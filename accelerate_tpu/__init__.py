@@ -1,0 +1,38 @@
+"""accelerate_tpu — a TPU-native training/inference framework with the
+capabilities of HuggingFace Accelerate, built from scratch on JAX/XLA.
+
+The user contract matches the reference (``/root/reference``):
+``Accelerator`` + ``prepare()`` + ``backward()`` + collectives + checkpoint
++ CLI — but the execution model is a pjit-compiled train step over a named
+ICI/DCN device mesh (see SURVEY.md for the full design map).
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .mesh import build_mesh, data_sharding, replicated, single_device_mesh
+from .utils.dataclasses import (
+    ContextParallelPlugin,
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
+    MeshPlugin,
+    ProjectConfiguration,
+    TensorParallelPlugin,
+)
+
+
+def __getattr__(name):
+    # Lazy imports keep `import accelerate_tpu` light and avoid cycles.
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name in ("Model", "PreparedModel", "ModelOutput"):
+        from . import modules
+
+        return getattr(modules, name)
+    raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

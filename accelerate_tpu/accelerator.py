@@ -1,0 +1,790 @@
+"""The Accelerator facade — same user contract as the reference
+(``/root/reference/src/accelerate/accelerator.py``, 3610 LoC), TPU-native
+execution.
+
+Design (SURVEY §7): ``prepare()`` does not mutate user objects in place; it
+computes shardings over the named mesh and returns wrappers whose work runs
+inside jit-compiled functions. ``backward(loss)`` consumes a deferred loss
+(see :mod:`accelerate_tpu.lazy`) and runs a cached compiled
+``value_and_grad``; the optimizer wrapper applies updates in a second jitted
+step. Collectives (``gather``/``reduce``/…) come from
+:mod:`accelerate_tpu.operations`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import operations as ops
+from .data_loader import DataLoaderShard, prepare_data_loader, skip_first_batches
+from .lazy import Deferred, clear_caches, grad_fn_for
+from .logging import get_logger
+from .mesh import data_sharding, replicated
+from .modules import Model, PreparedModel, extract_model_from_parallel
+from .optimizer import AcceleratedOptimizer
+from .parallel.sharding import (
+    infer_param_sharding,
+    opt_state_sharding_like,
+    shard_params,
+)
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MeshPlugin,
+    PrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+)
+
+logger = get_logger(__name__)
+
+
+class Accelerator:
+    """Create once, ``prepare()`` your objects, train (reference
+    ``Accelerator`` class ``accelerator.py:162``)."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: str | None = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: DataLoaderConfiguration | None = None,
+        deepspeed_plugin: DeepSpeedPlugin | None = None,
+        fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
+        megatron_lm_plugin=None,
+        mesh_plugin: MeshPlugin | None = None,
+        rng_types: list[str] | None = None,
+        log_with=None,
+        project_dir: str | None = None,
+        project_config: ProjectConfiguration | None = None,
+        gradient_accumulation_plugin: GradientAccumulationPlugin | None = None,
+        step_scheduler_with_optimizer: bool = True,
+        kwargs_handlers: list | None = None,
+        dynamo_backend=None,  # accepted for parity; XLA always compiles
+        even_batches: bool = True,
+        use_seedable_sampler: bool = False,
+    ):
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # plugin resolution from args/env (reference :293-376)
+        if deepspeed_plugin is None and os.environ.get("ACCELERATE_USE_DEEPSPEED", "false") == "true":
+            deepspeed_plugin = DeepSpeedPlugin()
+        if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false") == "true":
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+        if deepspeed_plugin is not None and fsdp_plugin is None:
+            fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
+        self.deepspeed_plugin = deepspeed_plugin
+        self.fsdp_plugin = fsdp_plugin
+        self.megatron_lm_plugin = megatron_lm_plugin
+
+        # kwargs handlers (reference :387-421)
+        self.scaler_handler = None
+        self.init_handler = None
+        self.profile_handler = None
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, GradScalerKwargs):
+                self.scaler_handler = handler
+            elif isinstance(handler, InitProcessGroupKwargs):
+                self.init_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
+
+        init_kwargs = self.init_handler.to_kwargs() if self.init_handler else {}
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision,
+            cpu=cpu,
+            mesh_plugin=mesh_plugin,
+            fsdp_plugin=fsdp_plugin,
+            _from_accelerator=True,
+            **init_kwargs,
+        )
+
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(
+            split_batches=split_batches,
+            even_batches=even_batches,
+            use_seedable_sampler=use_seedable_sampler,
+        )
+        if gradient_accumulation_plugin is None:
+            env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
+            steps = gradient_accumulation_steps if gradient_accumulation_steps > 1 else env_steps
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
+        self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
+
+        self.device_placement = device_placement
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.rng_types = rng_types or ["python", "numpy"]
+
+        # fp16 → static loss scale (no dynamic GradScaler needed on TPU)
+        self._loss_scale = None
+        if self.mixed_precision == "fp16":
+            self._loss_scale = (self.scaler_handler.init_scale if self.scaler_handler else 1024.0)
+
+        self._models: list[PreparedModel] = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list[DataLoaderShard] = []
+        self._custom_objects: list = []
+        self.step = 0
+        self.flag_tensor = None
+
+        from .tracking import filter_trackers
+
+        self.log_with = filter_trackers(log_with, self.logging_dir)
+        self.trackers = []
+
+    # ------------------------------------------------------------------
+    # properties delegating to state (reference :525-760)
+    # ------------------------------------------------------------------
+
+    @property
+    def distributed_type(self):
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self):
+        return self.state.num_processes
+
+    @property
+    def process_index(self):
+        return self.state.process_index
+
+    @property
+    def local_process_index(self):
+        return self.state.local_process_index
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def is_main_process(self):
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self):
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self):
+        return self.state.is_last_process
+
+    @property
+    def use_distributed(self):
+        return self.state.use_distributed
+
+    @property
+    def mixed_precision(self):
+        return self.state.mixed_precision
+
+    @property
+    def split_batches(self):
+        return self.dataloader_config.split_batches
+
+    @property
+    def even_batches(self):
+        return self.dataloader_config.even_batches
+
+    @even_batches.setter
+    def even_batches(self, value):
+        self.dataloader_config.even_batches = value
+
+    @property
+    def use_seedable_sampler(self):
+        return self.dataloader_config.use_seedable_sampler
+
+    @property
+    def non_blocking(self):
+        return self.dataloader_config.non_blocking
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def save_iteration(self):
+        return self.project_configuration.iteration
+
+    @property
+    def sync_gradients(self):
+        return self.gradient_state.sync_gradients
+
+    @sync_gradients.setter
+    def sync_gradients(self, value):
+        self.gradient_state.sync_gradients = value
+
+    @property
+    def gradient_accumulation_steps(self):
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value):
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def compute_dtype(self):
+        return {
+            "bf16": jnp.bfloat16,
+            "fp16": jnp.float16,
+            "fp8": jnp.bfloat16,  # fp8 matmul support is generation-gated; bf16 fallback
+        }.get(self.mixed_precision)
+
+    # ------------------------------------------------------------------
+    # process control (delegation)
+    # ------------------------------------------------------------------
+
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    def on_main_process(self, function):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function):
+        return self.state.on_local_main_process(function)
+
+    def on_last_process(self, function):
+        return self.state.on_last_process(function)
+
+    def on_process(self, function=None, process_index=None):
+        return self.state.on_process(function, process_index)
+
+    def on_local_process(self, function=None, local_process_index=None):
+        return self.state.on_local_process(function, local_process_index)
+
+    @contextlib.contextmanager
+    def main_process_first(self):
+        with self.state.main_process_first():
+            yield
+
+    @contextlib.contextmanager
+    def local_main_process_first(self):
+        with self.state.local_main_process_first():
+            yield
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    # ------------------------------------------------------------------
+    # prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, *args, device_placement: list[bool] | None = None):
+        """Shard, place, and wrap objects (reference ``prepare``
+        ``accelerator.py:1225``). Pass any combination of models
+        (:class:`Model` / flax module+params), optax transformations,
+        dataloaders and schedule fns; order is preserved."""
+        if device_placement is None:
+            device_placement = [None] * len(args)
+
+        # pass 1: everything except schedulers (they need bound optimizers)
+        prepared = []
+        for obj, dp in zip(args, device_placement):
+            if _is_model(obj):
+                prepared.append(self.prepare_model(obj, device_placement=dp))
+            elif _is_optimizer(obj):
+                prepared.append(self.prepare_optimizer(obj, device_placement=dp))
+            elif _is_dataloader(obj):
+                prepared.append(self.prepare_data_loader(obj, device_placement=dp))
+            else:
+                prepared.append(obj)
+
+        # bind optimizers to models by position pairing
+        models = [p for p in prepared if isinstance(p, PreparedModel)]
+        optimizers = [p for p in prepared if isinstance(p, AcceleratedOptimizer)]
+        for i, opt in enumerate(optimizers):
+            if opt.model is None:
+                model = models[min(i, len(models) - 1)] if models else None
+                if model is None:
+                    raise ValueError("an optimizer was passed to prepare() without any model")
+                opt_sharding = opt_state_sharding_like(
+                    opt.optimizer, model.params, model.param_sharding, self.mesh
+                )
+                opt.bind(model, opt_state_sharding=opt_sharding)
+
+        # pass 2: schedulers
+        result = []
+        for obj, p in zip(args, prepared):
+            if p is obj and _is_scheduler(obj):
+                result.append(self.prepare_scheduler(obj))
+            else:
+                result.append(p)
+        return result[0] if len(result) == 1 else tuple(result)
+
+    def prepare_model(self, model, device_placement: bool | None = None, evaluation_mode: bool = False):
+        """(Reference ``prepare_model`` ``accelerator.py:1361``.)"""
+        if isinstance(model, PreparedModel):
+            return model
+        model = _as_model(model)
+        rules = model.partition_rules
+        sharding = infer_param_sharding(model.params, self.mesh, self.fsdp_plugin, rules)
+        params = shard_params(model.params, sharding)
+        prepared = PreparedModel(
+            model,
+            accelerator=self,
+            compute_dtype=self.compute_dtype,
+            param_sharding=sharding,
+        )
+        prepared.params = params
+        prepared.training = not evaluation_mode
+        self._models.append(prepared)
+        return prepared
+
+    def prepare_optimizer(self, optimizer, device_placement: bool | None = None):
+        if isinstance(optimizer, AcceleratedOptimizer):
+            return optimizer
+        wrapped = AcceleratedOptimizer(optimizer, scaler=self._loss_scale)
+        self._optimizers.append(wrapped)
+        return wrapped
+
+    def prepare_data_loader(self, data_loader, device_placement: bool | None = None, slice_fn_for_dispatch=None):
+        if isinstance(data_loader, DataLoaderShard):
+            return data_loader
+        prepared = prepare_data_loader(
+            data_loader,
+            num_processes=self.num_processes,
+            process_index=self.process_index,
+            split_batches=self.split_batches,
+            put_on_device=device_placement if device_placement is not None else self.device_placement,
+            rng_types=self.rng_types,
+            even_batches=self.even_batches,
+            use_seedable_sampler=self.use_seedable_sampler,
+            sharding=data_sharding(self.mesh),
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def prepare_scheduler(self, scheduler):
+        if isinstance(scheduler, AcceleratedScheduler):
+            return scheduler
+        wrapped = AcceleratedScheduler(
+            scheduler,
+            self._optimizers,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.split_batches,
+        )
+        self._schedulers.append(wrapped)
+        return wrapped
+
+    # ------------------------------------------------------------------
+    # training step surface
+    # ------------------------------------------------------------------
+
+    def backward(self, loss, **kwargs):
+        """Compute gradients of a deferred loss and accumulate them into the
+        bound optimizers (reference ``backward`` ``accelerator.py:2218``:
+        scales by 1/accumulation steps :2240)."""
+        if not isinstance(loss, Deferred):
+            raise TypeError(
+                "backward() expects the deferred loss produced by a prepared "
+                "model call; got a concrete value. Compute the loss from "
+                "model outputs (e.g. model(**batch).loss)."
+            )
+        scale = float(self.gradient_accumulation_steps)
+        if self._loss_scale is not None:
+            scale = scale / self._loss_scale  # fp16: scale loss UP by _loss_scale
+        trainable = [opt.model for opt in self._optimizers if opt.model is not None]
+        if not trainable:
+            trainable = list(self._models)
+        jitted, trainables, frozen, inputs = grad_fn_for(loss, trainable, scale)
+        train_params = [m.params for m in trainables]
+        frozen_params = [m.params for m in frozen]
+        (scaled_loss, unscaled_loss), grads = jitted(train_params, frozen_params, inputs)
+        loss._set_forced(unscaled_loss)
+        for model, g in zip(trainables, grads):
+            opt = self._optimizer_for(model)
+            if opt is not None:
+                opt._accumulate_grads(g)
+            else:
+                # optimizer-less model: grads exposed via PreparedModel.grads
+                # for manual updates (reference analog: .grad on parameters)
+                model.accumulate_grads(g)
+
+    def _optimizer_for(self, model) -> AcceleratedOptimizer | None:
+        for opt in self._optimizers:
+            if opt.model is model:
+                return opt
+        return None
+
+    def _do_sync(self):
+        """(Reference ``accelerator.py:1034-1041``.)"""
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self.step = 0
+            self.gradient_state._set_sync_gradients(True)
+        else:
+            self.step += 1
+            self.gradient_state._set_sync_gradients(
+                (self.step % self.gradient_state.num_steps) == 0
+            )
+
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """(Reference ``accumulate`` ``accelerator.py:1060``.)"""
+        self._do_sync()
+        with contextlib.ExitStack() as stack:
+            if not self.sync_gradients:
+                for m in models:
+                    stack.enter_context(self.no_sync(m))
+            yield
+
+    @contextlib.contextmanager
+    def no_sync(self, model):
+        """Under GSPMD gradients are reduced inside the compiled step, so
+        there is no cross-rank traffic to skip (reference ``no_sync``
+        ``accelerator.py:945-983`` suppresses DDP allreduce); the context
+        keeps the API and the ``sync_gradients`` bookkeeping."""
+        old = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(old)
+
+    @contextlib.contextmanager
+    def trigger_sync_in_backward(self, model):
+        old = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(True)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(old)
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches=None):
+        """Even batches are the default data contract on TPU (static shapes);
+        this context only toggles the dataloader flag (reference
+        ``accelerator.py:1105-1191``)."""
+        if even_batches is not None:
+            old = self.even_batches
+            self.even_batches = even_batches
+            try:
+                yield
+            finally:
+                self.even_batches = old
+        else:
+            yield
+
+    def clip_grad_norm_(self, parameters, max_norm, norm_type=2):
+        """Clip accumulated grads; returns the pre-clip global norm
+        (reference ``clip_grad_norm_`` ``accelerator.py:2346``; like the
+        reference's ``unscale_gradients`` there, fp16 loss-scaled grads are
+        unscaled before clipping so both the clip and the returned norm are
+        in true gradient units)."""
+        opt = self._match_optimizer_for_parameters(parameters)
+        if opt is None or opt.grads is None:
+            return jnp.asarray(0.0)
+        opt.unscale_gradients()
+        clip = opt._jit_cache.get("clip_norm")
+        if clip is None:
+            def _clip(grads, max_norm):
+                norm = optax.global_norm(grads)
+                factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+                return jax.tree.map(lambda g: g * factor, grads), norm
+
+            clip = jax.jit(_clip, donate_argnums=(0,))
+            opt._jit_cache["clip_norm"] = clip
+        new_grads, norm = clip(opt._grads, float(max_norm))
+        opt._grads = new_grads
+        return norm
+
+    def clip_grad_value_(self, parameters, clip_value):
+        """(Reference ``accelerator.py:2403``.)"""
+        opt = self._match_optimizer_for_parameters(parameters)
+        if opt is None or opt.grads is None:
+            return
+        opt.unscale_gradients()
+        clip = opt._jit_cache.get("clip_value")
+        if clip is None:
+            def _clip(grads, v):
+                return jax.tree.map(lambda g: jnp.clip(g, -v, v), grads)
+
+            clip = jax.jit(_clip, donate_argnums=(0,))
+            opt._jit_cache["clip_value"] = clip
+        opt._grads = clip(opt._grads, float(clip_value))
+
+    def unscale_gradients(self, optimizer=None):
+        """(Reference ``unscale_gradients`` ``accelerator.py:2311``.)"""
+        opts = [optimizer] if optimizer is not None else self._optimizers
+        for opt in opts:
+            opt.unscale_gradients()
+
+    def _match_optimizer_for_parameters(self, parameters):
+        if isinstance(parameters, PreparedModel):
+            return self._optimizer_for(parameters)
+        if isinstance(parameters, AcceleratedOptimizer):
+            return parameters
+        # params pytree: match by identity against bound models
+        for opt in self._optimizers:
+            if opt.model is not None and opt.model.params is parameters:
+                return opt
+        return self._optimizers[0] if self._optimizers else None
+
+    # ------------------------------------------------------------------
+    # collectives
+    # ------------------------------------------------------------------
+
+    def _force_deferred(self, tensor):
+        return jax.tree.map(
+            lambda t: t.force() if isinstance(t, Deferred) else t,
+            tensor,
+            is_leaf=lambda t: isinstance(t, Deferred),
+        )
+
+    def gather(self, tensor):
+        """(Reference ``gather`` ``accelerator.py:2414``.)"""
+        return ops.gather(self._force_deferred(tensor))
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather + drop the duplicated tail on the last batch (reference
+        ``accelerator.py:2462-2533`` using ``GradientState.remainder``)."""
+        input_data = self._force_deferred(input_data)
+        try:
+            recursively_check = ops.find_batch_size(input_data) is not None
+        except Exception:
+            recursively_check = False
+        if use_gather_object or not recursively_check:
+            data = ops.gather_object(
+                input_data if isinstance(input_data, list) else [input_data]
+            )
+            return data
+        data = ops.gather(input_data)
+        remainder = self.gradient_state.remainder
+        if self.gradient_state.end_of_dataloader and remainder > 0:
+            def _truncate(t):
+                return t[:remainder] if hasattr(t, "ndim") and t.ndim > 0 else t
+
+            data = jax.tree.map(_truncate, data)
+        return data
+
+    def reduce(self, tensor, reduction="sum", scale=1.0):
+        return ops.reduce(self._force_deferred(tensor), reduction=reduction, scale=scale)
+
+    def pad_across_processes(self, tensor, dim=0, pad_index=0, pad_first=False):
+        return ops.pad_across_processes(
+            self._force_deferred(tensor), dim=dim, pad_index=pad_index, pad_first=pad_first
+        )
+
+    # -- trigger API (reference ``accelerator.py:2252-2309``) ----------------
+
+    def set_trigger(self):
+        self.flag_tensor = np.ones((), dtype=np.int32)
+
+    def check_trigger(self) -> bool:
+        flag = self.flag_tensor if self.flag_tensor is not None else np.zeros((), dtype=np.int32)
+        total = ops.reduce(flag, reduction="sum")
+        triggered = bool(np.asarray(total) >= 1)
+        if triggered:
+            self.flag_tensor = None
+        return triggered
+
+    # ------------------------------------------------------------------
+    # contexts
+    # ------------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler=None):
+        """Precision is a trace-time dtype policy on TPU — the context is
+        accepted for parity (reference ``accelerator.py:3435``)."""
+        yield
+
+    @contextlib.contextmanager
+    def profile(self, profile_handler: ProfileKwargs | None = None):
+        """``jax.profiler`` trace (reference builds torch.profiler,
+        ``accelerator.py:3462-3519``)."""
+        handler = profile_handler or self.profile_handler or ProfileKwargs()
+        trace_dir = handler.output_trace_dir
+        if trace_dir is None:
+            yield None
+            return
+        with jax.profiler.trace(trace_dir):
+            yield None
+
+    # ------------------------------------------------------------------
+    # model/optimizer interop
+    # ------------------------------------------------------------------
+
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        return extract_model_from_parallel(model, keep_fp32_wrapper)
+
+    def free_memory(self, *objects):
+        """Release prepared references + compiled-step caches (reference
+        ``free_memory`` ``accelerator.py:3282``)."""
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self.step = 0
+        clear_caches()
+        jax.clear_caches()
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    def get_state_dict(self, model, unwrap=True):
+        if isinstance(model, PreparedModel):
+            return model.state_dict()
+        if isinstance(model, Model):
+            return PreparedModel(model).state_dict()
+        raise TypeError(f"cannot extract state dict from {type(model)}")
+
+    # ------------------------------------------------------------------
+    # checkpointing facade (impl in checkpointing.py)
+    # ------------------------------------------------------------------
+
+    def register_for_checkpointing(self, *objects):
+        for obj in objects:
+            if not (hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict")):
+                raise ValueError(
+                    f"{obj} must define state_dict/load_state_dict to be registered"
+                )
+        self._custom_objects.extend(objects)
+
+    def save_state(self, output_dir: str | None = None, **save_model_func_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+
+    def load_state(self, input_dir: str | None = None, **load_model_func_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+
+    def save_model(self, model, save_directory: str, max_shard_size="10GB", safe_serialization=True):
+        from .checkpointing import save_model_weights
+
+        return save_model_weights(self, model, save_directory, max_shard_size, safe_serialization)
+
+    def save(self, obj, f, safe_serialization=False):
+        from .checkpointing import save_object
+
+        if self.is_main_process:
+            save_object(obj, f, safe_serialization=safe_serialization)
+
+    # ------------------------------------------------------------------
+    # tracking facade (impl in tracking.py)
+    # ------------------------------------------------------------------
+
+    def init_trackers(self, project_name: str, config: dict | None = None, init_kwargs: dict | None = None):
+        from .tracking import init_trackers
+
+        self.trackers = init_trackers(
+            self.log_with, project_name, self.logging_dir, config, init_kwargs or {}
+        )
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if getattr(tracker, "name", None) == name:
+                return tracker.tracker if unwrap else tracker
+        from .tracking import GeneralTracker
+
+        return GeneralTracker(_blank=True)
+
+    def log(self, values: dict, step: int | None = None, log_kwargs: dict | None = None):
+        for tracker in self.trackers:
+            tracker.log(values, step=step, **(log_kwargs or {}).get(tracker.name, {}))
+
+    def end_training(self):
+        for tracker in self.trackers:
+            tracker.finish()
+        self.wait_for_everyone()
+
+    # ------------------------------------------------------------------
+    # misc parity helpers
+    # ------------------------------------------------------------------
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return skip_first_batches(dataloader, num_batches)
+
+    def __repr__(self):
+        return repr(self.state)
+
+
+# ---------------------------------------------------------------------------
+# type sniffing for prepare()
+# ---------------------------------------------------------------------------
+
+
+def _is_model(obj) -> bool:
+    return isinstance(obj, (Model, PreparedModel))
+
+
+def _as_model(obj) -> Model:
+    if isinstance(obj, Model):
+        return obj
+    raise TypeError(
+        f"cannot prepare {type(obj)} as a model; wrap it in accelerate_tpu.Model "
+        "(for flax modules: Model.from_flax(module, variables))"
+    )
+
+
+def _is_optimizer(obj) -> bool:
+    if isinstance(obj, AcceleratedOptimizer):
+        return True
+    return isinstance(obj, optax.GradientTransformation) or (
+        hasattr(obj, "init") and hasattr(obj, "update") and not hasattr(obj, "apply_fn")
+    )
+
+
+def _is_dataloader(obj) -> bool:
+    if isinstance(obj, DataLoaderShard):
+        return True
+    if hasattr(obj, "dataset") and (hasattr(obj, "batch_size") or hasattr(obj, "batch_sampler")):
+        return True
+    mod = type(obj).__module__ or ""
+    return mod.startswith("torch.utils.data")
+
+
+def _is_scheduler(obj) -> bool:
+    """A schedule is an optax schedule fn (closure from the optax package, or
+    a 1-arg function whose parameter is step-like) or a torch-style
+    scheduler object (step + get_last_lr). Everything else passes through
+    prepare() untouched, matching the reference's behaviour for
+    unrecognized objects (loss fns, tokenizers, collate fns, …)."""
+    import functools as _ft
+    import inspect
+    import types as _t
+
+    if isinstance(obj, AcceleratedScheduler):
+        return True
+    if hasattr(obj, "step") and hasattr(obj, "get_last_lr"):
+        return True
+    if not isinstance(obj, (_t.FunctionType, _ft.partial)) or _is_optimizer(obj):
+        return False
+    if (getattr(obj, "__module__", "") or "").startswith("optax"):
+        return True
+    try:
+        params = list(inspect.signature(obj).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    return len(params) == 1 and params[0].name in (
+        "step", "count", "t", "epoch", "iteration", "step_count", "global_step"
+    )

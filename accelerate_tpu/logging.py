@@ -1,0 +1,57 @@
+"""Multi-process-aware logging (reference ``/root/reference/src/accelerate/
+logging.py:22-125``): ``main_process_only=True`` by default, ``in_order``
+rank-by-rank mode for debugging)."""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logs only on the main process unless ``main_process_only=False`` is
+    passed per-call; ``in_order=True`` serialises output rank by rank."""
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if not self.isEnabledFor(level):
+            return
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        from .state import PartialState
+
+        state = PartialState()
+        if not in_order:
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            return
+        for i in range(state.num_processes):
+            if i == state.process_index:
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, f"[rank {i}] {msg}", *args, **kwargs)
+            state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """(Reference ``logging.py:82``.) Honors ``ACCELERATE_LOG_LEVEL``."""
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

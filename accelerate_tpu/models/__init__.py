@@ -1,0 +1,1 @@
+from .llama import LlamaConfig, LlamaForCausalLM, init_llama_params, llama_apply

@@ -1,0 +1,224 @@
+"""Llama-family causal LM, TPU-first.
+
+The flagship model (BASELINE config #3: Llama-2-7B FSDP finetune). Design,
+per the scaling-book recipe rather than the reference's torch model zoo
+(the reference itself ships no models — it wraps ``transformers``):
+
+* **layer-stacked params + ``lax.scan``** — every block's weights carry a
+  leading ``[n_layers]`` dim and one scan body applies the stack. Compile
+  time is O(1) in depth and XLA sees one fused block program.
+* **explicit partition rules** — q/k/v/gate/up project *out* along ``tp``,
+  o/down project *in* along ``tp`` (one psum per block, rides ICI);
+  everything else shards its largest dim on ``fsdp`` (ZeRO-3-style).
+* **activation sharding constraints** — hidden states pinned to
+  ``P(('dp','fsdp'), 'cp', None)`` so sequence/context parallelism composes.
+* bf16 matmuls / fp32 norms+softmax; ``jax.checkpoint`` on the block for
+  rematerialised backward.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..modules import Model, ModelOutput
+from ..ops.layers import (
+    apply_rope,
+    causal_attention,
+    cross_entropy_loss,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab_size=256, hidden_size=64, layers=2, heads=4, seq=128):
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=hidden_size,
+            intermediate_size=hidden_size * 3,
+            num_hidden_layers=layers,
+            num_attention_heads=heads,
+            num_key_value_heads=heads,
+            max_position_embeddings=seq,
+            remat=False,
+        )
+
+
+#: path-regex → PartitionSpec. Layer-stacked leaves have a leading [layers]
+#: dim (never sharded — it's the scan axis).
+LLAMA_PARTITION_RULES = [
+    (r"embed_tokens", P("tp", "fsdp")),
+    (r"layers\.(wq|wk|wv)", P(None, "fsdp", "tp")),
+    (r"layers\.wo", P(None, "tp", "fsdp")),
+    (r"layers\.(w_gate|w_up)", P(None, "fsdp", "tp")),
+    (r"layers\.w_down", P(None, "tp", "fsdp")),
+    (r"norm", P()),
+    (r"lm_head", P("fsdp", "tp")),
+]
+
+
+def init_llama_params(key: jax.Array, config: LlamaConfig, dtype=jnp.float32):
+    """Initialise the layer-stacked parameter pytree."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    h, ff, nh, nkv, hd = (
+        c.hidden_size,
+        c.intermediate_size,
+        c.num_attention_heads,
+        c.num_key_value_heads,
+        c.head_dim,
+    )
+    L = c.num_hidden_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=dtype)
+
+    def dense_init(key, *shape, in_dim):
+        scale = 1.0 / np.sqrt(in_dim)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    ks = jax.random.split(k_layers, 8)
+    params = {
+        "embed_tokens": (
+            jax.random.normal(k_embed, (c.vocab_size, h), dtype=jnp.float32) * 0.02
+        ).astype(dtype),
+        "layers": {
+            "wq": dense_init(ks[0], L, h, nh * hd, in_dim=h),
+            "wk": dense_init(ks[1], L, h, nkv * hd, in_dim=h),
+            "wv": dense_init(ks[2], L, h, nkv * hd, in_dim=h),
+            "wo": dense_init(ks[3], L, nh * hd, h, in_dim=nh * hd),
+            "w_gate": dense_init(ks[4], L, h, ff, in_dim=h),
+            "w_up": dense_init(ks[5], L, h, ff, in_dim=h),
+            "w_down": dense_init(ks[6], L, ff, h, in_dim=ff),
+            "attn_norm": norm_init(L, h),
+            "mlp_norm": norm_init(L, h),
+        },
+        "norm": norm_init(h),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = dense_init(k_head, h, c.vocab_size, in_dim=h)
+    return params
+
+
+def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
+    """One transformer block as a scan body over stacked layer params."""
+    c = config
+    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+
+    def body(x, layer):
+        b, s, h = x.shape
+        # attention
+        y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = (y @ layer["wq"]).reshape(b, s, nh, hd)
+        k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
+        v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
+        k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
+        attn = causal_attention(q, k, v, segment_mask=attention_mask)
+        x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+        # mlp (SwiGLU)
+        y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
+        x = x + gated @ layer["w_down"]
+        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+        return x, None
+
+    if config.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    return body
+
+
+def _constrain(x, spec):
+    """Sharding constraint that is a no-op outside a mesh context where the
+    axes don't exist (keeps the model runnable on a bare single device)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+def llama_apply(
+    config: LlamaConfig,
+    params,
+    input_ids: jax.Array,  # [b, s] int32
+    attention_mask: jax.Array | None = None,  # [b, s] 1=real
+    labels: jax.Array | None = None,  # [b, s]; -100 ignored
+    positions: jax.Array | None = None,
+):
+    c = config
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
+
+    x = params["embed_tokens"][input_ids]
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+
+    body = _block(c, cos, sin, positions, attention_mask)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    logits = x @ head
+    logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
+
+    out = ModelOutput(logits=logits)
+    if labels is not None:
+        # causal shift: predict token t+1 from prefix ≤ t
+        shifted_logits = logits[:, :-1, :]
+        shifted_labels = labels[:, 1:]
+        out["loss"] = cross_entropy_loss(shifted_logits, shifted_labels)
+    return out
+
+
+class LlamaForCausalLM:
+    """Factory mirroring the transformers entry point the reference's users
+    bring to ``prepare()``."""
+
+    @staticmethod
+    def from_config(config: LlamaConfig, seed: int = 0, dtype=jnp.float32) -> Model:
+        params = init_llama_params(jax.random.PRNGKey(seed), config, dtype=dtype)
+
+        def apply_fn(p, input_ids=None, attention_mask=None, labels=None, positions=None, **kw):
+            return llama_apply(config, p, input_ids, attention_mask, labels, positions)
+
+        model = Model(
+            apply_fn,
+            params,
+            partition_rules=LLAMA_PARTITION_RULES,
+            name="LlamaForCausalLM",
+        )
+        model.config = config
+        return model

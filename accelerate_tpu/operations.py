@@ -1,0 +1,413 @@
+"""Collective operations over pytrees — eager (cross-host) and in-jit (mesh).
+
+TPU-native re-design of ``/root/reference/src/accelerate/utils/operations.py``
+(871 LoC). The reference dispatches each op per torch backend
+(``_tpu_gather`` :306 / ``_gpu_gather`` :321, ``broadcast`` :543, ``reduce``
+:728…). Here there are exactly two worlds:
+
+* **eager** — host-level values (numpy / host-resident jax.Array) exchanged
+  across *processes* (hosts) via ``jax.experimental.multihost_utils``. These
+  are the ``gather_for_metrics`` / ``broadcast_object_list`` equivalents that
+  must work outside ``jit``.
+* **in-jit** — values inside a compiled step, where collectives are mesh ops
+  (``lax.psum`` / ``all_gather`` / ``ppermute`` / ``all_to_all``) expressed
+  against named axes. Exposed as thin wrappers (:mod:`jops`) for use under
+  ``shard_map``; under plain ``jit`` + ``NamedSharding`` XLA inserts them
+  automatically — which is the normal path.
+
+Debug mode (``ACCELERATE_DEBUG_MODE=1``) verifies shape/dtype agreement
+across processes before any eager collective, mirroring the reference's
+``verify_operation`` (``operations.py:368-400``).
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+
+class DistributedOperationException(Exception):
+    """Raised in debug mode when ranks disagree on operand structure
+    (reference ``operations.py:359``)."""
+
+
+# ---------------------------------------------------------------------------
+# pytree plumbing
+# ---------------------------------------------------------------------------
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable[[Any], bool] = lambda t: isinstance(t, (jax.Array, np.ndarray)),
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every array leaf of a nested structure (reference
+    ``operations.py:85``; here it is a jax.tree.map specialisation that keeps
+    non-array leaves intact)."""
+
+    def _apply(leaf):
+        if test_type(leaf):
+            return func(leaf, *args, **kwargs)
+        if error_on_other_type:
+            raise TypeError(f"Unsupported type {type(leaf)} passed to {func.__name__}")
+        return leaf
+
+    return jax.tree.map(_apply, data)
+
+
+def is_array_like(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def send_to_device(tensor: Any, device=None, non_blocking: bool = True, skip_keys=None):
+    """Move a pytree onto a device or (Named)Sharding (reference
+    ``operations.py:136``). ``device`` may be a jax.Device, a Sharding, or
+    None (default device)."""
+    del non_blocking  # device_put is async by nature
+
+    def _put(leaf):
+        return jax.device_put(leaf, device)
+
+    if skip_keys and isinstance(tensor, dict):
+        return {
+            k: (v if k in skip_keys else send_to_device(v, device)) for k, v in tensor.items()
+        }
+    return recursively_apply(_put, tensor)
+
+
+def get_data_structure(data: Any):
+    """Shape/dtype skeleton of a pytree (reference ``operations.py:171``)."""
+    return recursively_apply(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), data)
+
+
+def listify(data: Any):
+    """Convert all array leaves to plain Python lists (reference :197)."""
+    return recursively_apply(lambda t: np.asarray(t).tolist(), data)
+
+
+def convert_to_fp32(tensor: Any):
+    """Upcast 16-bit float leaves to fp32 (reference
+    ``convert_outputs_to_fp32``/``convert_to_fp32`` :787-829)."""
+
+    def _upcast(t):
+        if t.dtype in (jnp.bfloat16, jnp.float16):
+            return t.astype(jnp.float32)
+        return t
+
+    return recursively_apply(_upcast, tensor)
+
+
+def find_device(data: Any):
+    """First device found in a pytree (reference :831)."""
+    for leaf in jax.tree.leaves(data):
+        if isinstance(leaf, jax.Array):
+            try:
+                return next(iter(leaf.devices()))
+            except Exception:
+                continue
+    return None
+
+
+def find_batch_size(data: Any) -> int | None:
+    for leaf in jax.tree.leaves(data):
+        if is_array_like(leaf) and leaf.ndim > 0:
+            return leaf.shape[0]
+    return None
+
+
+def slice_tensors(data: Any, tensor_slice: slice, process_index=None, num_processes=None):
+    """Slice every leaf along dim 0 (reference ``operations.py:585``)."""
+    return recursively_apply(lambda t: t[tensor_slice], data)
+
+
+def concatenate(data: list[Any], dim: int = 0):
+    """Concatenate a list of same-structure pytrees leafwise (reference :605)."""
+    if isinstance(data[0], (tuple, list)):
+        return type(data[0])(concatenate([d[i] for d in data], dim=dim) for i in range(len(data[0])))
+    if isinstance(data[0], dict):
+        return type(data[0])({k: concatenate([d[k] for d in data], dim=dim) for k in data[0]})
+    if not is_array_like(data[0]):
+        raise TypeError(f"Cannot concatenate {type(data[0])}")
+    return jnp.concatenate([jnp.asarray(d) for d in data], axis=dim)
+
+
+# ---------------------------------------------------------------------------
+# debug-mode verification
+# ---------------------------------------------------------------------------
+
+def _state():
+    from .state import PartialState
+
+    return PartialState()
+
+
+def verify_operation(function: Callable):
+    """Debug-mode wrapper: all processes must agree on operand metadata
+    (reference ``operations.py:368-400``)."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        state = _state()
+        if not state.debug or state.num_processes == 1:
+            return function(*args, **kwargs)
+        tensor = kwargs.get("tensor", args[0] if args else None)
+        meta = jax.tree.map(
+            lambda t: (tuple(t.shape), str(t.dtype)) if is_array_like(t) else None, tensor
+        )
+        from jax.experimental import multihost_utils
+
+        all_meta = gather_object([meta])
+        if not all(m == all_meta[0] for m in all_meta):
+            raise DistributedOperationException(
+                f"Mismatch between processes in {function.__name__}: "
+                + "; ".join(f"process {i}: {m}" for i, m in enumerate(all_meta))
+            )
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# eager collectives (outside jit)
+# ---------------------------------------------------------------------------
+
+def _materialize(t: jax.Array | np.ndarray) -> np.ndarray | jax.Array:
+    """Bring a possibly device-sharded array to a host-global view."""
+    if isinstance(t, jax.Array):
+        if not t.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(t, tiled=True)
+        return np.asarray(jax.device_get(t))
+    return t
+
+
+@verify_operation
+def gather(tensor: Any):
+    """Global view of per-shard data, concatenated on dim 0 (reference
+    ``gather`` :423). A globally-sharded ``jax.Array`` *is already* the
+    gathered value — we materialise it on host; multi-host host-local values
+    go through ``process_allgather``."""
+    state = _state()
+
+    def _gather(t):
+        if isinstance(t, jax.Array):
+            return _materialize(t)
+        if state.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(np.asarray(t), tiled=True)
+        return t
+
+    return recursively_apply(_gather, tensor)
+
+
+def gather_object(object: list[Any]) -> list[Any]:
+    """Gather arbitrary picklable objects from all processes into one list
+    (reference ``gather_object`` :449)."""
+    state = _state()
+    if state.num_processes == 1:
+        return list(object)
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(np.array([payload.size], dtype=np.int64))
+    max_size = int(sizes.max())
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: payload.size] = payload
+    all_payloads = multihost_utils.process_allgather(padded)  # [procs, max_size]
+    out: list[Any] = []
+    for i in range(all_payloads.shape[0]):
+        out.extend(pickle.loads(all_payloads[i, : int(sizes[i, 0])].tobytes()))
+    return out
+
+
+@verify_operation
+def broadcast(tensor: Any, from_process: int = 0):
+    """Broadcast array leaves from one process to all (reference :543)."""
+    state = _state()
+    if state.num_processes == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    def _bcast(t):
+        is_source = state.process_index == from_process
+        return multihost_utils.broadcast_one_to_all(
+            np.asarray(_materialize(t)), is_source=is_source
+        )
+
+    return recursively_apply(_bcast, tensor)
+
+
+def broadcast_object_list(object_list: list[Any], from_process: int = 0) -> list[Any]:
+    """In-place broadcast of picklable objects (reference :564)."""
+    state = _state()
+    if state.num_processes == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    is_source = state.process_index == from_process
+    size = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], dtype=np.int64), is_source=is_source
+    )
+    buf = np.zeros(int(size[0]), dtype=np.uint8)
+    if is_source:
+        buf[:] = payload
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    received = pickle.loads(data.tobytes())
+    object_list[:] = received
+    return object_list
+
+
+def _dim0_shard_count_of_sharding(sharding) -> int:
+    """How many ways a NamedSharding splits dim 0."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None or len(spec) == 0 or spec[0] is None:
+        return 1
+    axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    n = 1
+    for ax in axes:
+        n *= sharding.mesh.shape[ax]
+    return n
+
+
+def _dim0_shard_count(t: jax.Array) -> int:
+    """How many ways dim 0 of a jax.Array is split by its sharding."""
+    if not isinstance(t, jax.Array) or t.ndim == 0:
+        return 1
+    return _dim0_shard_count_of_sharding(getattr(t, "sharding", None))
+
+
+@verify_operation
+def reduce(tensor: Any, reduction: str = "mean", scale: float = 1.0):
+    """Elementwise reduce of per-participant values (reference ``reduce``
+    :728; XLA path :750-757 applied sum+scale). The participants are the
+    data-parallel shards: a batch-sharded global array of shape
+    ``[P·n, ...]`` reduces to ``[n, ...]`` combining its P shards —
+    the analog of each torch rank holding an ``[n, ...]`` tensor. Host
+    values on multi-host reduce across processes."""
+    state = _state()
+
+    def _reduce(t):
+        n_shards = _dim0_shard_count(t) if isinstance(t, jax.Array) else 1
+        value = np.asarray(_materialize(t))
+        if state.num_processes > 1 and not isinstance(t, jax.Array):
+            from jax.experimental import multihost_utils
+
+            stacked = multihost_utils.process_allgather(value)
+            out = stacked.sum(axis=0) * scale
+            if reduction == "mean":
+                out = out / state.num_processes
+            return out
+        if n_shards > 1 and value.shape[0] % n_shards == 0:
+            stacked = value.reshape((n_shards, value.shape[0] // n_shards) + value.shape[1:])
+            out = stacked.sum(axis=0) * scale
+            if reduction == "mean":
+                out = out / n_shards
+            return out
+        return value * scale
+
+    return recursively_apply(_reduce, tensor)
+
+
+@verify_operation
+def pad_across_processes(tensor: Any, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's arrays to the max size along ``dim`` so a gather
+    can concatenate them (reference :632)."""
+    state = _state()
+
+    def _pad(t):
+        t = np.asarray(_materialize(t))
+        if t.ndim == 0 or dim >= t.ndim:
+            return t
+        if state.num_processes == 1:
+            return t
+        from jax.experimental import multihost_utils
+
+        sizes = multihost_utils.process_allgather(np.array([t.shape[dim]], dtype=np.int64))
+        max_size = int(sizes.max())
+        if max_size == t.shape[dim]:
+            return t
+        pad_width = [(0, 0)] * t.ndim
+        pad_width[dim] = (max_size - t.shape[dim], 0) if pad_first else (0, max_size - t.shape[dim])
+        return np.pad(t, pad_width, constant_values=pad_index)
+
+    return recursively_apply(_pad, tensor)
+
+
+def pad_input_tensors(tensor: Any, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad a batch so it divides evenly across processes by repeating final
+    rows (reference ``pad_input_tensors`` :687)."""
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tensor
+    missing = num_processes - remainder
+
+    def _pad(t):
+        t = np.asarray(t)
+        if t.ndim == 0 or t.shape[dim] != batch_size:
+            return t
+        take = [t[-1:]] * missing
+        return np.concatenate([t] + take, axis=dim)
+
+    return recursively_apply(_pad, tensor)
+
+
+# ---------------------------------------------------------------------------
+# in-jit collectives over named mesh axes (for shard_map bodies / kernels)
+# ---------------------------------------------------------------------------
+
+class jops:
+    """Named-axis collectives usable inside ``shard_map``. The normal pjit
+    path never calls these explicitly — XLA inserts collectives from the
+    shardings — but ring attention, local-SGD averaging and the trigger API
+    (reference ``accelerator.py:2252-2309``) use them directly."""
+
+    psum = staticmethod(lax.psum)
+    pmean = staticmethod(lax.pmean)
+    pmax = staticmethod(lax.pmax)
+    pmin = staticmethod(lax.pmin)
+    ppermute = staticmethod(lax.ppermute)
+    all_to_all = staticmethod(lax.all_to_all)
+    axis_index = staticmethod(lax.axis_index)
+
+    @staticmethod
+    def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def ring_shift(x, axis_name: str, shift: int = 1):
+        """Rotate shards around the ring (KV rotation for ring attention)."""
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis_name, perm)
+
+
+def gather_sizes_across_processes(size: int) -> list[int]:
+    """All processes' values of a Python int (helper for uneven data ends)."""
+    state = _state()
+    if state.num_processes == 1:
+        return [size]
+    from jax.experimental import multihost_utils
+
+    sizes = multihost_utils.process_allgather(np.array([size], dtype=np.int64))
+    return [int(s) for s in sizes.reshape(-1)]
+
+
+def copy_tensor_to_devices(tensor):
+    """Replicate a host value onto every local device (reference
+    ``copy_tensor_to_devices`` — XLA path)."""
+    state = _state()
+    return jax.device_put(tensor, NamedSharding(state.mesh, P()))

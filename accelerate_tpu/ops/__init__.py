@@ -1,0 +1,8 @@
+from .layers import (
+    apply_rope,
+    causal_attention,
+    cross_entropy_loss,
+    dot_product_attention,
+    rms_norm,
+    rope_frequencies,
+)

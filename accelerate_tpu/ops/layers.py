@@ -1,0 +1,94 @@
+"""Core transformer ops, written for the MXU/VPU.
+
+No reference analog — the reference delegates all math to torch; these are
+the building blocks its model zoo gets from ``transformers``. Design notes:
+matmuls stay batched and bf16-friendly (MXU), elementwise chains are left
+for XLA to fuse (VPU), and everything is static-shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation (stability under bf16 compute)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0):
+    """Precomputed RoPE cos/sin tables [max_seq, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_seq_len)
+    freqs = np.outer(t, inv_freq)
+    return jnp.asarray(np.cos(freqs), dtype=jnp.float32), jnp.asarray(
+        np.sin(freqs), dtype=jnp.float32
+    )
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotate [batch, seq, heads, head_dim] by position-indexed tables."""
+    cos = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [b, s, n_heads, hd]
+    k: jax.Array,  # [b, s_kv, n_kv_heads, hd]
+    v: jax.Array,  # [b, s_kv, n_kv_heads, hd]
+    mask: jax.Array | None = None,  # broadcastable to [b, n_heads, s, s_kv]
+    scale: float | None = None,
+) -> jax.Array:
+    """Reference (non-Pallas) attention: einsum QK^T → softmax(fp32) → PV.
+    GQA handled by repeating KV heads. The Pallas flash kernel in
+    ``ops/flash_attention.py`` replaces this on the hot path."""
+    b, s, nh, hd = q.shape
+    n_kv = k.shape[2]
+    if n_kv != nh:
+        rep = nh // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.bool_) -> jax.Array:
+    return jnp.tril(jnp.ones((q_len, kv_len), dtype=dtype), k=kv_len - q_len)
+
+
+def causal_attention(q, k, v, segment_mask=None):
+    """Causal self-attention; ``segment_mask`` [b, s] marks valid tokens."""
+    s, skv = q.shape[1], k.shape[1]
+    mask = causal_mask(s, skv)[None, None, :, :]
+    if segment_mask is not None:
+        mask = mask & segment_mask[:, None, None, :].astype(bool)
+    return dot_product_attention(q, k, v, mask=mask)
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [b, s, vocab]
+    labels: jax.Array,  # [b, s] int; -100 = ignore
+    ignore_index: int = -100,
+) -> jax.Array:
+    """Token-level CE with ignore mask, fp32 log-softmax."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

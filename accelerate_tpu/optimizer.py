@@ -1,0 +1,211 @@
+"""Optimizer wrapper over optax.
+
+Reference: ``AcceleratedOptimizer`` (``/root/reference/src/accelerate/
+optimizer.py:37``) wraps a torch optimizer to (a) skip stepping while
+gradients accumulate, (b) integrate the GradScaler, (c) detect skipped
+steps. Here the optimizer is an optax ``GradientTransformation``; the
+wrapper owns the optimizer state, the accumulated gradients, and the jitted
+apply step. bf16 needs no loss scaling; with ``mixed_precision='fp16'`` a
+static loss scale is applied and non-finite gradients skip the step
+(preserving the ``optimizer_step_was_skipped`` contract, reference
+``optimizer.py:154-169``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .state import AcceleratorState, GradientState
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+class AcceleratedOptimizer:
+    """Owns (tx, opt_state) for one prepared model."""
+
+    def __init__(self, optimizer: optax.GradientTransformation, model=None, scaler=None):
+        if isinstance(optimizer, AcceleratedOptimizer):
+            raise ValueError("optimizer is already prepared")
+        self.optimizer = optimizer  # the raw optax transformation
+        self.model = model          # PreparedModel, bound during prepare()
+        self.scaler = scaler        # static loss scale (fp16 only)
+        self.accelerator_state = AcceleratorState() if AcceleratorState().initialized else None
+        self.gradient_state = GradientState()
+        self.opt_state = None
+        self._grads = None
+        self._grads_are_unscaled = False
+        self._accumulated_steps = 0
+        self._step_was_skipped = False
+        self._jit_cache: dict[str, Any] = {}
+
+    # -- initialisation (called by Accelerator.prepare) ----------------------
+
+    def bind(self, model, opt_state_sharding=None):
+        self.model = model
+        if opt_state_sharding is not None:
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=opt_state_sharding
+            )(model.params)
+        else:
+            self.opt_state = jax.jit(self.optimizer.init)(model.params)
+        return self
+
+    # -- gradient plumbing ----------------------------------------------------
+
+    def _accumulate_grads(self, grads):
+        if self._grads_are_unscaled and self.scaler is not None:
+            # grads already unscaled by a clip; bring the new contribution
+            # into the same units before accumulating
+            inv = 1.0 / self.scaler
+            grads = jax.tree.map(lambda g: g * inv, grads)
+        if self._grads is None:
+            self._grads = grads
+        else:
+            add = self._jit_cache.get("add")
+            if add is None:
+                add = jax.jit(_tree_add, donate_argnums=(0,))
+                self._jit_cache["add"] = add
+            self._grads = add(self._grads, grads)
+        self._accumulated_steps += 1
+
+    @property
+    def grads(self):
+        return self._grads
+
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op while accumulating, clears at boundary — matching the
+        reference's behaviour of only clearing on sync steps
+        (``optimizer.py:111``)."""
+        if self.gradient_state.sync_gradients:
+            self._grads = None
+            self._grads_are_unscaled = False
+            self._accumulated_steps = 0
+
+    # -- stepping -------------------------------------------------------------
+
+    def _apply_fn(self):
+        apply = self._jit_cache.get("apply")
+        if apply is None:
+            def _apply(params, opt_state, grads):
+                updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt_state
+
+            apply = jax.jit(_apply, donate_argnums=(0, 1, 2))
+            self._jit_cache["apply"] = apply
+        return apply
+
+    def _skip_fn(self):
+        skip = self._jit_cache.get("skip")
+        if skip is None:
+            def _all_finite(grads):
+                leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                return jnp.all(jnp.stack(leaves))
+
+            skip = jax.jit(_all_finite)
+            self._jit_cache["skip"] = skip
+        return skip
+
+    def unscale_gradients(self):
+        """Divide fp16 loss-scaled grads back to true units; idempotent
+        (reference GradScaler.unscale_ integration, ``optimizer.py:154``)."""
+        if self.scaler is None or self._grads is None or self._grads_are_unscaled:
+            return
+        inv = 1.0 / self.scaler
+        unscale = self._jit_cache.get("unscale")
+        if unscale is None:
+            unscale = jax.jit(
+                lambda g, s: jax.tree.map(lambda x: x * s, g), donate_argnums=(0,)
+            )
+            self._jit_cache["unscale"] = unscale
+        self._grads = unscale(self._grads, inv)
+        self._grads_are_unscaled = True
+
+    def step(self, closure=None):
+        if not self.gradient_state.sync_gradients:
+            self._step_was_skipped = False
+            return
+        if self._grads is None:
+            self._step_was_skipped = True
+            return
+        if self.scaler is not None:
+            # fp16 static-scale path: unscale + skip on non-finite
+            self.unscale_gradients()
+            if not bool(self._skip_fn()(self._grads)):
+                self._step_was_skipped = True
+                self._grads = None
+                self._grads_are_unscaled = False
+                self._accumulated_steps = 0
+                return
+        grads = self._grads
+        new_params, new_opt_state = self._apply_fn()(self.model.params, self.opt_state, grads)
+        self.model.params = new_params
+        self.opt_state = new_opt_state
+        self._grads = None
+        self._grads_are_unscaled = False
+        self._accumulated_steps = 0
+        self._step_was_skipped = False
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """(Reference ``optimizer.py:200``.)"""
+        return self._step_was_skipped
+
+    # -- state dict -----------------------------------------------------------
+
+    def state_dict(self):
+        return jax.device_get(self.opt_state)
+
+    def load_state_dict(self, state):
+        # Preserve shardings of the live opt_state when re-loading.
+        def _put(old, new):
+            if isinstance(old, jax.Array) and hasattr(old, "sharding"):
+                return jax.device_put(jnp.asarray(new, dtype=old.dtype), old.sharding)
+            return new
+
+        self.opt_state = jax.tree.map(_put, self.opt_state, state)
+
+    # -- lr plumbing (scheduler compat) ---------------------------------------
+
+    @property
+    def param_groups(self):
+        """Torch-compat view: one group exposing the injected hyperparams."""
+        hp = _find_hyperparams(self.opt_state)
+        if hp is None:
+            return [{}]
+        return [{k: (float(v) if jnp.ndim(v) == 0 else v) for k, v in hp.items()}]
+
+    def set_hyperparam(self, name: str, value):
+        hp = _find_hyperparams(self.opt_state)
+        if hp is None:
+            raise ValueError(
+                "optimizer was not built with optax.inject_hyperparams; "
+                "use accelerate_tpu.optim factories for schedulable optimizers"
+            )
+        hp[name] = jnp.asarray(value, dtype=jnp.asarray(hp[name]).dtype)
+
+    @property
+    def learning_rate(self):
+        hp = _find_hyperparams(self.opt_state)
+        if hp and "learning_rate" in hp:
+            return float(jax.device_get(hp["learning_rate"]))
+        return None
+
+
+def _find_hyperparams(opt_state):
+    """Locate an ``InjectStatefulHyperparamsState.hyperparams`` dict."""
+    if opt_state is None:
+        return None
+    states = opt_state if isinstance(opt_state, tuple) else (opt_state,)
+    for s in jax.tree.leaves(
+        states, is_leaf=lambda x: hasattr(x, "hyperparams")
+    ):
+        if hasattr(s, "hyperparams"):
+            return s.hyperparams
+    return None

@@ -1,0 +1,6 @@
+from .sharding import (
+    infer_param_sharding,
+    opt_state_sharding_like,
+    partition_spec_for,
+    shard_params,
+)

@@ -1,0 +1,88 @@
+"""LR scheduler wrapper.
+
+Reference: ``AcceleratedScheduler`` (``/root/reference/src/accelerate/
+scheduler.py:25``) steps the underlying scheduler only when the optimizer
+actually stepped, and by ``num_processes`` at a time unless
+``split_batches`` (:54-82). Here a scheduler is an optax schedule function
+``step -> lr``; the wrapper maintains the step counter with the same
+skip/×N semantics and writes the lr into the optimizer's injected
+hyperparams.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .optimizer import AcceleratedOptimizer
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler: Callable[[int], float],
+        optimizers,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+        self._step_count = 0
+        self._last_lr = None
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self._advance(1)
+            return
+        if not self.gradient_state.sync_gradients:
+            if self.gradient_state.adjust_scheduler:
+                self._step_count += 0  # explicitly: nothing happens mid-accumulation
+            return
+        # only advance if none of the bound optimizers skipped their step
+        if any(opt.step_was_skipped for opt in self.optimizers):
+            return
+        if self.split_batches:
+            self._advance(1)
+        else:
+            # reference semantics: one scheduler step per data-parallel rank.
+            # The torch world size maps to the mesh's data-parallel degree
+            # (dp×fsdp axes), not the host-process count.
+            state = AcceleratorState()
+            num = state.data_parallel_size if state.initialized else 1
+            self._advance(num)
+
+    def _advance(self, n: int):
+        self._step_count += n
+        if callable(self.scheduler):
+            lr = float(self.scheduler(self._step_count))
+        else:
+            # torch-style scheduler object: step it n times, read its lr
+            for _ in range(n):
+                self.scheduler.step()
+            lr = float(self.scheduler.get_last_lr()[0])
+        self._last_lr = lr
+        for opt in self.optimizers:
+            try:
+                opt.set_hyperparam("learning_rate", lr)
+            except ValueError:
+                pass  # fixed-lr optimizer: schedule is advisory only
+
+    def get_last_lr(self):
+        if self._last_lr is None:
+            lr = self.optimizers[0].learning_rate if self.optimizers else None
+            if lr is not None:
+                return [lr]
+            if callable(self.scheduler):
+                return [float(self.scheduler(0))]
+            return [float(self.scheduler.get_last_lr()[0])]
+        return [self._last_lr]
+
+    def state_dict(self):
+        return {"step_count": self._step_count, "last_lr": self._last_lr}
+
+    def load_state_dict(self, state):
+        self._step_count = state["step_count"]
+        self._last_lr = state.get("last_lr")

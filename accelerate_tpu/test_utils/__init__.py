@@ -1,0 +1,1 @@
+from .training import RegressionDataset, RegressionModel, mse_loss

@@ -1,0 +1,41 @@
+"""Closed-form training fixtures (reference ``test_utils/training.py:1-101``:
+``RegressionDataset`` / ``RegressionModel`` learn y = a·x + b)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..modules import Model, ModelOutput
+
+
+class RegressionDataset:
+    def __init__(self, a=2, b=3, length=64, seed=96):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.1 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def _regression_apply(params, x=None, y=None, **kwargs):
+    pred = params["a"] * x + params["b"]
+    out = ModelOutput(prediction=pred)
+    if y is not None:
+        out["loss"] = jnp.mean((pred - y) ** 2)
+    return out
+
+
+def RegressionModel(a=0.0, b=0.0):
+    """y = a·x + b with scalar params (matches the reference fixture)."""
+    params = {"a": jnp.asarray(float(a)), "b": jnp.asarray(float(b))}
+    return Model(_regression_apply, params, name="RegressionModel")
+
+
+def mse_loss(pred, target):
+    return ((pred - target) ** 2).mean()

@@ -1,0 +1,80 @@
+"""Environment-variable parsing helpers.
+
+TPU-native reimagining of the reference's ``utils/environment.py``
+(``/root/reference/src/accelerate/utils/environment.py:59-94``): the same
+string→bool/int coercion contract, keyed on ``ACCELERATE_*`` variables, so
+launcher-written configs round-trip identically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_TRUE = {"1", "true", "yes", "on", "y", "t"}
+_FALSE = {"0", "false", "no", "off", "n", "f", ""}
+
+
+def str_to_bool(value: str) -> int:
+    """Coerce an env-var string to 0/1 (raises on garbage, like the reference)."""
+    value = value.lower().strip()
+    if value in _TRUE:
+        return 1
+    if value in _FALSE:
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys: list[str], default: int) -> int:
+    """First present env var from ``env_keys`` parsed as int, else ``default``."""
+    for key in env_keys:
+        val = int(os.environ.get(key, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        return default
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Which of the given libraries are already imported in this process."""
+    import sys
+
+    return [lib for lib in library_names if lib in sys.modules]
+
+
+def patch_environment(**kwargs: Any):
+    """Context manager that temporarily sets (upper-cased) env vars.
+
+    Mirrors the reference test helper of the same name so launched
+    sub-configurations can be simulated in-process.
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def _patch():
+        existing = {}
+        for key, value in kwargs.items():
+            key = key.upper()
+            existing[key] = os.environ.get(key)
+            os.environ[key] = str(value)
+        try:
+            yield
+        finally:
+            for key, old in existing.items():
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
+
+    return _patch()

@@ -1,0 +1,70 @@
+"""RNG control.
+
+Reference: ``/root/reference/src/accelerate/utils/random.py`` (``set_seed``
+:31; ``synchronize_rng_states`` :66-128 broadcasts rank-0 torch RNG state).
+TPU-native: the *training* RNG is a ``jax.random`` key carried in TrainState
+(pure, splittable, reproducible by construction), so cross-process sync only
+concerns host-side RNGs (python/numpy, and torch's CPU generator when the
+torch-interop dataloader path is used).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable
+
+import numpy as np
+
+from .imports import is_torch_available
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
+    """Seed python/numpy(/torch) and return the matching JAX key seed.
+
+    ``device_specific`` offsets the seed by process index (reference
+    ``random.py:40-44``) — per-host different data augmentation while the
+    mesh step stays bitwise-deterministic from the TrainState key.
+    """
+    from ..state import PartialState
+
+    if device_specific:
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+    return seed
+
+
+def synchronize_rng_state(rng_type: str | None = None, generator=None):
+    """Broadcast the main process's host RNG state to all processes
+    (reference ``random.py:66-106``)."""
+    from .dataclasses import RNGType
+    from ..operations import broadcast_object_list
+    from ..state import PartialState
+
+    state = PartialState()
+    rng_type = RNGType(rng_type) if rng_type is not None else None
+    if state.num_processes == 1:
+        return
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        broadcast_object_list(payload)
+        random.setstate(payload[0])
+    elif rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        broadcast_object_list(payload)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.get_state()]
+        broadcast_object_list(payload)
+        generator.set_state(payload[0])
+    elif rng_type == RNGType.JAX:
+        pass  # the TrainState key is identical on all hosts by construction
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None):
+    for rng_type in rng_types:
+        synchronize_rng_state(rng_type=rng_type, generator=generator)

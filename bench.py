@@ -1,0 +1,183 @@
+"""Benchmark: flagship Llama train-step throughput on the attached chip.
+
+Prints ONE JSON line:
+  value        — tokens/sec of the full Accelerator user loop (the 5-line
+                 compat path: deferred forward → backward → step)
+  vs_baseline  — ratio vs a hand-fused raw-jit train step on the same model
+                 (1.0 == the framework adds zero overhead over pure JAX;
+                 the reference publishes no training throughput to compare
+                 against — see BASELINE.md)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bench_config():
+    from accelerate_tpu.models import LlamaConfig
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # smoke-test sizing
+        return LlamaConfig.tiny(vocab_size=512, hidden_size=128, layers=2, heads=4, seq=128), 4, 128
+    # ~470M-param slice of the llama2 architecture; fits one v5e chip with
+    # adam state in fp32
+    return (
+        LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=4096,
+            num_hidden_layers=24,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=1024,
+            remat=True,
+        ),
+        4,
+        1024,
+    )
+
+
+def _timed_steps(step_fn, n_warmup: int, n_steps: int) -> float:
+    """Time chained steps. ``step_fn`` returns a device scalar; we fetch the
+    final one to the host, which (unlike ``block_until_ready`` on remote
+    backends) reliably fences the whole data-dependent chain."""
+    for _ in range(n_warmup):
+        last = step_fn()
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        last = step_fn()
+    float(np.asarray(last))
+    return time.perf_counter() - t0
+
+
+def bench_accelerator_loop(config, batch, n_warmup=2, n_steps=10):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.mesh import data_sharding
+    from accelerate_tpu.models import LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(mixed_precision="bf16")
+    model, opt = accelerator.prepare(
+        LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
+    )
+    sharding = data_sharding(accelerator.mesh)
+    dev_batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in batch.items()}
+
+    def step():
+        out = model(**dev_batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        return out.loss.force()
+
+    t = _timed_steps(step, n_warmup, n_steps) / n_steps
+    accelerator.free_memory()  # drop params + compiled-graph caches before the next bench
+    import gc
+
+    gc.collect()
+    return t
+
+
+def bench_raw_jit(config, batch, n_warmup=2, n_steps=10):
+    """Hand-written fused train step: the 'pure JAX' bar."""
+    import optax
+
+    from accelerate_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    tx = optax.adamw(1e-4)
+    params = model.params
+    opt_state = tx.init(params)
+    bf16_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+
+    def loss_fn(p, b):
+        p16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
+        )
+        return model.apply_fn(p16, **b)["loss"].astype(jnp.float32)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, s, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    state = {"p": params, "s": opt_state}
+
+    def step():
+        state["p"], state["s"], loss = train_step(state["p"], state["s"], bf16_batch)
+        return loss
+
+    return _timed_steps(step, n_warmup, n_steps) / n_steps
+
+
+def _run_mode(mode: str) -> None:
+    config, bsz, seq = _bench_config()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, size=(bsz, seq)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    fn = bench_accelerator_loop if mode == "framework" else bench_raw_jit
+    t = fn(config, batch)
+    print(f"BENCH_RESULT {t:.6f}")
+
+
+def _subprocess_time(mode: str) -> float:
+    """Each measurement in its own process: clean HBM, no cross-bench cache
+    or allocator interference."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, mode],
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("BENCH_RESULT"):
+            return float(line.split()[1])
+    raise RuntimeError(f"bench mode {mode} failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+
+
+def main():
+    config, bsz, seq = _bench_config()
+    t_framework = _subprocess_time("framework")
+    t_raw = _subprocess_time("raw")
+
+    tokens_per_step = bsz * seq
+    tokens_per_sec = tokens_per_step / t_framework
+    vs_baseline = t_raw / t_framework  # 1.0 == framework as fast as raw jit
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] in ("framework", "raw"):
+        _run_mode(sys.argv[1])
+    else:
+        main()

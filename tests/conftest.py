@@ -1,0 +1,38 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initialises.
+
+This is the "multi-node without a cluster" fake backend (SURVEY §4): every
+sharding/collective path runs against 8 host-platform devices, mirroring the
+reference's gloo-on-localhost trick (``/root/reference/src/accelerate/
+test_utils/testing.py``) but inside one process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The env var alone is not enough when a site plugin (e.g. an out-of-tree TPU
+# backend) registers itself and rewrites platform selection — the config
+# update below always wins as long as it runs before backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    """Reset the Borg singletons between tests (reference
+    ``AccelerateTestCase``, ``test_utils/testing.py:479``)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

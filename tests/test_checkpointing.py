@@ -1,0 +1,136 @@
+"""Checkpoint round-trip (reference analog: ``tests/test_state_checkpointing.py``
+— resume must reproduce identical training trajectories)."""
+
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+
+class _Loader:
+    def __init__(self, dataset, batch_size):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = False
+        self.sampler = None
+        self.batch_sampler = None
+        self.collate_fn = None
+
+
+def _fresh_accelerator(**kwargs):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _train_steps(accelerator, model, opt, dl, n):
+    it = iter(dl)
+    for _ in range(n):
+        batch = next(it)
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+    return float(out.loss.item())
+
+
+def test_save_load_state_roundtrip(tmp_path):
+    accelerator = _fresh_accelerator()
+    model, opt, dl = accelerator.prepare(
+        RegressionModel(), optax.adam(0.05), _Loader(RegressionDataset(length=64), 16)
+    )
+    _train_steps(accelerator, model, opt, dl, 3)
+    params_before = {k: np.asarray(v) for k, v in model.params.items()}
+
+    ckpt = accelerator.save_state(str(tmp_path / "ckpt"))
+    assert os.path.isdir(ckpt)
+
+    # keep training, then restore — params and optimizer state must match
+    _train_steps(accelerator, model, opt, dl, 3)
+    assert not np.allclose(np.asarray(model.params["a"]), params_before["a"])
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    for k in params_before:
+        np.testing.assert_array_equal(np.asarray(model.params[k]), params_before[k])
+
+
+def test_resume_training_trajectory_identical(tmp_path):
+    """Train 6 steps straight vs save@3 → restore → 3 more: same params."""
+
+    def build():
+        accelerator = _fresh_accelerator()
+        return accelerator, *accelerator.prepare(
+            RegressionModel(), optax.adam(0.05), _Loader(RegressionDataset(length=96), 16)
+        )
+
+    acc1, m1, o1, d1 = build()
+    _train_steps(acc1, m1, o1, d1, 6)
+    straight = {k: np.asarray(v) for k, v in m1.params.items()}
+
+    acc2, m2, o2, d2 = build()
+    _train_steps(acc2, m2, o2, d2, 3)
+    acc2.save_state(str(tmp_path / "mid"))
+
+    acc3, m3, o3, d3 = build()
+    acc3.load_state(str(tmp_path / "mid"))
+    d3 = acc3.skip_first_batches(d3, 3)  # the documented resume idiom
+    _train_steps(acc3, m3, o3, d3, 3)
+    resumed = {k: np.asarray(v) for k, v in m3.params.items()}
+    for k in straight:
+        np.testing.assert_allclose(resumed[k], straight[k], rtol=1e-6)
+
+
+def test_automatic_checkpoint_rotation(tmp_path):
+    config = ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+    )
+    accelerator = _fresh_accelerator(project_config=config)
+    model, opt, dl = accelerator.prepare(
+        RegressionModel(), optax.adam(0.05), _Loader(RegressionDataset(length=32), 16)
+    )
+    _train_steps(accelerator, model, opt, dl, 1)
+    for _ in range(4):
+        accelerator.save_state()
+    checkpoints = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert checkpoints == ["checkpoint_2", "checkpoint_3"]
+
+
+def test_register_for_checkpointing_custom_object(tmp_path):
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def state_dict(self):
+            return {"n": self.n}
+
+        def load_state_dict(self, sd):
+            self.n = sd["n"]
+
+    accelerator = _fresh_accelerator()
+    model, opt, dl = accelerator.prepare(
+        RegressionModel(), optax.adam(0.05), _Loader(RegressionDataset(length=32), 16)
+    )
+    counter = Counter()
+    accelerator.register_for_checkpointing(counter)
+    counter.n = 7
+    _train_steps(accelerator, model, opt, dl, 1)
+    accelerator.save_state(str(tmp_path / "c"))
+    counter.n = 0
+    accelerator.load_state(str(tmp_path / "c"))
+    assert counter.n == 7
+
+
+def test_save_model_weights(tmp_path):
+    accelerator = _fresh_accelerator()
+    model = accelerator.prepare(RegressionModel(a=5, b=6))
+    accelerator.save_model(model, str(tmp_path / "m"))
+    files = os.listdir(tmp_path / "m")
+    assert any(f.startswith("model") for f in files)
+    from accelerate_tpu.checkpointing import load_array_dict
+
+    flat = load_array_dict(str(tmp_path / "m" / "model"))
+    assert float(flat["a"]) == 5.0

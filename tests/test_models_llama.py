@@ -1,0 +1,79 @@
+"""Flagship model: forward shape/loss sanity + sharded training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, MeshPlugin
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+
+def _batch(b=8, s=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(b, s)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids.copy(), "attention_mask": np.ones((b, s), np.int32)}
+
+
+def test_llama_forward_shapes():
+    config = LlamaConfig.tiny()
+    model = LlamaForCausalLM.from_config(config)
+    batch = _batch()
+    out = model.apply_fn(model.params, **{k: jnp.asarray(v) for k, v in batch.items()})
+    assert out.logits.shape == (8, 32, 256)
+    assert out.loss.shape == ()
+    assert np.isfinite(float(out.loss))
+    # random model ≈ uniform: loss ≈ ln(vocab)
+    assert abs(float(out.loss) - np.log(256)) < 1.0
+
+
+def test_llama_trains_under_accelerator_with_tp_fsdp_mesh():
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(
+        mesh_plugin=MeshPlugin(dp=2, fsdp=2, tp=2),
+        fsdp_plugin=FullyShardedDataParallelPlugin(),
+    )
+    config = LlamaConfig.tiny()
+    model = LlamaForCausalLM.from_config(config)
+    tx = optax.adamw(1e-3)
+    model, opt = accelerator.prepare(model, tx)
+
+    # params actually sharded: wq [L, h, nh*hd] → P(None, fsdp, tp)
+    wq = model.params["layers"]["wq"]
+    assert wq.sharding.spec == jax.P(None, "fsdp", "tp")
+
+    from accelerate_tpu.mesh import data_sharding
+
+    sharding = data_sharding(accelerator.mesh)
+    batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in _batch().items()}
+    losses = []
+    for _ in range(5):
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(out.loss.item())
+    assert losses[-1] < losses[0]  # memorising a fixed batch
+
+
+def test_llama_tiny_matches_replicated_vs_sharded():
+    """Same init, same batch: loss on a dp=8 mesh equals single-logical-device
+    computation (GSPMD correctness check)."""
+    config = LlamaConfig.tiny(layers=1, hidden_size=32, heads=2)
+    model = LlamaForCausalLM.from_config(config, seed=3)
+    batch = {k: jnp.asarray(v) for k, v in _batch(b=8, s=16).items()}
+    loss_plain = float(model.apply_fn(model.params, **batch).loss)
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=-1))
+    prepared = accelerator.prepare(LlamaForCausalLM.from_config(config, seed=3))
+    from accelerate_tpu.mesh import data_sharding
+
+    sharding = data_sharding(accelerator.mesh)
+    sharded_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    loss_sharded = prepared(**sharded_batch).loss.item()
+    np.testing.assert_allclose(loss_sharded, loss_plain, rtol=2e-5)

@@ -1,0 +1,82 @@
+"""State singleton behaviour (reference analog: tests over state.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import DistributedType, MeshPlugin
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.initialized
+    assert a.num_processes == 1  # single host
+    assert a.process_index == 0
+    assert a.is_main_process and a.is_local_main_process and a.is_last_process
+
+
+def test_mesh_built_over_8_cpu_devices():
+    state = PartialState()
+    assert state.num_devices == 8
+    assert state.distributed_type == DistributedType.CPU_MESH
+    assert dict(state.mesh.shape) == {"dp": 8, "fsdp": 1, "ep": 1, "cp": 1, "tp": 1}
+    assert state.data_parallel_size == 8
+
+
+def test_mesh_plugin_shapes():
+    state = PartialState(mesh_plugin=MeshPlugin(dp=-1, fsdp=2, tp=2))
+    assert dict(state.mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "cp": 1, "tp": 2}
+
+
+def test_mesh_plugin_invalid_shape():
+    with pytest.raises(ValueError):
+        MeshPlugin(dp=3, tp=2).axis_sizes(8)
+    with pytest.raises(ValueError):
+        MeshPlugin(dp=-1, tp=-1).axis_sizes(8)
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as x:
+        assert x == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def fn(v):
+        calls.append(v)
+        return v
+
+    fn(1)
+    assert calls == [1]
+
+
+def test_accelerator_state_precision_conflict():
+    AcceleratorState(mixed_precision="bf16", _from_accelerator=True)
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_accelerator_state_delegates_partial():
+    s = AcceleratorState(mixed_precision="no", _from_accelerator=True)
+    assert s.num_processes == 1
+    assert s.mesh.size == 8
+    assert s.mixed_precision == "no"
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert gs.remainder == -1
+    assert not gs.end_of_dataloader
+
+
+def test_wait_for_everyone_noop_single_host():
+    PartialState().wait_for_everyone()  # must not raise
