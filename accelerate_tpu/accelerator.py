@@ -54,6 +54,46 @@ from .utils.dataclasses import (
 logger = get_logger(__name__)
 
 
+class _PendingNorm:
+    """Return value of a fused-path clip: the true pre-clip norm, resolved
+    after the fused step ran (or by flushing to the split path on demand)."""
+
+    def __init__(self, accelerator, opt):
+        self._accelerator = accelerator
+        self._opt = opt
+
+    def _resolve(self):
+        if self._opt._last_norm is not None:
+            return self._opt._last_norm
+        if self._opt._pending_loss is not None:
+            self._accelerator._flush_pending(self._opt)  # sets _last_norm via clip
+        return self._opt._last_norm if self._opt._last_norm is not None else jnp.asarray(0.0)
+
+    def item(self):
+        return float(np.asarray(self._resolve()))
+
+    def __float__(self):
+        return self.item()
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._resolve(), dtype=dtype)
+
+    def __lt__(self, o): return self.item() < o
+    def __le__(self, o): return self.item() <= o
+    def __gt__(self, o): return self.item() > o
+    def __ge__(self, o): return self.item() >= o
+    def __add__(self, o): return self.item() + o
+    def __radd__(self, o): return o + self.item()
+    def __mul__(self, o): return self.item() * o
+    def __rmul__(self, o): return o * self.item()
+    def __truediv__(self, o): return self.item() / o
+    def __sub__(self, o): return self.item() - o
+    def __rsub__(self, o): return o - self.item()
+
+    def __repr__(self):
+        return f"PendingNorm({self._opt._last_norm})"
+
+
 class Accelerator:
     """Create once, ``prepare()`` your objects, train (reference
     ``Accelerator`` class ``accelerator.py:162``)."""
@@ -399,15 +439,50 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def backward(self, loss, **kwargs):
-        """Compute gradients of a deferred loss and accumulate them into the
-        bound optimizers (reference ``backward`` ``accelerator.py:2218``:
-        scales by 1/accumulation steps :2240)."""
+        """Stage gradients of a deferred loss (reference ``backward``
+        ``accelerator.py:2218``; 1/accumulation-steps scaling :2240).
+
+        Fast path: in the common case (single bound optimizer, no
+        accumulation in flight) nothing executes here — the loss graph is
+        parked on the optimizer and ``opt.step()`` runs ONE donated compiled
+        function doing forward+backward+clip+update, same cost as a
+        hand-fused pjit step. Anything that breaks fusion (accumulation,
+        multiple models, forcing the loss early) falls back to the split
+        grad path transparently."""
         if not isinstance(loss, Deferred):
             raise TypeError(
                 "backward() expects the deferred loss produced by a prepared "
                 "model call; got a concrete value. Compute the loss from "
                 "model outputs (e.g. model(**batch).loss)."
             )
+        opt = self._fusable_optimizer(loss)
+        if opt is not None:
+            if opt._pending_loss is not None:
+                self._flush_pending(opt)
+            if opt._grads is None:  # may have been set by the flush above
+                opt._pending_loss = loss
+                opt._pending_clip = None
+                opt._last_norm = None  # a stale norm must not satisfy _PendingNorm
+                object.__setattr__(loss, "_pre_force_hook", lambda: self._flush_pending(opt))
+                return
+        self._backward_split(loss)
+
+    def _fusable_optimizer(self, loss):
+        """The single optimizer eligible for the fused step, or None."""
+        if self.gradient_accumulation_steps != 1 or not self.gradient_state.sync_gradients:
+            return None
+        bound = [o for o in self._optimizers if o.model is not None]
+        if len(bound) != 1 or bound[0]._grads is not None:
+            return None
+        from .lazy import linearize
+
+        _, _, models = linearize(loss._node)
+        if bound[0].model not in models:
+            return None  # loss doesn't touch this model: split path degrades gracefully
+        return bound[0]
+
+    def _backward_split(self, loss):
+        """Split path: compute grads now, accumulate into optimizers."""
         scale = float(self.gradient_accumulation_steps)
         if self._loss_scale is not None:
             scale = scale / self._loss_scale  # fp16: scale loss UP by _loss_scale
@@ -427,6 +502,21 @@ class Accelerator:
                 # optimizer-less model: grads exposed via PreparedModel.grads
                 # for manual updates (reference analog: .grad on parameters)
                 model.accumulate_grads(g)
+
+    def _flush_pending(self, opt):
+        """Demote a parked fused loss to the split path (the user forced the
+        loss, clipped with an immediate-norm need, or issued a second
+        backward before stepping)."""
+        loss = opt._pending_loss
+        if loss is None:
+            return
+        opt._pending_loss = None
+        pending_clip = opt._pending_clip
+        opt._pending_clip = None
+        object.__setattr__(loss, "_pre_force_hook", None)
+        self._backward_split(loss)
+        if pending_clip is not None:
+            self.clip_grad_norm_(opt, pending_clip)
 
     def _optimizer_for(self, model) -> AcceleratedOptimizer | None:
         for opt in self._optimizers:
@@ -499,7 +589,18 @@ class Accelerator:
         unscaled before clipping so both the clip and the returned norm are
         in true gradient units)."""
         opt = self._match_optimizer_for_parameters(parameters)
-        if opt is None or opt.grads is None:
+        if opt is None:
+            return jnp.asarray(0.0)
+        if opt._pending_loss is not None:
+            if opt._pending_clip is None:
+                # fused path: record the clip; the fused step applies it and
+                # the true pre-clip norm is available after step()
+                opt._pending_clip = float(max_norm)
+                return _PendingNorm(self, opt)
+            # a second clip before step(): fused supports one — demote so
+            # both clips apply sequentially like the split path
+            self._flush_pending(opt)
+        if opt.grads is None:
             return jnp.asarray(0.0)
         opt.unscale_gradients()
         clip = opt._jit_cache.get("clip_norm")
@@ -513,12 +614,17 @@ class Accelerator:
             opt._jit_cache["clip_norm"] = clip
         new_grads, norm = clip(opt._grads, float(max_norm))
         opt._grads = new_grads
+        opt._last_norm = norm
         return norm
 
     def clip_grad_value_(self, parameters, clip_value):
         """(Reference ``accelerator.py:2403``.)"""
         opt = self._match_optimizer_for_parameters(parameters)
-        if opt is None or opt.grads is None:
+        if opt is None:
+            return
+        if opt._pending_loss is not None:
+            self._flush_pending(opt)  # value-clip is not fused; use split path
+        if opt.grads is None:
             return
         opt.unscale_gradients()
         clip = opt._jit_cache.get("clip_value")

@@ -271,11 +271,24 @@ def _assign_input_indices(root: Node, leaf_idx_map: dict):
 class Deferred:
     """Lazy array/namespace proxy. Cheap to build; forcing compiles+runs."""
 
-    __slots__ = ("_node", "_forced")
+    __slots__ = ("_node", "_forced", "_pre_force_hook", "_children")
 
     def __init__(self, node: Node):
         object.__setattr__(self, "_node", node)
         object.__setattr__(self, "_forced", None)
+        object.__setattr__(self, "_pre_force_hook", None)
+        object.__setattr__(self, "_children", None)
+
+    def _child(self, key, build):
+        """Memoize derived proxies so ``out.loss`` is the SAME object on
+        every access — forced values and pending-step hooks must be shared."""
+        children = self._children
+        if children is None:
+            children = {}
+            object.__setattr__(self, "_children", children)
+        if key not in children:
+            children[key] = build()
+        return children[key]
 
     # -- graph builders ------------------------------------------------------
 
@@ -325,12 +338,16 @@ class Deferred:
             hash(key)
         except TypeError:
             key = tuple(key)
-        return Deferred(Node("getitem", (self._node,), (key,)))
+        return self._child(
+            ("getitem", key), lambda: Deferred(Node("getitem", (self._node,), (key,)))
+        )
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return Deferred(Node("getattr", (self._node,), (name,)))
+        return self._child(
+            ("getattr", name), lambda: Deferred(Node("getattr", (self._node,), (name,)))
+        )
 
     # -- forcing -------------------------------------------------------------
 
@@ -340,6 +357,12 @@ class Deferred:
     def force(self):
         if self._forced is not None:
             return self._forced
+        if self._pre_force_hook is not None:
+            hook = self._pre_force_hook
+            object.__setattr__(self, "_pre_force_hook", None)
+            hook()  # e.g. flush a pending fused backward, which sets _forced
+            if self._forced is not None:
+                return self._forced
         value = force_value(self)
         self._set_forced(value)
         return value
@@ -394,6 +417,7 @@ _GRAD_CACHE: dict = {}
 def clear_caches():
     _FORCE_CACHE.clear()
     _GRAD_CACHE.clear()
+    _FUSED_CACHE.clear()
 
 
 def force_value(deferred: Deferred):
@@ -444,3 +468,82 @@ def grad_fn_for(loss: Deferred, trainable_models: list, loss_scale: float = 1.0)
         _GRAD_CACHE[key] = entry
     jitted, trainables, frozen = entry
     return jitted, trainables, frozen, inputs
+
+
+_FUSED_CACHE: dict = {}
+
+
+def fused_step_fn_for(
+    loss: Deferred,
+    model,
+    tx,
+    *,
+    clip_norm: bool = False,
+    grad_scaler: float | None = None,
+):
+    loss_scale = 1.0  # fusion only engages without accumulation in flight
+    """One donated, jitted train step for the common single-model loop:
+    forward + backward + (unscale) + (clip) + optimizer update. This is the
+    fast path `backward()`/`step()` take when nothing forces a split
+    (no accumulation in flight, single bound optimizer) — it makes the
+    compat loop cost what a hand-fused pjit step costs.
+
+    Returns (jitted, frozen_models, inputs). jitted signature:
+      (params, opt_state, frozen_params, inputs, max_norm)
+        -> (new_params, new_opt_state, loss, grad_norm, step_ok)
+    ``step_ok`` is False when fp16 grads were non-finite (update skipped).
+    """
+    import optax
+
+    root = loss._node
+    sig, inputs, models = linearize(root)
+    if model not in models:
+        raise ValueError("the pending loss does not involve the optimizer's model")
+    frozen = [m for m in models if m is not model]
+    key = (sig, id(model), id(tx), tuple(id(m) for m in frozen), loss_scale, clip_norm,
+           grad_scaler)
+    entry = _FUSED_CACHE.get(key)
+    if entry is None:
+        def loss_fn(params, frozen_params, input_values):
+            env = {id(model): params}
+            env.update({id(m): p for m, p in zip(frozen, frozen_params)})
+            out = jnp.asarray(replay(root, input_values, env))
+            if out.ndim != 0:
+                raise ValueError(
+                    f"backward() needs a scalar loss; got shape {out.shape}."
+                )
+            unscaled = out.astype(jnp.float32)
+            scaled = unscaled / loss_scale
+            if grad_scaler is not None:
+                scaled = scaled * grad_scaler  # fp16: scale up against underflow
+            return scaled, unscaled
+
+        def step(params, opt_state, frozen_params, input_values, max_norm):
+            (_, loss_value), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, frozen_params, input_values
+            )
+            step_ok = jnp.bool_(True)
+            if grad_scaler is not None:
+                inv = 1.0 / grad_scaler
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                finite = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                step_ok = jnp.all(jnp.stack(finite))
+            norm = optax.global_norm(grads)
+            if clip_norm:
+                factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # fp16 non-finite: keep old state (structure-preserving select)
+            if grad_scaler is not None:
+                keep = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(step_ok, a, b), new, old
+                )
+                new_params = keep(new_params, params)
+                new_opt_state = keep(new_opt_state, opt_state)
+            return new_params, new_opt_state, loss_value, norm, step_ok
+
+        entry = (jax.jit(step, donate_argnums=(0, 1)), frozen)
+        _FUSED_CACHE[key] = entry
+    jitted, frozen = entry
+    return jitted, frozen, inputs

@@ -43,6 +43,11 @@ class AcceleratedOptimizer:
         self._accumulated_steps = 0
         self._step_was_skipped = False
         self._jit_cache: dict[str, Any] = {}
+        # fused fast path (set by Accelerator.backward / clip_grad_norm_)
+        self._pending_loss = None
+        self._pending_clip: float | None = None
+        self._last_norm = None
+        self._step_ok_device = None  # fp16: lazily-fetched finite flag
 
     # -- initialisation (called by Accelerator.prepare) ----------------------
 
@@ -76,6 +81,10 @@ class AcceleratedOptimizer:
 
     @property
     def grads(self):
+        if self._grads is None and self._pending_loss is not None:
+            # forcing the parked loss flushes the fused step to the split
+            # path (its _pre_force_hook), which materialises the grads
+            self._pending_loss.force()
         return self._grads
 
     def zero_grad(self, set_to_none: bool = True):
@@ -127,10 +136,44 @@ class AcceleratedOptimizer:
         self._grads = unscale(self._grads, inv)
         self._grads_are_unscaled = True
 
+    def _fused_step(self):
+        """Run the single compiled forward+backward+clip+update step for the
+        parked loss (see Accelerator.backward's fast path)."""
+        from .lazy import fused_step_fn_for
+
+        loss = self._pending_loss
+        clip = self._pending_clip
+        self._pending_loss = None
+        self._pending_clip = None
+        object.__setattr__(loss, "_pre_force_hook", None)
+        jitted, frozen, inputs = fused_step_fn_for(
+            loss,
+            self.model,
+            self.optimizer,
+            clip_norm=clip is not None,
+            grad_scaler=self.scaler,
+        )
+        frozen_params = [m.params for m in frozen]
+        new_params, new_opt_state, loss_value, norm, step_ok = jitted(
+            self.model.params, self.opt_state, frozen_params, inputs,
+            clip if clip is not None else 0.0,
+        )
+        self.model.params = new_params
+        self.opt_state = new_opt_state
+        loss._set_forced(loss_value)
+        self._last_norm = norm
+        self._step_ok_device = step_ok if self.scaler is not None else None
+        self._step_was_skipped = False  # overridden lazily via step_was_skipped
+
     def step(self, closure=None):
         if not self.gradient_state.sync_gradients:
             self._step_was_skipped = False
+            self._step_ok_device = None
             return
+        if self._pending_loss is not None:
+            self._fused_step()
+            return
+        self._step_ok_device = None  # split path reports skips synchronously
         if self._grads is None:
             self._step_was_skipped = True
             return
@@ -154,7 +197,13 @@ class AcceleratedOptimizer:
 
     @property
     def step_was_skipped(self) -> bool:
-        """(Reference ``optimizer.py:200``.)"""
+        """(Reference ``optimizer.py:200``.) On the fused fp16 path the
+        finite-grads flag lives on device; fetched on first access."""
+        if self._step_ok_device is not None:
+            import numpy as np
+
+            self._step_was_skipped = not bool(np.asarray(self._step_ok_device))
+            self._step_ok_device = None
         return self._step_was_skipped
 
     # -- state dict -----------------------------------------------------------

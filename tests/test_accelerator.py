@@ -90,6 +90,7 @@ def test_gradient_accumulation_matches_full_batch():
     big = {"x": jax.device_put(jnp.asarray(x), shard), "y": jax.device_put(jnp.asarray(y), shard)}
     out = model1(**big)
     acc1.backward(out.loss)
+    out.loss.item()  # forces the parked fused step down the split path
     g_full = jax.device_get(opt1._grads)
 
     # accumulated microbatches on a fresh accelerator
@@ -180,6 +181,7 @@ def test_mixed_precision_bf16_keeps_fp32_params():
     batch = next(iter(dl))
     out = model(**batch)
     accelerator.backward(out.loss)
+    out.loss.item()  # flush the fused fast path so grads are inspectable
     assert opt._grads["a"].dtype == jnp.float32
     opt.step()
     assert model.params["a"].dtype == jnp.float32
@@ -272,3 +274,56 @@ def test_skip_first_batches_on_raw_loader():
     raw = _Loader(RegressionDataset(length=32), batch_size=8)
     skipped = accelerator.skip_first_batches(raw, 2)
     assert len(list(skipped)) == 2
+
+
+def test_fused_path_trains_and_matches_split():
+    """Fused backward+step must produce the same params as the split path."""
+    import jax
+
+    acc1, m1, o1, d1 = _make(lr=0.1)
+    batches = [b for b in d1]
+    for b in batches[:2]:
+        out = m1(**b)
+        acc1.backward(out.loss)
+        assert o1._pending_loss is not None  # fused path armed
+        o1.step()
+        o1.zero_grad()
+    fused_params = {k: np.asarray(v) for k, v in m1.params.items()}
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2, m2, o2, d2 = _make(lr=0.1)
+    for b in batches[:2]:
+        out = m2(**b)
+        acc2.backward(out.loss)
+        out.loss.item()  # force split path
+        o2.step()
+        o2.zero_grad()
+    split_params = {k: np.asarray(v) for k, v in m2.params.items()}
+    for k in fused_params:
+        np.testing.assert_allclose(fused_params[k], split_params[k], rtol=1e-6)
+
+
+def test_fused_path_with_clip_matches_split():
+    acc1, m1, o1, d1 = _make(lr=1.0)
+    batch = next(iter(d1))
+    out = m1(**batch)
+    acc1.backward(out.loss)
+    norm_pending = acc1.clip_grad_norm_(m1, max_norm=0.25)
+    o1.step()
+    fused_params = {k: float(np.asarray(v)) for k, v in m1.params.items()}
+    fused_norm = float(norm_pending)
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2, m2, o2, d2 = _make(lr=1.0)
+    batch2 = next(iter(d2))
+    out2 = m2(**batch2)
+    acc2.backward(out2.loss)
+    out2.loss.item()  # split
+    norm_split = float(acc2.clip_grad_norm_(m2, max_norm=0.25))
+    o2.step()
+    split_params = {k: float(np.asarray(v)) for k, v in m2.params.items()}
+    assert fused_norm == pytest.approx(norm_split, rel=1e-5)
+    for k in fused_params:
+        assert fused_params[k] == pytest.approx(split_params[k], rel=1e-5)
