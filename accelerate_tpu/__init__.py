@@ -35,4 +35,28 @@ def __getattr__(name):
         from . import modules
 
         return getattr(modules, name)
+    if name in (
+        "init_empty_weights",
+        "init_on_device",
+        "load_checkpoint_and_dispatch",
+        "load_checkpoint_in_model",
+        "dispatch_model",
+        "cpu_offload",
+        "disk_offload",
+    ):
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
+    if name in ("infer_auto_device_map", "get_balanced_memory", "get_max_memory"):
+        from .utils import modeling
+
+        return getattr(modeling, name)
+    if name == "find_executable_batch_size":
+        from .utils.memory import find_executable_batch_size
+
+        return find_executable_batch_size
+    if name == "skip_first_batches":
+        from .data_loader import skip_first_batches
+
+        return skip_first_batches
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -1,0 +1,496 @@
+"""Big-model inference: meta init, device-map dispatch, HBM↔host↔disk tiers.
+
+Reference: ``/root/reference/src/accelerate/big_modeling.py`` (637 LoC) +
+the ``AlignDevicesHook`` machinery (``hooks.py``). The torch design mutates
+``module.forward`` with pre/post hooks that move weights on and off the GPU
+(reference ``hooks.py:220-397``). The TPU-native design has no module
+mutation to hook — instead:
+
+* ``init_empty_weights`` → abstract params via ``jax.eval_shape``
+  (zero-RAM skeletons, reference ``big_modeling.py:58``);
+* a *device map* assigns param-tree prefixes to tiers — chip HBM, host
+  DRAM (numpy), disk (memmapped ``.dat`` via OffloadedWeightsLoader);
+* ``dispatch_model`` returns a model whose apply **streams** offloaded
+  segments through HBM with double buffering: ``jax.device_put`` of
+  segment i+1 is issued (async) before segment i computes, the per-layer
+  compiled fn is reused across layers, and consumed buffers are dropped —
+  the pipelined analog of the reference's pre/post-forward hook pair,
+  and the difference between the OPT-30B row being seconds vs minutes
+  per token (SURVEY §7 "disk-offload throughput").
+
+Models opt into streaming by exposing ``segments()`` (our model zoo does);
+anything else falls back to materialise-then-apply.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import get_logger
+from .modules import Model, ModelOutput
+from .utils.modeling import (
+    compute_module_sizes,
+    flat_param_shapes,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_state_dict_from_files,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict, save_offload_index
+
+logger = get_logger(__name__)
+
+_EMPTY_INIT = {"active": False, "include_buffers": True}
+
+
+@contextlib.contextmanager
+def init_empty_weights(include_buffers: bool = True):
+    """Initialise models as shape/dtype skeletons with zero memory
+    (reference ``init_empty_weights`` ``big_modeling.py:58``). Model
+    factories consult :func:`is_empty_init` and build params with
+    ``jax.eval_shape``."""
+    old = dict(_EMPTY_INIT)
+    _EMPTY_INIT.update(active=True, include_buffers=include_buffers)
+    try:
+        yield
+    finally:
+        _EMPTY_INIT.update(old)
+
+
+@contextlib.contextmanager
+def init_on_device(device):
+    """(Reference ``init_on_device`` ``big_modeling.py:94``.)"""
+    if device in ("meta", None):
+        with init_empty_weights():
+            yield
+        return
+    yield  # concrete init is already host-side; placement happens at prepare
+
+
+def is_empty_init() -> bool:
+    return _EMPTY_INIT["active"]
+
+
+def materialize_params(abstract_params, init_fn: Callable | None = None, seed: int = 0):
+    """Turn a ShapeDtypeStruct skeleton into concrete params — via the
+    model's init when available, else zeros (the reference's meta→empty
+    semantics: values are garbage until a checkpoint loads)."""
+    if init_fn is not None:
+        return init_fn(jax.random.PRNGKey(seed))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract_params)
+
+
+# ---------------------------------------------------------------------------
+# tier placement
+# ---------------------------------------------------------------------------
+
+
+_UNMAPPED = object()
+
+
+def _entry_for(path: str, device_map: Mapping[str, Any], default=_UNMAPPED):
+    """Longest-prefix device-map lookup for a dot path."""
+    probe = path
+    while True:
+        if probe in device_map:
+            return device_map[probe]
+        if "." not in probe:
+            break
+        probe = probe.rsplit(".", 1)[0]
+    if "" in device_map:
+        return device_map[""]
+    return default
+
+
+class TieredParams:
+    """The param pytree split across tiers, addressable by dot path.
+    ``resident_slices`` holds per-layer HBM slices of stacked leaves whose
+    layers straddle tiers (the OPT-30B shape: first N layers resident,
+    the rest streamed from host/disk)."""
+
+    def __init__(
+        self,
+        resident,
+        host: dict,
+        disk_index: Mapping | None,
+        offload_dir: str | None,
+        resident_slices: dict | None = None,
+        host_slices: dict | None = None,
+        stack_layouts: dict | None = None,
+    ):
+        self.resident = resident  # {path: jax.Array} fully-resident leaves
+        self.host = host  # {path: np.ndarray}
+        self.disk = (
+            OffloadedWeightsLoader(save_folder=offload_dir) if disk_index is not None else None
+        )
+        self.resident_slices = resident_slices or {}  # {(path, layer): jax.Array}
+        self.host_slices = host_slices or {}  # {(path, layer): np.ndarray}
+        self.stack_layouts = stack_layouts or {}  # {path: [tier per layer]}
+
+    def fetch_host_or_disk(self, path: str, idx: int | None = None):
+        if idx is not None:
+            if (path, idx) in self.host_slices:
+                return self.host_slices[(path, idx)]
+            if self.disk is not None and f"{path}.{idx}" in self.disk:
+                return self.disk[f"{path}.{idx}"]
+        if path in self.host:
+            value = self.host[path]
+            return value if idx is None else value[idx]
+        if self.disk is not None and path in self.disk:
+            value = self.disk[path]
+            return value if idx is None else value[idx]
+        raise KeyError((path, idx))
+
+
+def dispatch_model(
+    model: Model,
+    device_map: Mapping[str, Any],
+    main_device=None,
+    state_dict: Mapping | None = None,
+    offload_dir: str | None = None,
+    offload_buffers: bool = False,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+):
+    """Place a model's params per ``device_map`` and return a
+    :class:`DispatchedModel` (reference ``dispatch_model``
+    ``big_modeling.py:307``)."""
+    params = model.params
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = ".".join(_ppart(p) for p in path)
+        flat[key] = leaf
+
+    stack_prefix = getattr(model, "stacked_params_prefix", None)
+    devices = jax.local_devices()
+    resident_paths, host_paths, disk_paths = [], [], []
+    slice_plans: dict[str, list] = {}  # path -> per-layer tiers (straddling stacks)
+    unmapped = []
+    for key in flat:
+        if stack_prefix and key.startswith(stack_prefix + "."):
+            # per-layer lookup: 'layers.wq' layer i probes 'layers.i.wq' (the
+            # expanded granularity auto maps use), falling back to the
+            # unexpanded 'layers.wq' entry
+            rest = key[len(stack_prefix) + 1 :]
+            n_layers = flat[key].shape[0]
+            whole = _entry_for(key, device_map, default=_UNMAPPED)
+            tiers = [
+                _entry_for(f"{stack_prefix}.{i}.{rest}", device_map, default=whole)
+                for i in range(n_layers)
+            ]
+            if any(t is _UNMAPPED for t in tiers):
+                unmapped.append(key)
+                continue
+            if len(set(map(str, tiers))) > 1:
+                slice_plans[key] = tiers
+                continue
+            tier = tiers[0]
+        else:
+            tier = _entry_for(key, device_map)
+        if tier is _UNMAPPED:
+            unmapped.append(key)
+        elif tier == "cpu":
+            host_paths.append(key)
+        elif tier == "disk":
+            disk_paths.append(key)
+        else:
+            resident_paths.append((key, tier))
+    if unmapped:
+        raise ValueError(
+            f"device_map does not cover {len(unmapped)} parameters "
+            f"(e.g. {unmapped[:3]}); add entries or a '' catch-all"
+        )
+
+    # HBM-resident leaves
+    def _resident(key, leaf, tier):
+        dev = devices[int(tier)] if not isinstance(tier, str) else devices[0]
+        value = leaf
+        if isinstance(value, jax.ShapeDtypeStruct):
+            value = jnp.zeros(value.shape, value.dtype)
+        return jax.device_put(value, dev)
+
+    resident_map = {k: _resident(k, flat[k], t) for k, t in resident_paths}
+
+    def _host_value(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return np.zeros(leaf.shape, _np_dtype(leaf.dtype))
+        return np.asarray(jax.device_get(leaf))
+
+    host_map = {k: _host_value(flat[k]) for k in host_paths}
+
+    # straddling stacks: each layer goes to exactly one tier — chip slices
+    # become resident, cpu slices stay as per-layer host arrays, disk slices
+    # are written as individual '<path>.<i>' entries. No full-stack copy is
+    # retained anywhere.
+    resident_slices = {}
+    host_slices = {}
+    to_disk = {}
+    stack_layouts = {}
+    for k, tiers in slice_plans.items():
+        value = _host_value(flat[k])
+        stack_layouts[k] = list(tiers)
+        for i, tier in enumerate(tiers):
+            if tier == "cpu":
+                host_slices[(k, i)] = np.ascontiguousarray(value[i])
+            elif tier == "disk":
+                to_disk[f"{k}.{i}"] = np.ascontiguousarray(value[i])
+            else:
+                resident_slices[(k, i)] = jax.device_put(value[i], devices[int(tier)])
+        del value
+
+    if disk_paths or to_disk:
+        if offload_dir is None:
+            raise ValueError("device_map sends weights to 'disk' but no offload_dir given")
+        for k in disk_paths:
+            to_disk[k] = _host_value(flat[k])
+        disk_index = offload_state_dict(offload_dir, to_disk)
+    else:
+        disk_index = None
+
+    tiered = TieredParams(
+        resident_map, host_map, disk_index, offload_dir, resident_slices,
+        host_slices=host_slices, stack_layouts=stack_layouts,
+    )
+    return DispatchedModel(model, tiered, device_map)
+
+
+def _np_dtype(dtype):
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+
+
+def _ppart(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(getattr(p, "name", p))
+
+
+class DispatchedModel:
+    """Callable model over tiered params. With a cooperating model
+    (``model.segments``) execution streams segment-by-segment with
+    double-buffered H2D copies; otherwise offloaded leaves are materialised
+    for the duration of one call."""
+
+    def __init__(self, model: Model, tiered: TieredParams, device_map):
+        self._model = model
+        self.tiered = tiered
+        self.hf_device_map = dict(device_map)  # reference-compatible attr name
+        self._jit_apply = None
+        self._segment_fns: dict[str, Any] = {}
+
+    # -- generic path --------------------------------------------------------
+
+    def _materialize_full(self):
+        flat = {}
+        flat.update(self.tiered.resident)
+        for k in self.tiered.host:
+            flat[k] = jax.device_put(self.tiered.host[k])
+        if self.tiered.disk is not None:
+            for k in self.tiered.disk:
+                if "." in k and k.rsplit(".", 1)[0] in self.tiered.stack_layouts:
+                    continue  # per-layer slice; reassembled below
+                flat[k] = jax.device_put(np.asarray(self.tiered.disk[k]))
+        for k, tiers in self.tiered.stack_layouts.items():
+            layers = []
+            for i in range(len(tiers)):
+                if (k, i) in self.tiered.resident_slices:
+                    layers.append(self.tiered.resident_slices[(k, i)])
+                else:
+                    layers.append(jax.device_put(np.asarray(self.tiered.fetch_host_or_disk(k, i))))
+            flat[k] = jnp.stack(layers)
+        return _unflatten_by_paths(self._model.params, flat)
+
+    def __call__(self, *args, **kwargs):
+        segments = getattr(self._model, "segments", None)
+        if segments is not None:
+            return self._call_streaming(segments, *args, **kwargs)
+        params = self._materialize_full()
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(self._model.apply_fn)
+        return self._jit_apply(params, *args, **kwargs)
+
+    # -- streaming path ------------------------------------------------------
+
+    def _segment_params(self, seg_name, paths):
+        """Device arrays for one segment; offloaded leaves H2D-copied
+        (async). A ``(path, i)`` entry addresses layer i of a stacked leaf —
+        for host/disk tiers this slices the numpy/memmap view, so one layer's
+        bytes move, not the whole stack."""
+        out = {}
+        for entry in paths:
+            p, idx = entry if isinstance(entry, tuple) else (entry, None)
+            if idx is not None and (p, idx) in self.tiered.resident_slices:
+                out[p] = self.tiered.resident_slices[(p, idx)]
+            elif p in self.tiered.resident:
+                value = self.tiered.resident[p]
+                out[p] = value if idx is None else value[idx]
+            else:
+                host_value = self.tiered.fetch_host_or_disk(p, idx)
+                out[p] = jax.device_put(np.asarray(host_value))
+        return out
+
+    def _call_streaming(self, segments, *args, **kwargs):
+        """segments: list of (name, param_paths, fn) where
+        ``fn(params_dict, carry) -> carry``; first carry built from inputs,
+        last carry is the output. Copies for segment i+1 are issued before
+        segment i's compute is awaited (double buffering)."""
+        plan = segments(*args, **kwargs) if callable(segments) else segments
+        steps = plan["steps"]
+        carry = plan["init"]()
+        prefetched = self._segment_params(*steps[0][:2]) if steps else {}
+        for i, (name, paths, fn) in enumerate(steps):
+            seg_params = prefetched
+            if i + 1 < len(steps):
+                prefetched = self._segment_params(*steps[i + 1][:2])  # async H2D ahead
+            key = name if isinstance(name, str) else name[0]
+            jit_fn = self._segment_fns.get(key)
+            if jit_fn is None:
+                jit_fn = jax.jit(fn)
+                self._segment_fns[key] = jit_fn
+            carry = jit_fn(seg_params, carry)
+        return plan["finalize"](carry)
+
+    # -- misc ----------------------------------------------------------------
+
+    @property
+    def params(self):
+        return self._materialize_full()
+
+    def unwrap(self):
+        return self._model
+
+
+def _unflatten_by_paths(template, flat: dict):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, _ in paths:
+        key = ".".join(_ppart(p) for p in path)
+        leaves.append(flat[key])
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+# ---------------------------------------------------------------------------
+# convenience wrappers (reference API)
+# ---------------------------------------------------------------------------
+
+
+def cpu_offload(model: Model, execution_device=None, offload_buffers=False, state_dict=None):
+    """All weights on host, streamed per segment (reference ``cpu_offload``
+    ``big_modeling.py:171``)."""
+    return dispatch_model(model, {"": "cpu"})
+
+
+def disk_offload(model: Model, offload_dir: str, execution_device=None, offload_buffers=False):
+    """(Reference ``disk_offload`` ``big_modeling.py:261``.)"""
+    return dispatch_model(model, {"": "disk"}, offload_dir=offload_dir)
+
+
+def load_checkpoint_in_model(
+    model: Model,
+    checkpoint: str,
+    device_map: Mapping | None = None,
+    offload_folder: str | None = None,
+    dtype=None,
+    offload_state_dict_flag: bool = False,
+    strict: bool = False,
+    key_map: Callable[[dict], dict] | None = None,
+):
+    """Load a (possibly sharded, possibly torch-format) checkpoint into the
+    model's params (reference ``load_checkpoint_in_model``
+    ``utils/modeling.py:1796``). ``key_map`` converts foreign naming (e.g.
+    HF transformers llama names) into this model's paths — the model zoo
+    provides converters."""
+    flat_ckpt = load_state_dict_from_files(checkpoint)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(model.params)
+    native_keys = {".".join(_ppart(p) for p in path) for path, _ in paths}
+    # only run the foreign-name converter when the checkpoint isn't already
+    # in this model's native naming
+    if len(native_keys & set(flat_ckpt)) < max(1, len(native_keys) // 2):
+        if key_map is None:
+            key_map = getattr(model, "convert_state_dict", None)
+        if key_map is not None:
+            flat_ckpt = key_map(flat_ckpt)
+    # With a device_map in play, loaded values stay HOST-side (numpy): the
+    # model may exceed HBM and dispatch_model does the placement. Only a
+    # map-less load materialises on device.
+    keep_on_host = device_map is not None
+
+    def _materialise(value, target_dtype):
+        if keep_on_host:
+            return np.asarray(value).astype(_np_dtype(target_dtype), copy=False)
+        return jnp.asarray(np.asarray(value), dtype=target_dtype)
+
+    leaves = []
+    missing = []
+    for path, leaf in paths:
+        key = ".".join(_ppart(p) for p in path)
+        if key in flat_ckpt:
+            value = flat_ckpt[key]
+            target_dtype = dtype or getattr(leaf, "dtype", np.asarray(value).dtype)
+            leaves.append(_materialise(value, target_dtype))
+        else:
+            missing.append(key)
+            if strict:
+                raise KeyError(f"checkpoint missing {key}")
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                zeros = np.zeros(leaf.shape, _np_dtype(leaf.dtype))
+                leaves.append(zeros if keep_on_host else jnp.asarray(zeros))
+            else:
+                leaves.append(leaf)
+    if missing:
+        logger.warning(f"{len(missing)} params not found in checkpoint (e.g. {missing[:3]})")
+    model.params = jax.tree.unflatten(jax.tree.structure(model.params), leaves)
+    return model
+
+
+def load_checkpoint_and_dispatch(
+    model: Model,
+    checkpoint: str,
+    device_map: Mapping | str | None = None,
+    max_memory: Mapping | None = None,
+    no_split_module_classes=None,
+    offload_folder: str | None = None,
+    offload_buffers: bool = False,
+    dtype=None,
+    offload_state_dict_flag: bool | None = None,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+):
+    """(Reference ``load_checkpoint_and_dispatch`` ``big_modeling.py:508``.)"""
+    if isinstance(device_map, str):
+        # expand stacked layer dims so the map splits at layer granularity —
+        # dispatch_model probes the same 'layers.<i>.<name>' keys
+        shapes = flat_param_shapes(
+            model, expand_stacked=getattr(model, "stacked_params_prefix", None)
+        )
+        if device_map == "balanced":
+            max_memory = get_balanced_memory(
+                shapes, max_memory, no_split_module_classes, dtype=dtype
+            )
+        device_map = infer_auto_device_map(
+            shapes,
+            max_memory=max_memory,
+            no_split_module_classes=no_split_module_classes,
+            dtype=dtype,
+            tied_parameters=list(getattr(model, "tied_parameters", []) or []),
+        )
+    load_checkpoint_in_model(
+        model, checkpoint, device_map=device_map, offload_folder=offload_folder, dtype=dtype
+    )
+    if device_map is None:
+        return model
+    return dispatch_model(model, device_map, offload_dir=offload_folder)

@@ -127,31 +127,36 @@ def init_llama_params(key: jax.Array, config: LlamaConfig, dtype=jnp.float32):
     return params
 
 
-def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
-    """One transformer block as a scan body over stacked layer params."""
+def llama_layer_apply(config: LlamaConfig, layer, x, cos, sin, positions, attention_mask):
+    """One transformer block on UNstacked layer params — shared by the
+    training scan body and the streaming (offload) executor."""
     c = config
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    b, s, h = x.shape
+    # attention
+    y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+    q = (y @ layer["wq"]).reshape(b, s, nh, hd)
+    k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
+    v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
+    k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
+    attn = causal_attention(q, k, v, segment_mask=attention_mask)
+    x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    # mlp (SwiGLU)
+    y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+    gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
+    x = x + gated @ layer["w_down"]
+    return _constrain(x, P(("dp", "fsdp"), "cp", None))
+
+
+def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
+    """One transformer block as a scan body over stacked layer params."""
 
     def body(x, layer):
-        b, s, h = x.shape
-        # attention
-        y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = (y @ layer["wq"]).reshape(b, s, nh, hd)
-        k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
-        v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
-        q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
-        k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
-        attn = causal_attention(q, k, v, segment_mask=attention_mask)
-        x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
-        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
-        # mlp (SwiGLU)
-        y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
-        gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
-        x = x + gated @ layer["w_down"]
-        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
-        return x, None
+        return llama_layer_apply(config, layer, x, cos, sin, positions, attention_mask), None
 
     if config.remat:
         body = jax.checkpoint(body, prevent_cse=False)
@@ -203,13 +208,123 @@ def llama_apply(
     return out
 
 
+_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")
+
+
+def llama_segments(config: LlamaConfig):
+    """Streaming plan for :class:`accelerate_tpu.big_modeling.DispatchedModel`:
+    embed → L× layer (one compiled fn reused) → norm+head. Layer params are
+    addressed as ``("layers.wq", i)`` slices of the stacked leaves so
+    host/disk tiers stream one layer at a time."""
+
+    def plan(input_ids=None, attention_mask=None, positions=None, labels=None, **kw):
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        cos, sin = rope_frequencies(config.head_dim, config.max_position_embeddings, config.rope_theta)
+
+        def init():
+            return {
+                "ids": jnp.asarray(input_ids),
+                "mask": None if attention_mask is None else jnp.asarray(attention_mask),
+                "pos": positions,
+            }
+
+        def embed_fn(seg, carry):
+            x = seg["embed_tokens"][carry["ids"]]
+            return {**carry, "x": x}
+
+        def layer_fn(seg, carry):
+            layer = {k: seg[f"layers.{k}"] for k in _LAYER_KEYS}
+            x = llama_layer_apply(
+                config, layer, carry["x"], cos, sin, carry["pos"], carry["mask"]
+            )
+            return {**carry, "x": x}
+
+        def head_fn(seg, carry):
+            x = rms_norm(carry["x"], seg["norm"], config.rms_norm_eps)
+            head = seg.get("lm_head")
+            if head is None:
+                head = seg["embed_tokens"].T
+            return {**carry, "logits": x @ head}
+
+        steps = [("embed", ["embed_tokens"], embed_fn)]
+        for i in range(config.num_hidden_layers):
+            steps.append(
+                (("layer", i), [(f"layers.{k}", i) for k in _LAYER_KEYS], layer_fn)
+            )
+        head_paths = ["norm"] + ([] if config.tie_word_embeddings else ["lm_head"])
+        if config.tie_word_embeddings:
+            head_paths.append("embed_tokens")
+        steps.append(("head", head_paths, head_fn))
+
+        def finalize(carry):
+            out = ModelOutput(logits=carry["logits"])
+            if labels is not None:
+                out["loss"] = cross_entropy_loss(
+                    carry["logits"][:, :-1, :], jnp.asarray(labels)[:, 1:]
+                )
+            return out
+
+        return {"init": init, "steps": steps, "finalize": finalize}
+
+    return plan
+
+
+def convert_hf_llama_state_dict(flat: dict, config: LlamaConfig) -> dict:
+    """HF-transformers llama naming → this model's stacked layout.
+    torch ``nn.Linear`` stores ``[out, in]``; ours are ``[in, out]`` —
+    hence the transposes. Enables loading Llama-2 checkpoints directly
+    (reference users get this via transformers; SURVEY §7 pins keeping
+    torch-format checkpoint compatibility)."""
+    import numpy as np
+
+    L = config.num_hidden_layers
+
+    def get(name):
+        for prefix in ("model.", ""):
+            if prefix + name in flat:
+                return np.asarray(flat[prefix + name])
+        raise KeyError(name)
+
+    mapping = {
+        "wq": "self_attn.q_proj.weight",
+        "wk": "self_attn.k_proj.weight",
+        "wv": "self_attn.v_proj.weight",
+        "wo": "self_attn.o_proj.weight",
+        "w_gate": "mlp.gate_proj.weight",
+        "w_up": "mlp.up_proj.weight",
+        "w_down": "mlp.down_proj.weight",
+        "attn_norm": "input_layernorm.weight",
+        "mlp_norm": "post_attention_layernorm.weight",
+    }
+    out = {"embed_tokens": get("embed_tokens.weight"), "norm": get("norm.weight")}
+    for ours, theirs in mapping.items():
+        per_layer = [get(f"layers.{i}.{theirs}") for i in range(L)]
+        stacked = np.stack(per_layer)
+        if "norm" not in ours:
+            stacked = stacked.swapaxes(-1, -2)  # torch [out,in] → ours [in,out]
+        out[f"layers.{ours}"] = stacked
+    if not config.tie_word_embeddings:
+        out["lm_head"] = np.asarray(flat["lm_head.weight"]).T
+    return out
+
+
 class LlamaForCausalLM:
     """Factory mirroring the transformers entry point the reference's users
     bring to ``prepare()``."""
 
     @staticmethod
     def from_config(config: LlamaConfig, seed: int = 0, dtype=jnp.float32) -> Model:
-        params = init_llama_params(jax.random.PRNGKey(seed), config, dtype=dtype)
+        from ..big_modeling import is_empty_init
+
+        def make_params(key):
+            return init_llama_params(key, config, dtype=dtype)
+
+        if is_empty_init():
+            params = jax.eval_shape(make_params, jax.random.PRNGKey(seed))
+        else:
+            params = make_params(jax.random.PRNGKey(seed))
 
         def apply_fn(p, input_ids=None, attention_mask=None, labels=None, positions=None, **kw):
             return llama_apply(config, p, input_ids, attention_mask, labels, positions)
@@ -221,4 +336,9 @@ class LlamaForCausalLM:
             name="LlamaForCausalLM",
         )
         model.config = config
+        model.segments = llama_segments(config)
+        model.stacked_params_prefix = "layers"
+        model.convert_state_dict = lambda flat: convert_hf_llama_state_dict(flat, config)
+        # tied embeddings are a single leaf in this functional design (no
+        # separate lm_head param exists), so no tie group is declared
         return model

@@ -1,0 +1,83 @@
+"""OOM auto-retry utilities.
+
+Reference: ``/root/reference/src/accelerate/utils/memory.py`` (180 LoC) —
+``find_executable_batch_size`` :112 halves the batch size on CUDA OOM.
+On TPU the OOM signal is an ``XlaRuntimeError`` carrying
+``RESOURCE_EXHAUSTED`` (HBM) — same decorator contract here.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def release_memory(*objects):
+    """Drop references + compiled executables (reference ``release_memory``
+    ``utils/memory.py:63``)."""
+    import jax
+
+    objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    jax.clear_caches()
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """(Reference ``should_reduce_batch_size`` ``utils/memory.py:93``.)"""
+    message = str(exception)
+    return "RESOURCE_EXHAUSTED" in message or "Out of memory" in message or "OOM" in message
+
+
+def find_executable_batch_size(function=None, starting_batch_size: int = 128):
+    """Decorator: call ``function(batch_size, *args)`` halving ``batch_size``
+    on HBM exhaustion until it fits (reference ``utils/memory.py:112``)."""
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size = starting_batch_size
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        nonlocal batch_size
+        gc.collect()
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < 1 or params[0] != "batch_size":
+            raise TypeError(
+                f"{function.__name__} must take `batch_size` as its first argument"
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("no executable batch size found: reached zero")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    logger.info(
+                        f"batch size {batch_size} exhausted device memory; retrying with {batch_size // 2}"
+                    )
+                    release_memory()
+                    batch_size //= 2
+                else:
+                    raise
+
+    return wrapper
+
+
+def get_xla_memory_info(device=None) -> dict:
+    """Best-effort HBM stats (``memory_stats`` is optional per backend)."""
+    import jax
+
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    return stats

@@ -1,0 +1,392 @@
+"""Device-map inference & model-memory math.
+
+TPU-native port of the reference's ``utils/modeling.py`` (2147 LoC;
+``compute_module_sizes`` :704, ``get_max_memory`` :797, ``get_balanced_memory``
+:951, ``infer_auto_device_map`` :1303, ``load_checkpoint_in_model`` :1796,
+``find_tied_parameters`` :605). The math is backend-neutral arithmetic over
+a *module tree*; here a "module" is a dot-path prefix of the param pytree
+(``layers.wq`` …), and devices are memory tiers: TPU chips (``0..n-1``,
+HBM), ``"cpu"`` (host DRAM), ``"disk"``.
+
+For layer-stacked models (our scan-based transformers) a leading-dim layer
+stack like ``layers.wq [L, d, d]`` is treated as L per-layer submodules
+``layers.wq.0 … layers.wq.L-1`` so device maps can split at layer
+granularity exactly like the reference splits ``model.layers.N``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from .dataclasses import CustomDtype
+
+WEIGHTS_INDEX_NAME = "pytorch_model.bin.index.json"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+
+
+# ---------------------------------------------------------------------------
+# dtype sizes
+# ---------------------------------------------------------------------------
+
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element (reference ``dtype_byte_size`` — supports sub-byte
+    custom dtypes for quantized accounting, ``utils/modeling.py:139``)."""
+    if dtype in (CustomDtype.INT4, "int4"):
+        return 0.5
+    if dtype in (CustomDtype.INT2, "int2"):
+        return 0.25
+    if dtype in (CustomDtype.FP8, "fp8", "float8_e4m3fn", "float8_e5m2"):
+        return 1.0
+    dtype_str = str(dtype)
+    m = re.search(r"(\d+)", dtype_str.split(".")[-1])
+    if m is None:
+        if "bool" in dtype_str:
+            return 1.0
+        raise ValueError(f"cannot size dtype {dtype}")
+    return int(m.group(1)) / 8
+
+
+def named_module_tensors(
+    named_shapes: Mapping[str, tuple], prefix: str = ""
+) -> Iterable[tuple[str, tuple, Any]]:
+    for name, (shape, dtype) in named_shapes.items():
+        yield name, shape, dtype
+
+
+# ---------------------------------------------------------------------------
+# flat views of models
+# ---------------------------------------------------------------------------
+
+
+def flat_param_shapes(model_or_params, expand_stacked: str | None = None) -> dict[str, tuple]:
+    """``{dot.path: (shape, dtype)}`` for a Model/PreparedModel/params tree.
+
+    ``expand_stacked``: dot-path prefix (e.g. ``"layers"``) whose leaves have
+    a leading layer dim to be expanded into per-layer entries.
+    """
+    import jax
+
+    params = getattr(model_or_params, "params", model_or_params)
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = ".".join(_part(p) for p in path)
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
+        if expand_stacked and key.startswith(expand_stacked + ".") and len(shape) >= 1:
+            for i in range(shape[0]):
+                flat[f"{expand_stacked}.{i}.{key[len(expand_stacked) + 1:]}"] = (
+                    shape[1:],
+                    dtype,
+                )
+        else:
+            flat[key] = (shape, dtype)
+    return flat
+
+
+def _part(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# sizes
+# ---------------------------------------------------------------------------
+
+
+def compute_module_sizes(
+    named_shapes: Mapping[str, tuple],
+    dtype=None,
+    special_dtypes: Mapping[str, Any] | None = None,
+) -> dict[str, int]:
+    """Size in bytes of every module prefix (reference
+    ``compute_module_sizes`` ``utils/modeling.py:704``). ``dtype`` overrides
+    storage dtype (as when loading fp32 weights as bf16); ``special_dtypes``
+    per-tensor overrides (quantization)."""
+    sizes: dict[str, int] = defaultdict(int)
+    for name, (shape, tensor_dtype) in named_shapes.items():
+        if special_dtypes and name in special_dtypes:
+            size = int(np.prod(shape, dtype=np.int64) * dtype_byte_size(special_dtypes[name])) if shape else 1
+        else:
+            use = dtype if dtype is not None else tensor_dtype
+            size = int(np.prod(shape, dtype=np.int64) * dtype_byte_size(use)) if shape else int(dtype_byte_size(use))
+        parts = name.split(".")
+        for i in range(len(parts) + 1):
+            sizes[".".join(parts[:i])] += size
+    return dict(sizes)
+
+
+def compute_module_total_buffer_size(named_shapes, dtype=None) -> int:
+    return compute_module_sizes(named_shapes, dtype=dtype).get("", 0)
+
+
+# ---------------------------------------------------------------------------
+# memory probing
+# ---------------------------------------------------------------------------
+
+#: default per-chip HBM when the runtime doesn't report it (v5e = 16 GiB)
+DEFAULT_TPU_HBM_BYTES = 16 * 2**30
+
+
+def get_max_memory(max_memory: Mapping | None = None) -> dict:
+    """{device: usable bytes} over TPU chips + cpu + disk (reference
+    ``get_max_memory`` ``utils/modeling.py:797``; takes ~90% of reported
+    capacity as usable)."""
+    if max_memory is not None:
+        return {k: _to_bytes(v) for k, v in max_memory.items()}
+    import jax
+
+    out: dict = {}
+    for i, dev in enumerate(jax.local_devices()):
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            pass
+        if stats and stats.get("bytes_limit"):
+            out[i] = int(stats["bytes_limit"] * 0.9)
+        else:
+            out[i] = int(DEFAULT_TPU_HBM_BYTES * 0.9)
+    try:
+        import psutil
+
+        out["cpu"] = int(psutil.virtual_memory().available * 0.9)
+    except Exception:
+        try:
+            out["cpu"] = int(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_AVPHYS_PAGES") * 0.9)
+        except Exception:
+            out["cpu"] = 16 * 2**30
+    out["disk"] = float("inf")
+    return out
+
+
+def _to_bytes(v) -> int | float:
+    if isinstance(v, (int, float)):
+        return v
+    s = str(v).upper().replace(" ", "")
+    for unit, mul in (("GIB", 2**30), ("MIB", 2**20), ("KIB", 2**10), ("GB", 10**9), ("MB", 10**6), ("KB", 10**3)):
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)]) * mul)
+    return int(float(s))
+
+
+# ---------------------------------------------------------------------------
+# tied params
+# ---------------------------------------------------------------------------
+
+
+def find_tied_parameters(model) -> list[list[str]]:
+    """Groups of names sharing storage. In the functional world ties are
+    explicit — a model declares them via ``model.tied_parameters`` (e.g.
+    ``[["embed_tokens", "lm_head"]]`` for tied embeddings). (Reference
+    discovers them by object identity, ``utils/modeling.py:605``.)"""
+    return list(getattr(model, "tied_parameters", []) or [])
+
+
+# ---------------------------------------------------------------------------
+# device-map inference
+# ---------------------------------------------------------------------------
+
+
+def _module_children(named_shapes: Mapping[str, tuple], prefix: str) -> list[str]:
+    """Direct child module names under a prefix."""
+    seen = []
+    plen = len(prefix) + 1 if prefix else 0
+    for name in named_shapes:
+        if prefix and not name.startswith(prefix + "."):
+            continue
+        rest = name[plen:]
+        child = rest.split(".")[0]
+        full = f"{prefix}.{child}" if prefix else child
+        if full not in seen:
+            seen.append(full)
+    return seen
+
+
+def infer_auto_device_map(
+    named_shapes: Mapping[str, tuple],
+    max_memory: Mapping | None = None,
+    no_split_module_classes: list[str] | None = None,
+    dtype=None,
+    special_dtypes: Mapping[str, Any] | None = None,
+    tied_parameters: list[list[str]] | None = None,
+    clean_result: bool = True,
+    no_split_prefixes: list[str] | None = None,
+) -> dict[str, Any]:
+    """Greedy first-fit placement of modules onto memory tiers in order
+    (chips → cpu → disk), keeping no-split units whole and tied weights on
+    one tier (reference ``infer_auto_device_map`` ``utils/modeling.py:1303``).
+
+    ``no_split_prefixes`` is the TPU-native spelling of
+    ``no_split_module_classes``: dot-path prefixes (regexes allowed) that
+    must land on a single tier — e.g. ``layers.\\d+`` keeps each transformer
+    layer whole.
+    """
+    max_memory = get_max_memory(max_memory)
+    no_split = list(no_split_prefixes or []) + list(no_split_module_classes or [])
+    sizes = compute_module_sizes(named_shapes, dtype=dtype, special_dtypes=special_dtypes)
+    tied_groups = tied_parameters or []
+
+    devices = [d for d in max_memory if max_memory[d] > 0]
+    # order: numeric chips first, then cpu, then disk
+    devices.sort(key=lambda d: (isinstance(d, str), str(d) == "disk", str(d)))
+
+    device_map: dict[str, Any] = {}
+    remaining = {d: max_memory[d] for d in devices}
+
+    def is_no_split(name: str) -> bool:
+        return any(re.fullmatch(pat, name) for pat in no_split)
+
+    def tied_to(name: str) -> list[str]:
+        out = []
+        for group in tied_groups:
+            if name in group:
+                out.extend(g for g in group if g != name)
+        return out
+
+    # walk: BFS that splits modules unless marked no-split / leaf
+    queue = _module_children(named_shapes, "")
+    dev_idx = 0
+    while queue:
+        name = queue.pop(0)
+        if name in device_map:  # already placed as a tied companion
+            continue
+        size = sizes.get(name, 0)
+        # tied companions must fit with the module
+        companions = [c for c in tied_to(name) if c not in device_map]
+        total = size + sum(sizes.get(c, 0) for c in companions)
+        placed = False
+        while dev_idx < len(devices):
+            device = devices[dev_idx]
+            if total <= remaining[device]:
+                device_map[name] = device
+                remaining[device] -= total
+                for c in companions:
+                    device_map[c] = device
+                placed = True
+                break
+            # doesn't fit: split if allowed, else advance to the next tier
+            children = [] if is_no_split(name) else _module_children(named_shapes, name)
+            children = [c for c in children if c != name]
+            if children and not (len(children) == 1 and children[0] == name):
+                queue = children + queue
+                placed = True
+                break
+            dev_idx += 1
+        if not placed:
+            raise ValueError(
+                f"module {name!r} ({total} bytes) does not fit on any device tier"
+            )
+
+    if clean_result:
+        device_map = clean_device_map(device_map)
+    return device_map
+
+
+def clean_device_map(device_map: dict[str, Any], module_name: str = "") -> dict[str, Any]:
+    """Collapse children that all share a device into their parent
+    (reference ``clean_device_map``)."""
+    prefix = module_name + "." if module_name else ""
+    values = [v for k, v in device_map.items() if k == module_name or k.startswith(prefix)]
+    if module_name and len(values) > 0 and len(set(map(str, values))) == 1:
+        for k in [k for k in device_map if k.startswith(prefix)]:
+            del device_map[k]
+        device_map[module_name] = values[0]
+        return device_map
+    children = {k.split(".")[0] if not module_name else module_name + "." + k[len(prefix):].split(".")[0]
+                for k in device_map if k != module_name and (not module_name or k.startswith(prefix))}
+    for child in sorted(children):
+        clean_device_map(device_map, child)
+    return device_map
+
+
+def get_balanced_memory(
+    named_shapes: Mapping[str, tuple],
+    max_memory: Mapping | None = None,
+    no_split_module_classes: list[str] | None = None,
+    dtype=None,
+    special_dtypes=None,
+    low_zero: bool = False,
+) -> dict:
+    """Even out per-chip budgets so layers spread across chips instead of
+    first-fit filling chip 0 (reference ``get_balanced_memory``
+    ``utils/modeling.py:951``). ``low_zero`` reserves chip 0 for activations
+    / generation state."""
+    user_max = max_memory is not None
+    max_memory = get_max_memory(max_memory)
+    chips = [d for d in max_memory if not isinstance(d, str)]
+    if len(chips) <= 1:
+        return max_memory
+    total_size = compute_module_sizes(named_shapes, dtype=dtype, special_dtypes=special_dtypes).get("", 0)
+    n = len(chips) - int(low_zero)
+    per_chip = total_size // n + total_size // (n * 10)  # +10% slack like the reference
+    out = dict(max_memory)
+    for d in chips:
+        cap = max_memory[d]
+        if low_zero and d == 0:
+            out[d] = min(cap, per_chip // 2) if not user_max else cap
+        else:
+            out[d] = min(cap, per_chip)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checkpoint reading (HF-format interop)
+# ---------------------------------------------------------------------------
+
+
+def load_state_dict_from_files(checkpoint_path: str) -> dict[str, np.ndarray]:
+    """Read a checkpoint directory/file into a flat numpy dict. Supports
+    sharded ``model.safetensors.index.json`` / ``pytorch_model.bin.index.json``
+    layouts and single files (reference ``load_checkpoint_in_model``
+    ``utils/modeling.py:1796`` keeps this reader; SURVEY §7 pins keeping
+    torch-format compatibility)."""
+    path = checkpoint_path
+    if os.path.isdir(path):
+        for index_name in (SAFE_WEIGHTS_INDEX_NAME, WEIGHTS_INDEX_NAME, "model.index.json"):
+            index_file = os.path.join(path, index_name)
+            if os.path.exists(index_file):
+                with open(index_file) as f:
+                    index = json.load(f)
+                out = {}
+                for shard in sorted(set(index["weight_map"].values())):
+                    out.update(_load_single_file(os.path.join(path, shard)))
+                return out
+        for candidate in ("model.safetensors", "pytorch_model.bin", "model.npz"):
+            p = os.path.join(path, candidate)
+            if os.path.exists(p):
+                return _load_single_file(p)
+        raise FileNotFoundError(f"no checkpoint found under {path}")
+    return _load_single_file(path)
+
+
+def _load_single_file(path: str) -> dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        try:
+            return load_file(path)
+        except Exception:
+            from safetensors.flax import load_file as load_flax
+
+            return {k: np.asarray(v) for k, v in load_flax(path).items()}
+    if path.endswith(".npz"):
+        data = np.load(path)
+        return {k: data[k] for k in data.files}
+    if path.endswith((".bin", ".pt", ".pth")):
+        import torch
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v) for k, v in sd.items()}
+    raise ValueError(f"unrecognised checkpoint format: {path}")

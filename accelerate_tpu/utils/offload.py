@@ -1,0 +1,145 @@
+"""Disk-offload weight store.
+
+Reference: ``/root/reference/src/accelerate/utils/offload.py`` (213 LoC) —
+memory-mapped ``.dat`` files + ``index.json``, a lazy Mapping over offloaded
+state-dict shards. Same on-disk contract here; values come back as numpy
+memmaps that feed ``jax.device_put`` streaming without a host copy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+_DTYPE_ALIASES = {"bfloat16": "bfloat16"}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: dict | None = None) -> dict:
+    """Write one tensor as ``<name>.dat`` + record it in the index
+    (reference ``offload_weight`` ``utils/offload.py:25``)."""
+    os.makedirs(offload_folder, exist_ok=True)
+    weight = np.asarray(weight)
+    dtype_name = str(weight.dtype)
+    array = weight
+    if dtype_name == "bfloat16":
+        # store raw bytes; recorded dtype restores the view on load
+        array = weight.view(np.uint16)
+    file_path = os.path.join(offload_folder, f"{weight_name}.dat")
+    mm = np.memmap(file_path, dtype=array.dtype, mode="w+", shape=array.shape or (1,))
+    mm[:] = array if array.shape else array.reshape(1)
+    mm.flush()
+    if index is not None:
+        index[weight_name] = {"dtype": dtype_name, "shape": list(weight.shape)}
+    return index if index is not None else {}
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.memmap:
+    """(Reference ``load_offloaded_weight`` ``utils/offload.py:46``.)"""
+    shape = tuple(weight_info["shape"])
+    dtype_name = weight_info["dtype"]
+    if dtype_name == "bfloat16":
+        mm = np.memmap(weight_file, dtype=np.uint16, mode="r", shape=shape or (1,))
+        out = mm.view(_np_dtype("bfloat16"))
+    else:
+        out = np.memmap(weight_file, dtype=_np_dtype(dtype_name), mode="r", shape=shape or (1,))
+    if not shape:
+        out = out[0]
+    return out
+
+
+def save_offload_index(index: dict, offload_folder: str):
+    with open(os.path.join(offload_folder, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    with open(os.path.join(offload_folder, "index.json")) as f:
+        return json.load(f)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping[str, Any]) -> dict:
+    """Offload a whole flat state dict (reference ``offload_state_dict``)."""
+    index: dict = {}
+    for name, value in state_dict.items():
+        index = offload_weight(value, name, save_dir, index)
+    save_offload_index(index, save_dir)
+    return index
+
+
+class PrefixedDataset(Mapping):
+    """View of a Mapping with a key prefix (reference ``utils/offload.py:104``)."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter([k for k in self.dataset if k.startswith(self.prefix)])
+
+    def __len__(self):
+        return len([k for k in self.dataset if k.startswith(self.prefix)])
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy Mapping over in-memory + disk-offloaded weights (reference
+    ``OffloadedWeightsLoader`` ``utils/offload.py:127``)."""
+
+    def __init__(
+        self,
+        state_dict: Mapping[str, Any] | None = None,
+        save_folder: str | None = None,
+        index: Mapping | None = None,
+        device=None,
+    ):
+        if state_dict is None and save_folder is None and index is None:
+            raise ValueError("need either a state_dict or a save_folder/index")
+        self.state_dict = dict(state_dict or {})
+        if index is None and save_folder is not None:
+            index = load_offload_index(save_folder)
+        self.index = dict(index or {})
+        self.save_folder = save_folder
+        self.all_keys = list(self.state_dict)
+        self.all_keys.extend(k for k in self.index if k not in self.all_keys)
+        self.device = device
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        weight_info = self.index[key]
+        if weight_info.get("safetensors_file") is not None:
+            from safetensors.numpy import load_file
+
+            return load_file(weight_info["safetensors_file"])[weight_info.get("weight_name", key)]
+        weight_file = os.path.join(self.save_folder, f"{key}.dat")
+        return load_offloaded_weight(weight_file, weight_info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)
+
+
+def extract_submodules_state_dict(state_dict: Mapping, submodule_names: list[str]) -> dict:
+    """(Reference ``extract_submodules_state_dict`` ``utils/offload.py:194``.)"""
+    out = {}
+    for name in submodule_names:
+        out.update(
+            {k: v for k, v in state_dict.items() if k == name or k.startswith(name + ".")}
+        )
+    return out
