@@ -59,4 +59,12 @@ def __getattr__(name):
         from .data_loader import skip_first_batches
 
         return skip_first_batches
+    if name in ("notebook_launcher", "debug_launcher"):
+        from . import launchers
+
+        return getattr(launchers, name)
+    if name == "LocalSGD":
+        from .local_sgd import LocalSGD
+
+        return LocalSGD
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

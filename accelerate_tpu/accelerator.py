@@ -110,6 +110,7 @@ class Accelerator:
         fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
         megatron_lm_plugin=None,
         mesh_plugin: MeshPlugin | None = None,
+        context_parallel_plugin=None,
         rng_types: list[str] | None = None,
         log_with=None,
         project_dir: str | None = None,
@@ -135,6 +136,7 @@ class Accelerator:
         self.deepspeed_plugin = deepspeed_plugin
         self.fsdp_plugin = fsdp_plugin
         self.megatron_lm_plugin = megatron_lm_plugin
+        self.context_parallel_plugin = context_parallel_plugin
 
         # kwargs handlers (reference :387-421)
         self.scaler_handler = None
@@ -157,6 +159,17 @@ class Accelerator:
             _from_accelerator=True,
             **init_kwargs,
         )
+
+        # attention routing: bake the cp mode + mesh into every step compiled
+        # from here on (models read this at trace time)
+        from .ops.attention import AttentionContext, set_attention_context
+
+        cp_mode = None
+        if context_parallel_plugin is not None and dict(self.state.mesh.shape).get("cp", 1) > 1:
+            cp_mode = context_parallel_plugin.mode
+        elif dict(self.state.mesh.shape).get("cp", 1) > 1:
+            cp_mode = "ring"  # cp axis in the mesh implies ring attention
+        set_attention_context(AttentionContext(mesh=self.state.mesh, cp_mode=cp_mode))
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration(
             split_batches=split_batches,

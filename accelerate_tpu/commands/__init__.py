@@ -1,0 +1,7 @@
+"""The ``accelerate-tpu`` CLI (reference: ``src/accelerate/commands/``).
+
+Subcommands: config, env, launch, test, estimate-memory, merge-weights,
+tpu-config — same verbs as the reference CLI, with a ``jax_tpu`` compute
+environment instead of torchrun/xmp process spawning (one process drives all
+local chips; multi-host = same command per host + coordinator env vars).
+"""

@@ -1,0 +1,29 @@
+"""CLI root: subcommand registry (reference ``commands/accelerate_cli.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import config, env, estimate, launch, merge, test, tpu
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(dest="command")
+    for module in (config, env, launch, test, estimate, merge, tpu):
+        module.add_parser(subparsers)
+
+    args = parser.parse_args(argv)
+    if not hasattr(args, "func"):
+        parser.print_help()
+        return 1
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

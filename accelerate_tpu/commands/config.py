@@ -1,0 +1,189 @@
+"""``accelerate-tpu config`` — questionnaire → yaml, plus programmatic config.
+
+Reference analog: ``commands/config/`` (cluster.py questionnaire,
+config_args.py dataclasses, default.py write_basic_config). The TPU build
+asks only questions that exist on TPU (mesh axes, precision, hosts) and
+keeps the same file contract: a yaml at
+``~/.cache/accelerate_tpu/default_config.yaml`` that ``launch`` reads and
+turns into ``ACCELERATE_*`` env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+cache_dir = os.path.join(
+    os.path.expanduser(os.environ.get("ACCELERATE_TPU_CACHE", "~/.cache/accelerate_tpu"))
+)
+default_yaml_config_file = os.path.join(cache_dir, "default_config.yaml")
+default_json_config_file = os.path.join(cache_dir, "default_config.json")
+
+
+def _yaml():
+    try:
+        import yaml
+
+        return yaml
+    except ImportError:  # pragma: no cover
+        return None
+
+
+@dataclass
+class ClusterConfig:
+    """The launch-relevant config (reference ``config_args.py:43-290``)."""
+
+    compute_environment: str = "JAX_TPU"
+    distributed_type: str = "TPU"  # NO | TPU | MULTI_HOST_TPU | CPU_MESH
+    num_machines: int = 1
+    machine_rank: int = 0
+    coordinator_address: str | None = None  # host:port for jax.distributed
+    mixed_precision: str = "bf16"
+    gradient_accumulation_steps: int = 1
+    # mesh axes (-1 = absorb remaining devices)
+    mesh_dp: int = -1
+    mesh_fsdp: int = 1
+    mesh_ep: int = 1
+    mesh_cp: int = 1
+    mesh_tp: int = 1
+    use_fsdp: bool = False
+    fsdp_config: dict = field(default_factory=dict)
+    context_parallel_mode: str | None = None  # ring | ulysses | allgather
+    debug: bool = False
+    num_cpu_devices: int = 0  # >0 → virtual CPU mesh (testing)
+    downcast_bf16: bool = False
+    tpu_name: str | None = None
+    tpu_zone: str | None = None
+    main_training_function: str = "main"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+    def save(self, path: str | None = None) -> str:
+        path = path or default_yaml_config_file
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        yaml = _yaml()
+        with open(path, "w") as f:
+            if path.endswith(".json") or yaml is None:
+                json.dump(self.to_dict(), f, indent=2)
+            else:
+                yaml.safe_dump(self.to_dict(), f)
+        return path
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "ClusterConfig":
+        path = path or (
+            default_yaml_config_file
+            if os.path.exists(default_yaml_config_file)
+            else default_json_config_file
+        )
+        with open(path) as f:
+            if path.endswith(".json"):
+                data = json.load(f)
+            else:
+                yaml = _yaml()
+                data = yaml.safe_load(f) if yaml else json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in (data or {}).items() if k in known})
+
+    def to_environment(self) -> dict[str, str]:
+        """The env-var contract ``Accelerator``/``PartialState`` read."""
+        env = {
+            "ACCELERATE_MIXED_PRECISION": str(self.mixed_precision),
+            "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
+            "ACCELERATE_MESH_DP": str(self.mesh_dp),
+            "ACCELERATE_MESH_FSDP": str(self.mesh_fsdp),
+            "ACCELERATE_MESH_EP": str(self.mesh_ep),
+            "ACCELERATE_MESH_CP": str(self.mesh_cp),
+            "ACCELERATE_MESH_TP": str(self.mesh_tp),
+        }
+        if self.use_fsdp:
+            env["ACCELERATE_USE_FSDP"] = "true"
+            for k, v in (self.fsdp_config or {}).items():
+                env[f"FSDP_{k.upper()}"] = str(v)
+        if self.context_parallel_mode:
+            env["ACCELERATE_CP_MODE"] = self.context_parallel_mode
+        if self.debug:
+            env["ACCELERATE_DEBUG_MODE"] = "true"
+        if self.num_machines > 1 and self.coordinator_address:
+            env["ACCELERATE_COORDINATOR_ADDR"] = self.coordinator_address
+            env["ACCELERATE_NUM_PROCESSES"] = str(self.num_machines)
+            env["ACCELERATE_PROCESS_ID"] = str(self.machine_rank)
+        if self.num_cpu_devices > 0:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={self.num_cpu_devices}"
+            ).strip()
+            # a CPU-mesh child must not open a TPU-plugin session (single
+            # physical chip ⇒ concurrent sessions deadlock); clearing the
+            # pool var makes any site-level TPU registration a no-op
+            env["PALLAS_AXON_POOL_IPS"] = ""
+        return env
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()
+    if not raw:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "y")
+    return cast(raw)
+
+
+def get_cluster_input() -> ClusterConfig:
+    """Interactive questionnaire (reference ``cluster.py:54``), linearised —
+    plain prompts instead of the cursor-menu UI."""
+    cfg = ClusterConfig()
+    env = _ask(
+        "Compute environment? (jax_tpu / cpu_mesh for local testing)", "jax_tpu"
+    )
+    if env == "cpu_mesh":
+        cfg.compute_environment = "CPU_MESH"
+        cfg.distributed_type = "CPU_MESH"
+        cfg.num_cpu_devices = _ask("How many virtual CPU devices?", 8, int)
+    cfg.num_machines = _ask("How many hosts (machines)?", 1, int)
+    if cfg.num_machines > 1:
+        cfg.distributed_type = "MULTI_HOST_TPU"
+        cfg.machine_rank = _ask("Rank of this machine?", 0, int)
+        cfg.coordinator_address = _ask("Coordinator address (host:port)?", "127.0.0.1:8476")
+    cfg.mesh_fsdp = _ask("FSDP (param-shard) mesh extent?", 1, int)
+    cfg.mesh_tp = _ask("Tensor-parallel mesh extent?", 1, int)
+    cfg.mesh_cp = _ask("Context-parallel (sequence) mesh extent?", 1, int)
+    cfg.mesh_ep = _ask("Expert-parallel mesh extent?", 1, int)
+    if cfg.mesh_cp > 1:
+        cfg.context_parallel_mode = _ask("Context parallel mode? (ring/ulysses)", "ring")
+    cfg.use_fsdp = cfg.mesh_fsdp > 1
+    cfg.mixed_precision = _ask("Mixed precision? (no/bf16/fp16)", "bf16")
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
+    cfg.debug = _ask("Check distributed operations for shape agreement (debug mode)?", False, bool)
+    return cfg
+
+
+def write_basic_config(mixed_precision: str = "bf16", save_location: str | None = None):
+    """Non-interactive default config (reference ``default.py:142``)."""
+    cfg = ClusterConfig(mixed_precision=mixed_precision)
+    return cfg.save(save_location)
+
+
+def config_command(args):
+    if getattr(args, "default", False):
+        path = write_basic_config(mixed_precision=args.mixed_precision)
+    else:
+        cfg = get_cluster_input()
+        path = cfg.save(args.config_file)
+    print(f"configuration saved at {path}")
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser("config", help="Create the launch configuration")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--default", action="store_true", help="write defaults, no questions")
+    p.add_argument("--mixed_precision", default="bf16")
+    p.set_defaults(func=config_command)
+    return p

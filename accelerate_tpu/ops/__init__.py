@@ -1,3 +1,11 @@
+from .attention import (
+    AttentionContext,
+    attention,
+    attention_context,
+    get_attention_context,
+    set_attention_context,
+)
+from .flash_attention import blockwise_attention, flash_attention
 from .layers import (
     apply_rope,
     causal_attention,

@@ -1,0 +1,110 @@
+"""Attention dispatch: one entry point the models call, routed by the
+active parallelism context.
+
+Routing (decided at trace time, baked into the compiled step):
+
+1. ``cp`` mesh extent > 1 and a context-parallel mode configured →
+   :func:`accelerate_tpu.parallel.context.context_parallel_attention`
+   (ring / Ulysses / allgather under shard_map);
+2. on TPU → the Pallas flash kernel;
+3. otherwise → blockwise (CPU) attention.
+
+The context is set by ``Accelerator.prepare`` (from ``MeshPlugin`` +
+``ContextParallelPlugin``) via :func:`set_attention_context`; models stay
+pure and read it only while being traced.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
+from typing import Literal
+
+import jax
+
+from .flash_attention import blockwise_attention, flash_attention
+from .layers import causal_attention
+
+
+@dataclass(frozen=True)
+class AttentionContext:
+    mesh: object | None = None  # jax.sharding.Mesh
+    cp_mode: Literal["ring", "ulysses", "allgather"] | None = None
+    cp_axis: str = "cp"
+    batch_axes: tuple[str, ...] = ("dp", "fsdp")
+    head_axis: str = "tp"
+    impl: Literal["auto", "flash", "blockwise", "reference"] = "auto"
+    block_q: int = 128
+    block_kv: int = 128
+
+
+_current = AttentionContext()
+
+
+def set_attention_context(ctx: AttentionContext | None) -> None:
+    global _current
+    _current = ctx or AttentionContext()
+
+
+def get_attention_context() -> AttentionContext:
+    return _current
+
+
+@contextmanager
+def attention_context(**overrides):
+    global _current
+    prev = _current
+    _current = replace(prev, **overrides)
+    try:
+        yield _current
+    finally:
+        _current = prev
+
+
+def attention(
+    q: jax.Array,  # [b, s, nh, d]
+    k: jax.Array,  # [b, s, n_kv, d]
+    v: jax.Array,
+    segment_mask: jax.Array | None = None,  # [b, s] 1 = valid token
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    ctx = _current
+    if (
+        ctx.mesh is not None
+        and ctx.cp_mode is not None
+        and dict(ctx.mesh.shape).get(ctx.cp_axis, 1) > 1
+    ):
+        from ..parallel.context import context_parallel_attention
+
+        return context_parallel_attention(
+            q, k, v, segment_mask,
+            mesh=ctx.mesh,
+            mode=ctx.cp_mode,
+            causal=causal,
+            scale=scale,
+            cp_axis=ctx.cp_axis,
+            batch_axes=ctx.batch_axes,
+            head_axis=ctx.head_axis,
+        )
+    impl = ctx.impl
+    if impl == "auto":
+        impl = "flash" if jax.devices()[0].platform == "tpu" else "blockwise"
+    if impl == "flash":
+        return flash_attention(
+            q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
+            block_q=ctx.block_q, block_kv=ctx.block_kv,
+        )
+    if impl == "blockwise":
+        return blockwise_attention(
+            q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
+            block_kv=max(ctx.block_kv, 128),
+        )
+    if not causal:
+        from .layers import dot_product_attention
+
+        mask = None
+        if segment_mask is not None:
+            mask = segment_mask[:, None, None, :].astype(bool)
+        return dot_product_attention(q, k, v, mask=mask, scale=scale)
+    return causal_attention(q, k, v, segment_mask=segment_mask)

@@ -328,6 +328,9 @@ class AcceleratorState:
         cls._shared_state.clear()
         if reset_partial_state:
             PartialState._reset_state()
+        from .ops.attention import set_attention_context
+
+        set_attention_context(None)
 
     @property
     def mixed_precision(self) -> str:

@@ -1,0 +1,142 @@
+"""Bundled distributed assertion script (reference
+``test_utils/scripts/test_script.py``): executed by ``accelerate-tpu test``
+and the self-launched tests, on 1 chip, N local devices, or a pod.
+
+Checks: state init, collectives vs closed form, dataloader sharding
+round-trip, split_between_processes, and the training parity check —
+training through the Accelerator must match a hand-rolled optax loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_state_check(accelerator):
+    state = accelerator.state
+    assert state.num_processes >= 1
+    assert accelerator.device is not None
+    accelerator.print(f"state ok: {dict(state.mesh.shape)}")
+
+
+def operations_check(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    n = accelerator.num_processes
+    # gather of per-shard arange must reconstruct the global arange
+    x = jnp.arange(8, dtype=jnp.float32)
+    g = ops.gather(x)
+    assert g.shape[0] == 8, g.shape
+    r = ops.reduce(jnp.ones((4,)), reduction="sum")
+    np.testing.assert_allclose(np.asarray(r), np.ones(4) * 1.0)
+    b = ops.broadcast(jnp.full((2,), float(accelerator.process_index)))
+    np.testing.assert_allclose(np.asarray(b), 0.0)
+    accelerator.print("operations ok")
+
+
+def dataloader_check(accelerator):
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard
+
+    # every global index appears exactly once across shards per batch round
+    n = 4
+    bs = BatchSampler(range(24), batch_size=8, drop_last=False)
+    seen = []
+    for rank in range(n):
+        shard = BatchSamplerShard(bs, num_processes=n, process_index=rank)
+        seen.extend(i for batch in shard for i in batch)
+    assert sorted(set(seen)) == list(range(24)), sorted(set(seen))
+    accelerator.print("dataloader sharding ok")
+
+
+def split_between_processes_check(accelerator):
+    items = list(range(7))
+    with accelerator.split_between_processes(items) as mine:
+        got = list(mine)
+    assert len(got) >= 1
+    accelerator.print(f"split ok: {len(got)} items on rank {accelerator.process_index}")
+
+
+def training_check(accelerator):
+    """Train y = a·x + b through the Accelerator and through raw optax —
+    identical final weights required (reference ``training_check``,
+    ``test_script.py:449``)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.modules import Model
+    from accelerate_tpu.test_utils.training import RegressionDataset
+
+    ds = RegressionDataset(length=64, seed=42)
+    xs = np.array([d["x"] for d in ds], dtype=np.float32).reshape(-1, 1)
+    ys = np.array([d["y"] for d in ds], dtype=np.float32).reshape(-1, 1)
+
+    def apply_fn(params, x, labels=None):
+        pred = x * params["a"] + params["b"]
+        out = {"logits": pred}
+        if labels is not None:
+            out["loss"] = jnp.mean((pred - labels) ** 2)
+        return out
+
+    def make_params():
+        return {"a": jnp.zeros(()), "b": jnp.zeros(())}
+
+    # --- raw optax reference loop (single device) ---
+    tx = optax.sgd(0.1)
+    params = make_params()
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def raw_step(params, opt_state, x, y):
+        def loss_fn(p):
+            return apply_fn(p, x, labels=y)["loss"]
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for epoch in range(3):
+        for i in range(0, 64, 16):
+            params, opt_state, _ = raw_step(
+                params, opt_state, jnp.asarray(xs[i : i + 16]), jnp.asarray(ys[i : i + 16])
+            )
+
+    # --- accelerator loop (sharded batch over the mesh) ---
+    model = Model(apply_fn, make_params(), name="regression")
+    prepared, opt = accelerator.prepare(model, optax.sgd(0.1))
+    for epoch in range(3):
+        for i in range(0, 64, 16):
+            batch_x = jnp.asarray(xs[i : i + 16])
+            batch_y = jnp.asarray(ys[i : i + 16])
+            out = prepared(batch_x, labels=batch_y)
+            accelerator.backward(out["loss"])
+            opt.step()
+            opt.zero_grad()
+
+    a1 = float(np.asarray(jax.device_get(params["a"])))
+    a2 = float(np.asarray(jax.device_get(prepared.params["a"])))
+    b1 = float(np.asarray(jax.device_get(params["b"])))
+    b2 = float(np.asarray(jax.device_get(prepared.params["b"])))
+    assert abs(a1 - a2) < 1e-4, f"a: raw {a1} vs accelerated {a2}"
+    assert abs(b1 - b2) < 1e-4, f"b: raw {b1} vs accelerated {b2}"
+    accelerator.print(f"training parity ok: a={a2:.4f} b={b2:.4f}")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    # parity checks compare against an fp32 raw-optax loop — pin precision
+    # regardless of what the launch config says
+    accelerator = Accelerator(mixed_precision="no")
+    init_state_check(accelerator)
+    operations_check(accelerator)
+    dataloader_check(accelerator)
+    split_between_processes_check(accelerator)
+    training_check(accelerator)
+    accelerator.print("all checks passed")
+
+
+if __name__ == "__main__":
+    main()

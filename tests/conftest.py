@@ -31,8 +31,10 @@ def reset_state():
     """Reset the Borg singletons between tests (reference
     ``AccelerateTestCase``, ``test_utils/testing.py:479``)."""
     yield
+    from accelerate_tpu.ops.attention import set_attention_context
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+    set_attention_context(None)

@@ -1,0 +1,195 @@
+"""Attention stack: Pallas flash kernel (interpret mode), blockwise
+fallback, and the three context-parallel modes on the 8-device CPU mesh.
+
+Oracle is the naive einsum attention (``ops/layers.py``). Mirrors the
+reference's closed-form collective checks (``test_utils/scripts/test_ops.py``)
+in spirit: every distributed path must equal its single-device answer.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+from accelerate_tpu.ops.attention import AttentionContext, attention, attention_context
+from accelerate_tpu.ops.flash_attention import blockwise_attention, flash_attention
+from accelerate_tpu.ops.layers import causal_mask, dot_product_attention
+from accelerate_tpu.parallel.context import context_parallel_attention
+
+
+def _make_qkv(b=2, s=128, h=4, d=32, n_kv=None, seed=0):
+    rng = np.random.default_rng(seed)
+    n_kv = n_kv or h
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, n_kv, d)), jnp.float32)
+    return q, k, v
+
+
+def _oracle(q, k, v, segment_mask=None, causal=True):
+    s, skv = q.shape[1], k.shape[1]
+    mask = jnp.ones((s, skv), bool)
+    if causal:
+        mask = causal_mask(s, skv)
+    mask = mask[None, None]
+    if segment_mask is not None:
+        mask = mask & segment_mask[:, None, None, :].astype(bool)
+    return dot_product_attention(q, k, v, mask=mask)
+
+
+class TestFlashKernel:
+    def test_forward_causal(self):
+        q, k, v = _make_qkv()
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64, interpret=True)
+        np.testing.assert_allclose(out, _oracle(q, k, v), atol=2e-5)
+
+    def test_forward_non_causal(self):
+        q, k, v = _make_qkv()
+        out = flash_attention(q, k, v, causal=False, block_q=64, block_kv=64, interpret=True)
+        np.testing.assert_allclose(out, _oracle(q, k, v, causal=False), atol=2e-5)
+
+    def test_forward_segment_mask(self):
+        q, k, v = _make_qkv()
+        rng = np.random.default_rng(1)
+        mask = jnp.asarray(rng.random((2, 128)) > 0.3).at[:, 0].set(True)
+        out = flash_attention(q, k, v, segment_mask=mask, causal=True, interpret=True)
+        np.testing.assert_allclose(out, _oracle(q, k, v, segment_mask=mask), atol=2e-5)
+
+    def test_forward_unpadded_seq(self):
+        # seq not a multiple of the block: exercises pad + bias masking
+        q, k, v = _make_qkv(s=100)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64, interpret=True)
+        np.testing.assert_allclose(out, _oracle(q, k, v), atol=2e-5)
+
+    def test_gqa(self):
+        q, k, v = _make_qkv(h=8, n_kv=2)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        rep_k = jnp.repeat(k, 4, axis=2)
+        rep_v = jnp.repeat(v, 4, axis=2)
+        np.testing.assert_allclose(out, _oracle(q, rep_k, rep_v), atol=2e-5)
+
+    def test_gradients(self):
+        q, k, v = _make_qkv(s=128)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True, interpret=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_oracle(q, k, v) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(a, b, atol=2e-4 * max(scale, 1.0))
+
+    def test_bf16(self):
+        q, k, v = _make_qkv()
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = _oracle(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+        np.testing.assert_allclose(out.astype(jnp.float32), ref, atol=3e-2)
+
+
+class TestBlockwise:
+    def test_forward_and_grad(self):
+        q, k, v = _make_qkv(s=192)
+        rng = np.random.default_rng(1)
+        mask = jnp.asarray(rng.random((2, 192)) > 0.3).at[:, 0].set(True)
+        out = blockwise_attention(q, k, v, segment_mask=mask, causal=True, block_kv=64)
+        np.testing.assert_allclose(out, _oracle(q, k, v, segment_mask=mask), atol=2e-5)
+
+        def loss_bw(q, k, v):
+            return (blockwise_attention(q, k, v, segment_mask=mask, block_kv=64) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_oracle(q, k, v, segment_mask=mask) ** 2).sum()
+
+        gb = jax.grad(loss_bw, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gb, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_gqa(self):
+        q, k, v = _make_qkv(h=8, n_kv=4)
+        out = blockwise_attention(q, k, v, causal=True, block_kv=64)
+        ref = _oracle(q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2))
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def _cp_mesh(cp=4):
+    from accelerate_tpu.mesh import build_mesh
+    from accelerate_tpu.utils.dataclasses import MeshPlugin
+
+    return build_mesh(MeshPlugin(dp=-1, cp=cp))
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+class TestContextParallel:
+    def test_matches_dense(self, mode):
+        mesh = _cp_mesh(cp=4)
+        q, k, v = _make_qkv(b=2, s=256, h=4, d=32)
+        rng = np.random.default_rng(2)
+        mask = jnp.asarray(rng.random((2, 256)) > 0.2).at[:, 0].set(True)
+
+        fn = jax.jit(
+            functools.partial(
+                context_parallel_attention, mesh=mesh, mode=mode, causal=True
+            )
+        )
+        out = fn(q, k, v, mask)
+        np.testing.assert_allclose(out, _oracle(q, k, v, segment_mask=mask), atol=3e-5)
+
+    def test_gradients_match_dense(self, mode):
+        mesh = _cp_mesh(cp=4)
+        q, k, v = _make_qkv(b=1, s=128, h=4, d=16, seed=3)
+
+        def loss_cp(q, k, v):
+            out = context_parallel_attention(q, k, v, None, mesh=mesh, mode=mode)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_oracle(q, k, v) ** 2).sum()
+
+        gc = jax.jit(jax.grad(loss_cp, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gc, gr):
+            np.testing.assert_allclose(a, b, atol=2e-4)
+
+    def test_non_causal(self, mode):
+        mesh = _cp_mesh(cp=4)
+        q, k, v = _make_qkv(b=2, s=128, h=4, d=16, seed=4)
+        out = jax.jit(
+            functools.partial(
+                context_parallel_attention, mesh=mesh, mode=mode, causal=False
+            )
+        )(q, k, v, None)
+        np.testing.assert_allclose(out, _oracle(q, k, v, causal=False), atol=3e-5)
+
+
+class TestDispatcher:
+    def test_default_is_blockwise_on_cpu(self):
+        q, k, v = _make_qkv()
+        out = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, _oracle(q, k, v), atol=2e-5)
+
+    def test_cp_context_routes_to_ring(self):
+        mesh = _cp_mesh(cp=4)
+        q, k, v = _make_qkv(s=256)
+        with attention_context(mesh=mesh, cp_mode="ring"):
+            out = jax.jit(lambda *a: attention(*a, causal=True))(q, k, v)
+        np.testing.assert_allclose(out, _oracle(q, k, v), atol=3e-5)
+
+    def test_accelerator_sets_context(self):
+        from accelerate_tpu import Accelerator, MeshPlugin
+        from accelerate_tpu.ops.attention import get_attention_context
+
+        acc = Accelerator(mesh_plugin=MeshPlugin(dp=-1, cp=2))
+        ctx = get_attention_context()
+        assert ctx.cp_mode == "ring"
+        assert dict(ctx.mesh.shape)["cp"] == 2
