@@ -165,10 +165,17 @@ class Accelerator:
         from .ops.attention import AttentionContext, set_attention_context
 
         cp_mode = None
-        if context_parallel_plugin is not None and dict(self.state.mesh.shape).get("cp", 1) > 1:
-            cp_mode = context_parallel_plugin.mode
-        elif dict(self.state.mesh.shape).get("cp", 1) > 1:
-            cp_mode = "ring"  # cp axis in the mesh implies ring attention
+        if dict(self.state.mesh.shape).get("cp", 1) > 1:
+            if context_parallel_plugin is not None:
+                cp_mode = context_parallel_plugin.mode
+            else:
+                # honour `launch --cp_mode` / config (written as ACCELERATE_CP_MODE);
+                # a cp axis in the mesh defaults to ring attention
+                cp_mode = os.environ.get("ACCELERATE_CP_MODE", "ring")
+                if cp_mode not in ("ring", "ulysses", "allgather"):
+                    raise ValueError(
+                        f"ACCELERATE_CP_MODE={cp_mode!r} — expected ring|ulysses|allgather"
+                    )
         set_attention_context(AttentionContext(mesh=self.state.mesh, cp_mode=cp_mode))
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration(

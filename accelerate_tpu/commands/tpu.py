@@ -20,22 +20,49 @@ def _gcloud_cmd(tpu_name: str, zone: str, worker: str, command: str) -> list[str
     ]
 
 
+def resolve_coordinator(cfg) -> str | None:
+    """Worker 0's address, resolved *on the launcher*.
+
+    Explicit config wins; otherwise ask gcloud for worker 0's internal IP.
+    Returns None when neither works — the workers then fall back to JAX's
+    TPU-pod auto-detection (``jax.distributed.initialize()`` with no
+    coordinator reads the TPU metadata server), which is always correct on
+    a real pod. Never emit an unexpanded ``$(hostname -i)``: quoted it is a
+    literal, and unquoted it would resolve to each worker's *own* IP.
+    """
+    if cfg.coordinator_address:
+        return cfg.coordinator_address
+    try:
+        out = subprocess.run(
+            [
+                "gcloud", "compute", "tpus", "tpu-vm", "describe",
+                cfg.tpu_name or "tpu", f"--zone={cfg.tpu_zone or 'zone'}",
+                "--format=value(networkEndpoints[0].ipAddress)",
+            ],
+            capture_output=True, text=True, timeout=60,
+        )
+        ip = out.stdout.strip().splitlines()[0] if out.returncode == 0 and out.stdout.strip() else ""
+        return f"{ip}:8476" if ip else None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
 def build_pod_commands(cfg, script: str, script_args: list[str], env: dict) -> list[list[str]]:
     """One gcloud ssh command per pod worker, each exporting the multi-host
     rendezvous env (coordinator = worker 0 port 8476 by convention)."""
     n = max(cfg.num_machines, 1)
-    coordinator = cfg.coordinator_address or "$(hostname -i):8476"
+    coordinator = resolve_coordinator(cfg)
     cmds = []
     accelerate_env = {k: v for k, v in env.items() if k.startswith(("ACCELERATE_", "JAX_", "XLA_"))}
     for worker in range(n):
-        exports = " ".join(
-            f"{k}={v!r}" for k, v in {
-                **accelerate_env,
-                "ACCELERATE_COORDINATOR_ADDR": coordinator,
-                "ACCELERATE_NUM_PROCESSES": str(n),
-                "ACCELERATE_PROCESS_ID": str(worker),
-            }.items()
-        )
+        worker_env = {
+            **accelerate_env,
+            "ACCELERATE_NUM_PROCESSES": str(n),
+            "ACCELERATE_PROCESS_ID": str(worker),
+        }
+        if coordinator is not None:
+            worker_env["ACCELERATE_COORDINATOR_ADDR"] = coordinator
+        exports = " ".join(f"{k}={v!r}" for k, v in worker_env.items())
         inner = f"export {exports}; python3 {script} {' '.join(script_args)}"
         cmds.append(_gcloud_cmd(cfg.tpu_name or "tpu", cfg.tpu_zone or "zone", str(worker), inner))
     return cmds

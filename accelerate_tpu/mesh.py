@@ -118,6 +118,19 @@ def initialize_distributed(
         env = os.environ.get("ACCELERATE_PROCESS_ID")
         process_id = int(env) if env else None
     if coordinator_address is None:
+        # No coordinator: the only recoverable multi-process case is a real
+        # TPU pod, where jax.distributed.initialize() with all-None args
+        # auto-detects the rendezvous from the TPU metadata server. Anywhere
+        # else (stale ACCELERATE_NUM_PROCESSES export, CPU repro of a pod
+        # config) stay a single-process no-op as before.
+        on_tpu_vm = os.path.exists("/dev/accel0") or any(
+            k in os.environ for k in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID", "TPU_WORKER_HOSTNAMES")
+        )
+        if not (num_processes and num_processes > 1 and on_tpu_vm):
+            return
+        if jax._src.distributed.global_state.client is not None:  # already up
+            return
+        jax.distributed.initialize()
         return
     if jax._src.distributed.global_state.client is not None:  # already up
         return

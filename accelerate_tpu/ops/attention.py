@@ -16,11 +16,15 @@ pure and read it only while being traced.
 
 from __future__ import annotations
 
+import functools
 from contextlib import contextmanager
 from dataclasses import dataclass, replace
 from typing import Literal
 
 import jax
+from jax.sharding import PartitionSpec as P
+
+shard_map = jax.shard_map
 
 from .flash_attention import blockwise_attention, flash_attention
 from .layers import causal_attention
@@ -61,6 +65,53 @@ def attention_context(**overrides):
         _current = prev
 
 
+def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
+    """Run the flash kernel under shard_map: batch over dp/fsdp, heads over
+    tp, sequence replicated (cp==1 on this path — cp>1 routes to
+    ``context_parallel_attention``). Axes that don't divide the corresponding
+    dim stay replicated; if nothing shards, fall back to the plain call."""
+    mesh = ctx.mesh
+    shape = dict(mesh.shape)
+    b, _, nh, _ = q.shape
+    n_kv = k.shape[2]
+
+    kept_batch: list[str] = []
+    extent = 1
+    for ax in ctx.batch_axes:
+        if b % (extent * shape.get(ax, 1)) == 0:
+            kept_batch.append(ax)
+            extent *= shape.get(ax, 1)
+    batch_entry = tuple(kept_batch) if kept_batch else None
+    head_ext = shape.get(ctx.head_axis, 1)
+    head_entry = (
+        ctx.head_axis if (nh % head_ext == 0 and n_kv % head_ext == 0) else None
+    )
+    if batch_entry is None and head_entry is None:
+        return flash_attention(
+            q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
+            block_q=ctx.block_q, block_kv=ctx.block_kv,
+        )
+
+    qkv_spec = P(batch_entry, None, head_entry, None)
+    mask_spec = P(batch_entry, None)
+    has_mask = segment_mask is not None
+    in_specs = (qkv_spec,) * 3 + ((mask_spec,) if has_mask else ())
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+    )
+    def _inner(q_, k_, v_, *mask_):
+        return flash_attention(
+            q_, k_, v_,
+            segment_mask=mask_[0] if mask_ else None,
+            causal=causal, scale=scale,
+            block_q=ctx.block_q, block_kv=ctx.block_kv,
+        )
+
+    args = (q, k, v, segment_mask) if has_mask else (q, k, v)
+    return _inner(*args)
+
+
 def attention(
     q: jax.Array,  # [b, s, nh, d]
     k: jax.Array,  # [b, s, n_kv, d]
@@ -91,6 +142,11 @@ def attention(
     if impl == "auto":
         impl = "flash" if jax.devices()[0].platform == "tpu" else "blockwise"
     if impl == "flash":
+        if ctx.mesh is not None and any(e > 1 for e in dict(ctx.mesh.shape).values()):
+            # GSPMD treats the Mosaic custom call as opaque, so on a sharded
+            # mesh the kernel must run under shard_map with explicit batch /
+            # head partitioning — otherwise XLA replicates q,k,v per device.
+            return _flash_sharded(q, k, v, segment_mask, causal, scale, ctx)
         return flash_attention(
             q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
             block_q=ctx.block_q, block_kv=ctx.block_kv,

@@ -51,7 +51,9 @@ def _fwd_kernel(
     v_ref,  # (1, 1, bkv, d)
     bias_ref,  # (1, 1, 1, bkv) or None
     o_ref,  # (1, 1, bq, d)
-    lse_ref,  # (1, 1, bq)
+    lse_ref,  # (1, 1, bq, 1) — trailing unit lane so the block spec is
+    #           Mosaic-legal (a rank-3 (1, 1, bq) block has second-minor 1,
+    #           which real-TPU lowering rejects unless heads == 1)
     m_scr,  # (bq, _MIN_LANE) f32
     l_scr,  # (bq, _MIN_LANE) f32
     acc_scr,  # (bq, d) f32
@@ -96,7 +98,10 @@ def _fwd_kernel(
         m_prev = m_scr[:, 0][:, None]  # (bq, 1)
         m_cur = jnp.max(s, axis=-1)[:, None]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # (bq, bkv)
+        # NEG_INF is finite, so a fully-masked row has s == m_new == NEG_INF
+        # and exp(s - m_new) would be 1; zero it so l stays 0 and the row
+        # resolves to output 0 / lse NEG_INF instead of mean(v).
+        p = jnp.where(m_new == NEG_INF, 0.0, jnp.exp(s - m_new))  # (bq, bkv)
         alpha = jnp.exp(m_prev - m_new)  # (bq, 1)
         l_new = alpha * l_scr[:, 0][:, None] + jnp.sum(p, axis=-1)[:, None]
 
@@ -113,9 +118,9 @@ def _fwd_kernel(
         l = l_scr[:, 0][:, None]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        m = m_scr[:, 0]
-        lse = jnp.where(l[:, 0] == 0.0, NEG_INF, m + jnp.log(l_safe[:, 0]))
-        lse_ref[0, 0, :] = lse
+        m = m_scr[:, 0][:, None]
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0, :, :] = lse
 
 
 # ---------------------------------------------------------------------------
@@ -145,8 +150,8 @@ def _bwd_dq_kernel(
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :][:, None]  # (bq, 1)
-        delta = delta_ref[0, 0, :][:, None]
+        lse = lse_ref[0, 0, :, :]  # (bq, 1)
+        delta = delta_ref[0, 0, :, :]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -158,7 +163,10 @@ def _bwd_dq_kernel(
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
             mask = (qi * block_q + rows) >= (ki * block_kv + cols)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bkv); rows with lse=NEG_INF give 0
+        # NEG_INF is the finite float32 min, so for a fully-masked row both s
+        # and lse are NEG_INF and exp(s - lse) = exp(0) = 1 — zero those rows
+        # explicitly (partially-masked entries underflow to 0 on their own).
+        p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse))  # (bq, bkv)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -196,8 +204,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :][:, None]
-        delta = delta_ref[0, 0, :][:, None]
+        lse = lse_ref[0, 0, :, :]  # (bq, 1)
+        delta = delta_ref[0, 0, :, :]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -209,7 +217,8 @@ def _bwd_dkv_kernel(
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
             mask = (qi * block_q + rows) >= (ki * block_kv + cols)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bkv)
+        # see dq kernel: fully-masked rows have lse == NEG_INF and must give 0
+        p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse))  # (bq, bkv)
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -285,11 +294,11 @@ def _fwd_call(q, k, v, bias, scale, causal, block_q, block_kv, interpret):
 
     out_shape = [
         jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-        jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
     ]
     out_specs = [
         pl.BlockSpec((1, 1, block_q, d), qmap),
-        pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
     ]
     kwargs = {}
     cp = _compiler_params(len(grid))
@@ -318,7 +327,8 @@ def _bwd_call(q, k, v, bias, o, lse, do, scale, causal, block_q, block_kv, inter
     nq = sq // block_q
     nkv = skv // block_kv
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (b,h,sq)
+    # (b, h, sq, 1): rank-4 with a unit lane, matching the lse layout
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
 
     def qmap4(bi, hi, qi, ki):
         return (bi, hi, qi, 0)
@@ -327,7 +337,7 @@ def _bwd_call(q, k, v, bias, o, lse, do, scale, causal, block_q, block_kv, inter
         return (bi, hi, ki, 0)
 
     def rowmap(bi, hi, qi, ki):
-        return (bi, hi, qi)
+        return (bi, hi, qi, 0)
 
     # --- dq: grid (b, h, nq, nkv) ---
     in_specs = [
@@ -341,8 +351,8 @@ def _bwd_call(q, k, v, bias, o, lse, do, scale, causal, block_q, block_kv, inter
         args.append(bias)
     in_specs += [
         pl.BlockSpec((1, 1, block_q, d), qmap4),
-        pl.BlockSpec((1, 1, block_q), rowmap),
-        pl.BlockSpec((1, 1, block_q), rowmap),
+        pl.BlockSpec((1, 1, block_q, 1), rowmap),
+        pl.BlockSpec((1, 1, block_q, 1), rowmap),
     ]
     args += [do, lse, delta]
 
@@ -384,7 +394,7 @@ def _bwd_call(q, k, v, bias, o, lse, do, scale, causal, block_q, block_kv, inter
         return (bi, hi, ki, 0)
 
     def rowmap_t(bi, hi, ki, qi):
-        return (bi, hi, qi)
+        return (bi, hi, qi, 0)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), qmap_t),
@@ -397,8 +407,8 @@ def _bwd_call(q, k, v, bias, o, lse, do, scale, causal, block_q, block_kv, inter
         args.append(bias)
     in_specs += [
         pl.BlockSpec((1, 1, block_q, d), qmap_t),
-        pl.BlockSpec((1, 1, block_q), rowmap_t),
-        pl.BlockSpec((1, 1, block_q), rowmap_t),
+        pl.BlockSpec((1, 1, block_q, 1), rowmap_t),
+        pl.BlockSpec((1, 1, block_q, 1), rowmap_t),
     ]
     args += [do, lse, delta]
 
@@ -495,8 +505,15 @@ def flash_attention(
         v = jnp.repeat(v, rep, axis=2)
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
 
-    block_q = min(block_q, max(sq, 1))
-    block_kv = min(block_kv, max(skv, 1))
+    # Mosaic block constraints: second-minor multiple of 8 (q rows), and the
+    # bias block's minor dim (= block_kv) a multiple of 128 — so align the
+    # clamped blocks rather than clamping to the raw sequence length (s=100
+    # must give block_q=104, not 100).
+    def _round_up(x: int, m: int) -> int:
+        return ((max(x, 1) + m - 1) // m) * m
+
+    block_q = min(_round_up(block_q, 8), _round_up(sq, 8))
+    block_kv = min(_round_up(block_kv, 128), _round_up(skv, 128))
     sq_p = int(np.ceil(sq / block_q)) * block_q
     skv_p = int(np.ceil(skv / block_kv)) * block_kv
 
@@ -569,7 +586,8 @@ def blockwise_attention(
         s = jnp.where(col_mask, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_run, m_cur)
-        p = jnp.exp(s - m_new[..., None])
+        # fully-masked rows: m_new == NEG_INF (finite) would give exp(0)=1
+        p = jnp.where(m_new[..., None] == NEG_INF, 0.0, jnp.exp(s - m_new[..., None]))
         alpha = jnp.exp(m_run - m_new)
         l_new = alpha * l_run + jnp.sum(p, axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum(

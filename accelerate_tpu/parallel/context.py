@@ -62,7 +62,9 @@ def _chunk_update(carry, q, k_chunk, v_chunk, kv_valid, q_offset, kv_offset, sca
     s = jnp.where(mask, s, NEG_INF)
     m_cur = jnp.max(s, axis=-1)  # [b,h,sq]
     m_new = jnp.maximum(m_run, m_cur)
-    p = jnp.exp(s - m_new[..., None])
+    # fully-masked rows: m_new == NEG_INF (finite) would give exp(0)=1,
+    # turning the row into mean(v); zero p so l stays 0 → output 0
+    p = jnp.where(m_new[..., None] == NEG_INF, 0.0, jnp.exp(s - m_new[..., None]))
     alpha = jnp.exp(m_run - m_new)
     l_new = alpha * l_run + jnp.sum(p, axis=-1)
     acc = acc * alpha[..., None] + jnp.einsum(
@@ -212,6 +214,11 @@ def context_parallel_attention(
         raise ValueError(
             f"sequence length {s} must be divisible by the {cp_axis!r} mesh "
             f"extent {cp_extent} for context parallelism"
+        )
+    if mode == "ulysses" and nh % cp_extent != 0:
+        raise ValueError(
+            f"ulysses context parallelism re-shards heads over {cp_axis!r}: "
+            f"head count {nh} must be divisible by the mesh extent {cp_extent}"
         )
     qkv_spec = P(batch_entry, cp_axis, head_entry, None)
     mask_spec = P(batch_entry, cp_axis)

@@ -87,6 +87,33 @@ class TestFlashKernel:
             scale = float(jnp.abs(b).max())
             np.testing.assert_allclose(a, b, atol=2e-4 * max(scale, 1.0))
 
+    def test_gradients_fully_masked_rows(self):
+        # Left-padded mask + causal: the first query rows see zero valid keys,
+        # so lse is the sentinel NEG_INF and the backward must zero p rather
+        # than evaluate exp(NEG_INF - NEG_INF) = 1 (regression: grads were
+        # garbage for padded batches).
+        q, k, v = _make_qkv(s=128)
+        mask = jnp.ones((2, 128), bool).at[:, :48].set(False)
+
+        # fully-masked rows must resolve to output 0, not mean(v)
+        out_f = flash_attention(q, k, v, segment_mask=mask, causal=True, interpret=True)
+        out_b = blockwise_attention(q, k, v, segment_mask=mask, causal=True)
+        assert float(jnp.abs(out_f[:, :48]).max()) == 0.0
+        assert float(jnp.abs(out_b[:, :48]).max()) == 0.0
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, segment_mask=mask, causal=True, interpret=True) ** 2).sum()
+
+        def loss_block(q, k, v):
+            return (blockwise_attention(q, k, v, segment_mask=mask, causal=True) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gb):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(a, b, atol=2e-4 * max(scale, 1.0))
+        assert all(bool(jnp.isfinite(g).all()) for g in gf)
+
     def test_bf16(self):
         q, k, v = _make_qkv()
         q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
