@@ -65,6 +65,26 @@ def attention_context(**overrides):
         _current = prev
 
 
+def adapt_attention_specs(
+    mesh_shape: dict, b: int, nh: int, n_kv: int,
+    batch_axes: tuple[str, ...], head_axis: str,
+) -> tuple[tuple | None, str | None]:
+    """(batch_entry, head_entry) for attention shard_map specs: keep only
+    the sharding axes that divide the corresponding dim (e.g. batch 1 on a
+    dp=2 mesh stays replicated). Shared by the flash GSPMD wrapper and
+    ``context_parallel_attention``."""
+    kept_batch: list[str] = []
+    extent = 1
+    for ax in batch_axes:
+        if b % (extent * mesh_shape.get(ax, 1)) == 0:
+            kept_batch.append(ax)
+            extent *= mesh_shape.get(ax, 1)
+    batch_entry = tuple(kept_batch) if kept_batch else None
+    head_ext = mesh_shape.get(head_axis, 1)
+    head_entry = head_axis if (nh % head_ext == 0 and n_kv % head_ext == 0) else None
+    return batch_entry, head_entry
+
+
 def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
     """Run the flash kernel under shard_map: batch over dp/fsdp, heads over
     tp, sequence replicated (cp==1 on this path — cp>1 routes to
@@ -75,16 +95,8 @@ def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
     b, _, nh, _ = q.shape
     n_kv = k.shape[2]
 
-    kept_batch: list[str] = []
-    extent = 1
-    for ax in ctx.batch_axes:
-        if b % (extent * shape.get(ax, 1)) == 0:
-            kept_batch.append(ax)
-            extent *= shape.get(ax, 1)
-    batch_entry = tuple(kept_batch) if kept_batch else None
-    head_ext = shape.get(ctx.head_axis, 1)
-    head_entry = (
-        ctx.head_axis if (nh % head_ext == 0 and n_kv % head_ext == 0) else None
+    batch_entry, head_entry = adapt_attention_specs(
+        shape, b, nh, n_kv, ctx.batch_axes, ctx.head_axis
     )
     if batch_entry is None and head_entry is None:
         return flash_attention(

@@ -200,26 +200,28 @@ def context_parallel_attention(
 
     # Adapt specs to the actual shapes: drop sharding axes that don't divide
     # the corresponding dim (e.g. batch 1 on a dp=2 mesh stays replicated).
+    from ..ops.attention import adapt_attention_specs
+
     shape = dict(mesh.shape)
-    kept_batch: list[str] = []
-    extent = 1
-    for ax in batch_axes:
-        if b % (extent * shape.get(ax, 1)) == 0:
-            kept_batch.append(ax)
-            extent *= shape.get(ax, 1)
-    batch_entry = tuple(kept_batch) if kept_batch else None
-    head_entry = head_axis if nh % shape.get(head_axis, 1) == 0 else None
+    batch_entry, head_entry = adapt_attention_specs(
+        shape, b, nh, nh, batch_axes, head_axis
+    )
     cp_extent = shape.get(cp_axis, 1)
     if s % cp_extent != 0:
         raise ValueError(
             f"sequence length {s} must be divisible by the {cp_axis!r} mesh "
             f"extent {cp_extent} for context parallelism"
         )
-    if mode == "ulysses" and nh % cp_extent != 0:
-        raise ValueError(
-            f"ulysses context parallelism re-shards heads over {cp_axis!r}: "
-            f"head count {nh} must be divisible by the mesh extent {cp_extent}"
-        )
+    if mode == "ulysses":
+        # the all_to_all splits the *local* head dim (after any tp sharding)
+        local_heads = nh // shape.get(head_axis, 1) if head_entry else nh
+        if local_heads % cp_extent != 0:
+            raise ValueError(
+                f"ulysses context parallelism re-shards heads over {cp_axis!r}: "
+                f"per-shard head count {local_heads} (= {nh} heads"
+                + (f" / {head_axis}={shape.get(head_axis, 1)}" if head_entry else "")
+                + f") must be divisible by the {cp_axis!r} mesh extent {cp_extent}"
+            )
     qkv_spec = P(batch_entry, cp_axis, head_entry, None)
     mask_spec = P(batch_entry, cp_axis)
     body = _LOCAL_BODIES[mode]

@@ -57,6 +57,7 @@ def _bench_config(platform: str):
 # Peak dense bf16 FLOPs/s per chip by device kind (public spec sheets).
 _PEAK_FLOPS = (
     ("v6e", 918e12),
+    ("v6 lite", 918e12),  # jax reports v6e device_kind as "TPU v6 lite"
     ("v5p", 459e12),
     ("v5e", 197e12),
     ("v5 lite", 197e12),

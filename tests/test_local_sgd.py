@@ -1,0 +1,164 @@
+"""LocalSGD: K-step divergent local training + parameter averaging over dp
+(reference ``/root/reference/src/accelerate/local_sgd.py:19-104``; here the
+workers are dp shards carrying a stacked replica axis — see
+``accelerate_tpu/local_sgd.py``)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, LocalSGD, MeshPlugin
+from accelerate_tpu.test_utils import RegressionModel
+
+
+LR = 0.1
+
+
+def _np_sgd_steps(a, b, x, y, lr, steps):
+    """Closed-form SGD on mse loss of y = a·x + b for one worker's slice."""
+    for _ in range(steps):
+        pred = a * x + b
+        ga = np.mean(2.0 * (pred - y) * x)
+        gb = np.mean(2.0 * (pred - y))
+        a, b = a - lr * ga, b - lr * gb
+    return a, b
+
+
+def _make(dp):
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=dp, devices=jax.devices()[:dp]))
+    model = RegressionModel(a=0.5, b=-0.5)
+    model, opt = accelerator.prepare(model, optax.sgd(LR))
+    return accelerator, model, opt
+
+
+def _data(n, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n,)).astype(np.float32)
+    y = (2.0 * x + 3.0).astype(np.float32)
+    return x, y
+
+
+def test_local_steps_match_independent_workers_closed_form():
+    """Inside the context each dp replica trains alone on its slice; the
+    exit average equals the mean of independently trained workers."""
+    R, b, steps = 4, 4, 3
+    accelerator, model, opt = _make(R)
+    x, y = _data(R * b)
+
+    with LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=100) as local_sgd:
+        for _ in range(steps):
+            out = model(x=x, y=y)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            local_sgd.step()
+
+    # numpy oracle: worker r sees the contiguous slice r of the global batch
+    workers = [
+        _np_sgd_steps(0.5, -0.5, x[r * b : (r + 1) * b], y[r * b : (r + 1) * b], LR, steps)
+        for r in range(R)
+    ]
+    a_ref = np.mean([w[0] for w in workers])
+    b_ref = np.mean([w[1] for w in workers])
+    assert np.allclose(float(np.asarray(model.params["a"])), a_ref, atol=1e-5)
+    assert np.allclose(float(np.asarray(model.params["b"])), b_ref, atol=1e-5)
+
+
+def test_sync_every_step_equals_full_batch_sgd():
+    """local_sgd_steps=1 degenerates to synchronous data-parallel SGD: the
+    average of per-slice gradients is the full-batch gradient."""
+    R, b, steps = 2, 8, 4
+    accelerator, model, opt = _make(R)
+    x, y = _data(R * b, seed=11)
+
+    with LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=1) as local_sgd:
+        for _ in range(steps):
+            out = model(x=x, y=y)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            local_sgd.step()
+
+    a_ref, b_ref = _np_sgd_steps(0.5, -0.5, x, y, LR, steps)
+    assert np.allclose(float(np.asarray(model.params["a"])), a_ref, atol=1e-5)
+    assert np.allclose(float(np.asarray(model.params["b"])), b_ref, atol=1e-5)
+
+
+def test_mid_context_sync_boundary():
+    """With local_sgd_steps=2 and 4 steps: sync at 2 and 4 — oracle is two
+    rounds of (2 local steps, average)."""
+    R, b = 2, 4
+    accelerator, model, opt = _make(R)
+    x, y = _data(R * b, seed=3)
+
+    with LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=2) as local_sgd:
+        for _ in range(4):
+            out = model(x=x, y=y)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            local_sgd.step()
+
+    a_w = [0.5] * R
+    b_w = [-0.5] * R
+    for _round in range(2):
+        for r in range(R):
+            a_w[r], b_w[r] = _np_sgd_steps(
+                a_w[r], b_w[r], x[r * b : (r + 1) * b], y[r * b : (r + 1) * b], LR, 2
+            )
+        a_w = [np.mean(a_w)] * R
+        b_w = [np.mean(b_w)] * R
+    assert np.allclose(float(np.asarray(model.params["a"])), a_w[0], atol=1e-5)
+    assert np.allclose(float(np.asarray(model.params["b"])), b_w[0], atol=1e-5)
+
+
+def test_disabled_and_single_replica_are_noops():
+    accelerator, model, opt = _make(2)
+    x, y = _data(8)
+    with LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=2, enabled=False) as l:
+        out = model(x=x, y=y)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        l.step()
+    a_ref, b_ref = _np_sgd_steps(0.5, -0.5, x, y, LR, 1)
+    assert np.allclose(float(np.asarray(model.params["a"])), a_ref, atol=1e-5)
+
+    # dp=1: enabled silently degrades (reference: distributed_type == NO)
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc1 = Accelerator(mesh_plugin=MeshPlugin(dp=1, devices=jax.devices()[:1]))
+    m1 = acc1.prepare_model(RegressionModel())
+    with LocalSGD(accelerator=acc1, model=m1, local_sgd_steps=2) as l1:
+        assert not l1.enabled
+
+
+def test_model_parallel_mesh_raises():
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=2, tp=2, devices=jax.devices()[:4]))
+    model = accelerator.prepare_model(RegressionModel())
+    with pytest.raises(NotImplementedError):
+        LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=2)
+
+
+def test_params_shape_restored_after_context():
+    accelerator, model, opt = _make(4)
+    orig_shapes = jax.tree.map(lambda l: l.shape, model.params)
+    x, y = _data(8)
+    with LocalSGD(accelerator=accelerator, model=model, local_sgd_steps=3) as l:
+        stacked = jax.tree.leaves(model.params)[0]
+        assert stacked.shape[0] == 4
+        out = model(x=x, y=y)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        l.step()
+    assert jax.tree.map(lambda l: l.shape, model.params) == orig_shapes
+    # training continues fine after the context
+    out = model(x=x, y=y)
+    accelerator.backward(out.loss)
+    opt.step()
+    assert np.isfinite(out.loss.item())
