@@ -67,4 +67,8 @@ def __getattr__(name):
         from .local_sgd import LocalSGD
 
         return LocalSGD
+    if name == "prepare_pippy":
+        from .inference import prepare_pippy
+
+        return prepare_pippy
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

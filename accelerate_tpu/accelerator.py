@@ -435,9 +435,13 @@ class Accelerator:
             split_batches=self.split_batches,
             put_on_device=device_placement if device_placement is not None else self.device_placement,
             rng_types=self.rng_types,
+            dispatch_batches=self.dataloader_config.dispatch_batches,
             even_batches=self.even_batches,
             use_seedable_sampler=self.use_seedable_sampler,
+            slice_fn_for_dispatch=slice_fn_for_dispatch,
+            use_stateful_dataloader=self.dataloader_config.use_stateful_dataloader,
             sharding=data_sharding(self.mesh),
+            prefetch_batches=self.dataloader_config.prefetch_batches,
         )
         self._dataloaders.append(prepared)
         return prepared

@@ -188,7 +188,11 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
             with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
                 pickle.dump(sched.state_dict(), f)
         for i, dl in enumerate(accelerator._dataloaders):
-            state = {"iteration": dl.iteration, "skip_batches": dl.skip_batches}
+            # deep sampler/loader state: epoch + mid-epoch position, so
+            # load_state resumes without a manual skip_first_batches
+            # (reference saves sampler/dataloader state_dicts,
+            # ``checkpointing.py:116-143``)
+            state = dl.state_dict()
             with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
                 pickle.dump(state, f)
         for i, obj in enumerate(accelerator._custom_objects):
@@ -251,7 +255,7 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
         if os.path.exists(path):
             with open(path, "rb") as f:
                 state = pickle.load(f)
-            dl.set_epoch(state.get("iteration", 0))
+            dl.load_state_dict(state)
     for i, obj in enumerate(accelerator._custom_objects):
         with open(os.path.join(input_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "rb") as f:
             obj.load_state_dict(pickle.load(f))

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import itertools
 import math
+import queue
+import threading
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -193,31 +195,56 @@ class BatchSamplerShard:
         completed = list(last) + list(filler)
         yield completed[lo:hi]
 
-    # -- no-split mode: global schedule, stride-sliced ----------------------
-
-    def _build_schedule(self) -> list[list[int]]:
-        """Materialise the padded global batch schedule (all processes)."""
-        P = self.num_processes
-        batches = [list(b) for b in self.batch_sampler]
-        if not batches:
-            return []
-        if self.drop_last:
-            full_rounds = len(batches) // P
-            return batches[: full_rounds * P]
-        if not self.even_batches:
-            return batches
-        B = self.batch_size
-        # cycling source: indices of the first P batches, read sequentially
-        source = itertools.cycle([i for b in batches[:P] for i in b])
-        if len(batches[-1]) < B:
-            batches[-1] = batches[-1] + list(itertools.islice(source, B - len(batches[-1])))
-        while len(batches) % P != 0:
-            batches.append(list(itertools.islice(source, B)))
-        return batches
+    # -- no-split mode: streaming rounds, stride-sliced ----------------------
 
     def _iter_round_robin(self):
-        schedule = self._build_schedule()
-        yield from schedule[self.process_index :: self.num_processes]
+        """Stream the padded global schedule one round (``num_processes``
+        batches) at a time — O(P·B) memory, never the whole epoch (the
+        reference streams the same way, ``data_loader.py:189-256``; an
+        earlier version here materialised every batch index list).
+
+        Round r of the global schedule holds batches ``[rP, rP+P)``; this
+        process owns position ``process_index`` in each round. The padding
+        source for ``even_batches`` cycles the indices of the *first P
+        batches* read sequentially — stateful across both the short-batch
+        completion and whole-batch padding, matching the reference."""
+        P = self.num_processes
+        B = self.batch_size
+        STOP = object()
+        first_rounds: list[list[int]] = []  # the first P batches (cycle source)
+        round_buf: list[list[int]] = []
+
+        # one-batch lookahead: the *last* batch of the stream may be short
+        # and needs completion even when its round is already P long
+        it = iter(self.batch_sampler)
+        pending = next(it, STOP)
+        while pending is not STOP:
+            batch = list(pending)
+            pending = next(it, STOP)
+            if len(first_rounds) < P:
+                first_rounds.append(batch)
+            round_buf.append(batch)
+            if len(round_buf) == P and pending is not STOP:
+                yield round_buf[self.process_index]
+                round_buf = []
+
+        if not round_buf:
+            return
+        if self.drop_last:
+            if len(round_buf) == P:
+                yield round_buf[self.process_index]
+            return
+        if not self.even_batches:
+            if self.process_index < len(round_buf):
+                yield round_buf[self.process_index]
+            return
+        source = itertools.cycle([i for b in first_rounds for i in b])
+        last = round_buf[-1]
+        if len(last) < B:
+            round_buf[-1] = last + list(itertools.islice(source, B - len(last)))
+        while len(round_buf) < P:
+            round_buf.append(list(itertools.islice(source, B)))
+        yield round_buf[self.process_index]
 
 
 class IterableDatasetShard:
@@ -350,6 +377,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         _drop_last: bool = False,
         _non_blocking: bool = False,
         iterable_shard: IterableDatasetShard | None = None,
+        prefetch_batches: int = 2,
     ):
         self.dataset = dataset
         self.batch_sampler = batch_sampler
@@ -364,6 +392,9 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.gradient_state = GradientState()
         self._total_batch_size = total_batch_size
         self.iteration = 0
+        self.prefetch_batches = prefetch_batches
+        self.batches_yielded = 0  # within the current epoch (stateful resume)
+        self._resume_skip = 0     # applied once by the next __iter__
 
     # -- properties mirrored from the reference -----------------------------
 
@@ -434,31 +465,129 @@ class DataLoaderShard(DataLoaderStateMixin):
             return batch
         return to_global_array(batch, self.sharding)
 
+    def _prefetched(self, it: Iterator[Any]) -> Iterator[tuple[Any, bool]]:
+        """Run dataset reads + collation on a background thread with
+        ``prefetch_batches`` of lookahead (the reference overlaps host work
+        the same way via ``MpDeviceLoaderWrapper``, ``data_loader.py:632``).
+
+        Device placement (``_place``) stays on the CONSUMER thread: the
+        global-array assembly may involve multi-device transfers, and XLA's
+        CPU collective rendezvous deadlocks (then aborts the process) when a
+        second thread's device work interleaves with in-flight collective
+        programs — all device interaction must come from one thread.
+        ``device_put`` is async anyway, so the H2D copy still overlaps
+        compute; the thread buys back the python-side read+collate time.
+
+        Yields ``(placed_batch, is_last)`` — the producer's own one-batch
+        lookahead decides ``is_last`` so end-of-dataloader still flags
+        *before* the final yield."""
+        q: queue.Queue = queue.Queue(maxsize=max(1, self.prefetch_batches))
+        stop = threading.Event()
+        SENTINEL = object()
+
+        def _produce():
+            try:
+                current = next(it, SENTINEL)
+                if current is SENTINEL:
+                    q.put((SENTINEL, None))
+                    return
+                while not stop.is_set():
+                    nxt = next(it, SENTINEL)
+                    if nxt is SENTINEL:
+                        q.put((current, True))
+                        q.put((SENTINEL, None))
+                        return
+                    q.put((current, False))
+                    current = nxt
+                q.put((SENTINEL, None))
+            except BaseException as e:  # propagate to the consumer
+                q.put((e, "error"))
+
+        worker = threading.Thread(target=_produce, daemon=True, name="dataloader-prefetch")
+        worker.start()
+        try:
+            while True:
+                item, flag = q.get()
+                if flag == "error":
+                    raise item
+                if item is SENTINEL:
+                    return
+                yield self._place(item), flag
+        finally:
+            stop.set()
+            # drain so a blocked producer put() can observe the stop flag
+            while worker.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    worker.join(timeout=0.1)
+
+    def _synchronous(self, it: Iterator[Any]) -> Iterator[tuple[Any, bool]]:
+        """No-thread fallback (``prefetch_batches=0``): same one-batch
+        lookahead as the reference ``DataLoaderShard.__iter__`` :543-576."""
+        SENTINEL = object()
+        current = next(it, SENTINEL)
+        if current is SENTINEL:
+            return
+        while True:
+            nxt = next(it, SENTINEL)
+            if nxt is SENTINEL:
+                yield self._place(current), True
+                return
+            yield self._place(current), False
+            current = nxt
+
     def __iter__(self):
         if self.rng_types:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.begin()
+        self.batches_yielded = 0
         it = self._raw_batches()
-        if self.skip_batches:
-            it = itertools.islice(it, self.skip_batches, None)
-        # one-batch lookahead: flag end_of_dataloader before yielding the last
+        skip = self.skip_batches + self._resume_skip
+        self._resume_skip = 0
+        if skip:
+            it = itertools.islice(it, skip, None)
+        use_thread = self.prefetch_batches > 0 and self._prefetch_safe
+        stream = self._prefetched(it) if use_thread else self._synchronous(it)
         try:
-            current = next(it)
-        except StopIteration:
-            self.end()
-            return
-        try:
-            while True:
-                nxt = next(it)
-                yield self._place(current)
-                current = nxt
-        except StopIteration:
-            self.end_of_dataloader = True
-            self.gradient_state._set_sync_gradients(True) if self.gradient_state.sync_with_dataloader else None
-            yield self._place(current)
+            for batch, is_last in stream:
+                if is_last:
+                    self.end_of_dataloader = True
+                    if self.gradient_state.sync_with_dataloader:
+                        self.gradient_state._set_sync_gradients(True)
+                self.batches_yielded += 1
+                yield batch
         finally:
-            self.iteration += 1
+            # Advance the epoch only on full consumption (the reference's
+            # increment sits after the loop, so a mid-epoch break leaves it
+            # untouched) — a state_dict() after a break must resume THIS
+            # epoch at batches_yielded, not skip into the next one.
+            if self.end_of_dataloader:
+                self.iteration += 1
+                self.batches_yielded = 0
             self.end()
+
+    @property
+    def _prefetch_safe(self) -> bool:
+        """Background prefetch must not run device collectives off-thread
+        (see ``_prefetched``); subclasses whose raw iterator communicates
+        (the dispatcher) disable it when multi-process."""
+        return True
+
+    # -- stateful resume (reference StatefulDataLoader support,
+    # ``data_loader.py:449``; sampler state in checkpoints :116-143) ---------
+
+    def state_dict(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "batches_yielded": self.batches_yielded,
+            "skip_batches": self.skip_batches,
+        }
+
+    def load_state_dict(self, state: dict):
+        self.iteration = state.get("iteration", 0)
+        self.set_epoch(self.iteration)
+        self._resume_skip = state.get("batches_yielded", 0)
 
 
 def to_global_array(batch, sharding):
@@ -505,6 +634,103 @@ def to_global_array(batch, sharding):
     return jax.tree.map(_put, batch)
 
 
+class DataLoaderDispatcher(DataLoaderShard):
+    """Main-process-only data fetch: process 0 reads *global* batches and
+    broadcasts them; every process then takes its slice and contributes it
+    to the global array (reference ``DataLoaderDispatcher``
+    ``data_loader.py:682``, ``_fetch_batches`` :741).
+
+    Use for IterableDatasets whose stream only exists on one host (web
+    datasets, queues) — the sampler never shards, so non-main processes
+    need no dataset access at all.
+    """
+
+    def __init__(self, *args, even_batches: bool = True, slice_fn=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.even_batches = even_batches
+        self.slice_fn = slice_fn  # reference slice_fn_for_dispatch hook
+
+    @property
+    def _prefetch_safe(self) -> bool:
+        # the raw iterator runs broadcast collectives — those must stay on
+        # the consumer thread when multiple processes participate
+        return PartialState().num_processes == 1
+
+    def _raw_batches(self) -> Iterator[Any]:
+        state = PartialState()
+        if state.num_processes == 1:
+            yield from super()._raw_batches()
+            return
+        from . import operations as ops
+
+        if state.is_main_process:
+            it = super()._raw_batches()
+            while True:
+                batch = next(it, None)
+                has_more = ops.broadcast_object_list([batch is not None])[0]
+                if not has_more:
+                    return
+                yield ops.broadcast_object_list([batch])[0]
+        else:
+            while True:
+                has_more = ops.broadcast_object_list([None])[0]
+                if not has_more:
+                    return
+                yield ops.broadcast_object_list([None])[0]
+
+    def _place(self, batch):
+        """Slice this process's rows out of the broadcast global batch, then
+        assemble the global array. With ``even_batches`` (default) uneven
+        tails are padded by wrapping to the batch start; with
+        ``even_batches=False`` the tail is split unevenly (host mode only —
+        a global array needs equal shards)."""
+        state = PartialState()
+        n, i = state.num_processes, state.process_index
+
+        if self.slice_fn is not None and n > 1:
+            local = self.slice_fn(batch, n, i)
+            return local if self.sharding is None else to_global_array(local, self.sharding)
+
+        def _pad(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % n != 0:
+                pad = n - (x.shape[0] % n)
+                reps = int(np.ceil(pad / max(x.shape[0], 1)))
+                filler = np.concatenate([np.asarray(x)] * reps)[:pad]
+                return np.concatenate([np.asarray(x), filler])
+            return x
+
+        def _slice(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % n == 0:
+                sh = x.shape[0] // n
+                return x[i * sh : (i + 1) * sh]
+            return x
+
+        def _slice_uneven(x):
+            if hasattr(x, "ndim") and x.ndim >= 1:
+                return np.array_split(np.asarray(x), n)[i]
+            return x
+
+        if not self.even_batches and n > 1:
+            uneven = any(
+                hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % n != 0
+                for x in jax.tree.leaves(batch)
+            )
+            if uneven:
+                if self.sharding is not None:
+                    raise ValueError(
+                        "even_batches=False with an uneven tail cannot form a "
+                        "global mesh array; use put_on_device=False or keep "
+                        "even_batches=True"
+                    )
+                return jax.tree.map(_slice_uneven, batch)
+
+        batch = jax.tree.map(_pad, batch) if self.even_batches else batch
+        local = jax.tree.map(_slice, batch) if n > 1 else batch
+        if self.sharding is None:
+            return local
+        return to_global_array(local, self.sharding)
+
+
 # ---------------------------------------------------------------------------
 # prepare / skip
 # ---------------------------------------------------------------------------
@@ -531,10 +757,15 @@ def prepare_data_loader(
     non_blocking: bool = False,
     use_stateful_dataloader: bool = False,
     sharding=None,
+    prefetch_batches: int = 2,
 ) -> DataLoaderShard:
     """Build the sharded, device-placing loader (reference decision tree at
     ``data_loader.py:932-1181``). Accepts a native loader, a torch
-    DataLoader (rebuilt, torch stays optional), or a bare dataset."""
+    DataLoader (rebuilt, torch stays optional), or a bare dataset.
+
+    ``dispatch_batches=True`` routes through :class:`DataLoaderDispatcher`
+    (process 0 fetches global batches, everyone slices); the default is
+    per-process sharded sampling."""
     state = PartialState()
     num_processes = num_processes if num_processes is not None else state.num_processes
     process_index = process_index if process_index is not None else state.process_index
@@ -562,6 +793,38 @@ def prepare_data_loader(
 
     is_iterable = not hasattr(dataset, "__getitem__") and hasattr(dataset, "__iter__")
 
+    if dispatch_batches:
+        # process 0 reads GLOBAL batches; the sampler never shards
+        global_bs = (batch_size or 1) * (1 if split_batches else num_processes)
+        if is_iterable:
+            shard = IterableDatasetShard(
+                dataset, batch_size=global_bs, drop_last=drop_last,
+                num_processes=1, process_index=0, split_batches=False,
+            )
+            return DataLoaderDispatcher(
+                dataset, collate_fn=collate_fn,
+                sharding=sharding if put_on_device else None,
+                rng_types=rng_types, _drop_last=drop_last,
+                total_batch_size=global_bs, iterable_shard=shard,
+                prefetch_batches=prefetch_batches,
+                even_batches=even_batches, slice_fn=slice_fn_for_dispatch,
+            )
+        sampler_n = len(dataset)
+        if use_seedable_sampler:
+            inner = SeedableRandomSampler(sampler_n, seed=data_seed)
+        else:
+            inner = SequentialSampler(sampler_n)
+        return DataLoaderDispatcher(
+            dataset,
+            batch_sampler=BatchSampler(inner, batch_size=global_bs, drop_last=drop_last),
+            collate_fn=collate_fn,
+            sharding=sharding if put_on_device else None,
+            rng_types=rng_types, _drop_last=drop_last,
+            total_batch_size=global_bs,
+            prefetch_batches=prefetch_batches,
+            even_batches=even_batches, slice_fn=slice_fn_for_dispatch,
+        )
+
     if is_iterable:
         shard = IterableDatasetShard(
             dataset,
@@ -579,6 +842,7 @@ def prepare_data_loader(
             _drop_last=drop_last,
             total_batch_size=(batch_size or 1) * (1 if split_batches else num_processes),
             iterable_shard=shard,
+            prefetch_batches=prefetch_batches,
         )
 
     n = len(dataset)
@@ -616,6 +880,7 @@ def prepare_data_loader(
         sharding=sharding if put_on_device else None,
         rng_types=rng_types,
         _drop_last=drop_last,
+        prefetch_batches=prefetch_batches,
     )
 
 
@@ -681,8 +946,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
     if batch_sampler is not None:
         batch_sampler = SkipBatchSampler(batch_sampler, skip_batches=num_batches)
         skip = 0
-    return SkipDataLoader(
-        dataloader.dataset,
+    kwargs = dict(
         batch_sampler=batch_sampler,
         collate_fn=dataloader.collate_fn,
         sharding=dataloader.sharding,
@@ -692,4 +956,14 @@ def skip_first_batches(dataloader, num_batches: int = 0):
         total_batch_size=total_bs,
         _drop_last=dataloader._drop_last,
         iterable_shard=dataloader.iterable_shard,
+        prefetch_batches=dataloader.prefetch_batches,
     )
+    if isinstance(dataloader, DataLoaderDispatcher):
+        # preserve main-process-only fetch + per-process slicing semantics
+        return DataLoaderDispatcher(
+            dataloader.dataset,
+            even_batches=dataloader.even_batches,
+            slice_fn=dataloader.slice_fn,
+            **kwargs,
+        )
+    return SkipDataLoader(dataloader.dataset, **kwargs)

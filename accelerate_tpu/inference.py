@@ -1,0 +1,268 @@
+"""Pipeline-parallel inference: the ``prepare_pippy`` equivalent.
+
+Reference: ``/root/reference/src/accelerate/inference.py:31-184`` — PiPPy
+splits a torch module at layer boundaries, builds one ``PipelineStage`` per
+process and runs a GPipe schedule (rank 0 feeds microbatches, the last rank
+holds the output).
+
+TPU-native design: models already expose a **segment plan**
+(``model.segments`` — the same plan the streaming offload executor uses, see
+``big_modeling.py``), so stage construction is a *partition of the segment
+list*: contiguous groups balanced by parameter bytes, one group per device.
+Each stage's params are committed to its device; one jitted fn per stage
+runs that group's segments back-to-back. GPipe microbatching falls out of
+XLA's async dispatch — microbatch m on stage s and microbatch m+1 on stage
+s-1 execute concurrently because dispatch never blocks; device-to-device
+carries ride ``jax.device_put``.
+
+Single-host scope (one process drives all local chips) — the multi-host
+scale-out path on TPU is GSPMD sharding, not pipeline stages (SURVEY §2.2:
+"PP is the lowest-priority strategy on TPU").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import get_logger
+from .modules import Model, ModelOutput
+
+logger = get_logger(__name__)
+
+
+def find_pippy_batch_size(args, kwargs):
+    """(Reference ``find_pippy_batch_size`` ``inference.py:58``.)"""
+    for value in list(args or ()) + list((kwargs or {}).values()):
+        for leaf in jax.tree.leaves(value):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1:
+                return leaf.shape[0]
+    return None
+
+
+def _param_bytes(leaf) -> int:
+    size = int(np.prod(leaf.shape)) if leaf.shape else 1
+    return size * jnp.dtype(leaf.dtype).itemsize
+
+
+def generate_stage_map(steps, flat_params, num_stages: int) -> list[int]:
+    """Balanced contiguous partition of segment steps into ``num_stages``
+    groups by parameter bytes (reference ``generate_device_map``
+    ``inference.py:31`` does the same via ``infer_auto_device_map``).
+    Returns the first step index of each stage."""
+    weights = []
+    for name, paths, _fn in steps:
+        w = 0
+        for entry in paths:
+            p = entry[0] if isinstance(entry, tuple) else entry
+            leaf = flat_params.get(p)
+            if leaf is not None:
+                w += _param_bytes(leaf) // (
+                    leaf.shape[0] if isinstance(entry, tuple) and leaf.shape else 1
+                )
+        weights.append(max(w, 1))
+    total = sum(weights)
+    target = total / num_stages
+    bounds = [0]
+    acc = 0
+    for i, w in enumerate(weights):
+        acc += w
+        if acc >= target * len(bounds) and len(bounds) < num_stages and i + 1 < len(steps):
+            bounds.append(i + 1)
+    while len(bounds) < num_stages:  # degenerate: fewer steps than stages
+        bounds.append(len(steps))
+    return bounds
+
+
+class PipelinedModel:
+    """Callable over pipeline stages; mirrors the wrapped-forward contract
+    of the reference (``model.forward`` swapped, ``inference.py:165-180``)."""
+
+    def __init__(self, model: Model, num_chunks: int, devices, split_points):
+        self._model = model
+        self.num_chunks = num_chunks
+        self.devices = list(devices)
+        self.hf_split_points = split_points  # reference-compatible attr
+        self._stage_params: list[dict] = []
+        self._stage_fns: list = []
+        self._stage_steps: list = []
+
+    # -- stage construction (called by prepare_pippy) -----------------------
+
+    def _build(self, plan_factory, flat_params, bounds):
+        self._plan_factory = plan_factory
+        self._bounds = bounds
+        # params per stage, committed to the stage's device
+        steps = self._example_plan["steps"]
+        for s in range(len(self.devices)):
+            lo = bounds[s]
+            hi = bounds[s + 1] if s + 1 < len(bounds) else len(steps)
+            needed = {}
+            for name, paths, _fn in steps[lo:hi]:
+                for entry in paths:
+                    # a (path, i) entry addresses layer i of a stacked leaf —
+                    # only that slice lives on this stage's device
+                    p, idx = entry if isinstance(entry, tuple) else (entry, None)
+                    key = p if idx is None else f"{p}.{idx}"
+                    if key not in needed:
+                        value = flat_params[p] if idx is None else flat_params[p][idx]
+                        needed[key] = jax.device_put(value, self.devices[s])
+            self._stage_params.append(needed)
+            self._stage_steps.append((lo, hi))
+            self._stage_fns.append(None)
+
+    def _stage_fn(self, s, steps):
+        if self._stage_fns[s] is None:
+            lo, hi = self._stage_steps[s]
+            fns = [fn for _, _, fn in steps[lo:hi]]
+            paths_per = [paths for _, paths, _ in steps[lo:hi]]
+
+            def run_stage(stage_params, carry):
+                for fn, paths in zip(fns, paths_per):
+                    seg = {}
+                    for entry in paths:
+                        p, idx = entry if isinstance(entry, tuple) else (entry, None)
+                        seg[p] = stage_params[p if idx is None else f"{p}.{idx}"]
+                    carry = fn(seg, carry)
+                return carry
+
+            self._stage_fns[s] = jax.jit(run_stage)
+        return self._stage_fns[s]
+
+    # -- forward -------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        batch = find_pippy_batch_size(args, kwargs)
+        if batch is None:
+            raise ValueError("Could not find batch size from args or kwargs")
+        chunks = min(self.num_chunks, batch)
+        pad = (-batch) % chunks
+        if pad:  # wraparound padding so every microbatch is equal-sized
+            args = jax.tree.map(lambda x: _pad0(x, batch, pad), args)
+            kwargs = jax.tree.map(lambda x: _pad0(x, batch, pad), kwargs)
+        mb = (batch + pad) // chunks
+
+        outputs = []
+        for m in range(chunks):
+            sl = slice(m * mb, (m + 1) * mb)
+            mb_args = jax.tree.map(lambda x: _slice0(x, sl, batch + pad), args)
+            mb_kwargs = jax.tree.map(lambda x: _slice0(x, sl, batch + pad), kwargs)
+            plan = self._plan_factory(*mb_args, **mb_kwargs)
+            steps = plan["steps"]
+            carry = plan["init"]()
+            for s in range(len(self.devices)):
+                carry = jax.device_put(carry, self.devices[s])
+                carry = self._stage_fn(s, steps)(self._stage_params[s], carry)
+            outputs.append(plan["finalize"](carry))
+        out_cls = type(outputs[0]) if type(outputs[0]) is not dict and isinstance(outputs[0], dict) else None
+        plain = [dict(o) if out_cls else o for o in outputs]  # ModelOutput isn't a pytree
+        # scalars (a loss) average over chunks weighted by REAL rows, so the
+        # wraparound-padded tail chunk doesn't get full weight. (Padded rows
+        # inside that chunk still enter its internal mean — pass
+        # chunk-divisible batches for exact scalar parity.)
+        real = jnp.asarray(
+            [max(0, min(mb, batch - m * mb)) for m in range(chunks)], jnp.float32
+        )
+        weights = real / jnp.sum(real)
+
+        def _merge(*xs):
+            if jnp.ndim(xs[0]):
+                return jnp.concatenate(xs, axis=0)
+            return jnp.sum(jnp.stack(xs) * weights)
+
+        out = jax.tree.map(_merge, *plain)
+        if pad:
+            out = jax.tree.map(lambda x: x[:batch] if hasattr(x, "ndim") and x.ndim else x, out)
+        if out_cls is not None:
+            out = out_cls(out)
+        return out
+
+    forward = __call__
+
+    def unwrap(self):
+        return self._model
+
+
+def _pad0(x, batch, pad):
+    if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == batch:
+        reps = int(math.ceil(pad / x.shape[0]))
+        filler = jnp.concatenate([x] * reps, axis=0)[:pad]
+        return jnp.concatenate([x, filler], axis=0)
+    return x
+
+
+def _slice0(x, sl, padded_batch):
+    if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == padded_batch:
+        return x[sl]
+    return x
+
+
+def prepare_pippy(
+    model: Model,
+    split_points: str | list = "auto",
+    no_split_module_classes=None,
+    example_args: tuple = (),
+    example_kwargs: dict | None = None,
+    num_chunks: int | None = None,
+    gather_output: bool = False,
+    devices=None,
+):
+    """Wrap ``model`` for pipeline-parallel inference (reference
+    ``prepare_pippy`` ``inference.py:124``; same signature, plus ``devices``
+    to pin the stage list).
+
+    ``split_points='auto'`` balances the model's segment plan across the
+    devices by parameter bytes; pass a list of segment names to split
+    explicitly. ``gather_output`` is accepted for parity — on a single host
+    every returned ``jax.Array`` is already addressable from the caller.
+    """
+    segments = getattr(model, "segments", None)
+    if segments is None:
+        raise ValueError(
+            "prepare_pippy needs a model with a segment plan (model.segments); "
+            "zoo models provide one"
+        )
+    devices = list(devices) if devices is not None else jax.local_devices()
+    example_kwargs = example_kwargs or {}
+    if num_chunks is None:
+        num_chunks = len(devices)
+
+    plan = segments(*example_args, **example_kwargs) if callable(segments) else segments
+    steps = plan["steps"]
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(model.params)[0]:
+        key = ".".join(_ppart(p) for p in path)
+        flat[key] = leaf
+
+    if split_points == "auto":
+        bounds = generate_stage_map(steps, flat, len(devices))
+    else:
+        names = [n if isinstance(n, str) else n[0] for n, _, _ in steps]
+        bounds = [0] + [names.index(sp) for sp in split_points]
+        if len(bounds) > len(devices):
+            raise ValueError(f"{len(bounds)} stages but only {len(devices)} devices")
+    split_names = []
+    for b in bounds[1:]:
+        n = steps[b][0]
+        split_names.append(n if isinstance(n, str) else n[0])
+
+    wrapped = PipelinedModel(model, num_chunks, devices[: len(bounds)], split_names)
+    wrapped._example_plan = plan
+    wrapped._build(segments if callable(segments) else (lambda *a, **k: segments), flat, bounds)
+    logger.info(
+        "pipeline stages at %s over %d devices", split_names, len(wrapped.devices)
+    )
+    return wrapped
+
+
+def _ppart(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(getattr(p, "name", p))

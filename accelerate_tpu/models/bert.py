@@ -1,0 +1,191 @@
+"""BERT-style bidirectional encoder for sequence(-pair) classification.
+
+The examples' model (BASELINE config #1 is BERT-base on GLUE/MRPC via the
+reference's ``examples/nlp_example.py``; the reference itself pulls the
+model from transformers — this zero-egress build ships its own). TPU-first
+design, same recipe as :mod:`.llama`:
+
+* layer-stacked params + ``lax.scan`` — one compiled block program;
+* bidirectional (non-causal) attention through :func:`ops.attention`, so
+  the flash kernel / context parallelism route the same way as the LMs;
+* learned absolute position + token-type embeddings (sentence pairs);
+* ``[CLS]``-token pooling + linear head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..modules import Model, ModelOutput
+from ..ops.attention import attention
+from ..ops.layers import rms_norm
+from .llama import _constrain
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    norm_eps: float = 1e-12
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, vocab_size=512, hidden_size=64, layers=2, heads=4, seq=64, num_labels=2):
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=hidden_size,
+            intermediate_size=hidden_size * 4,
+            num_hidden_layers=layers,
+            num_attention_heads=heads,
+            max_position_embeddings=seq,
+            num_labels=num_labels,
+        )
+
+
+BERT_PARTITION_RULES = [
+    (r"embed_tokens", P("tp", "fsdp")),
+    (r"embed_positions", P(None, "fsdp")),
+    (r"embed_types", P(None, "fsdp")),
+    (r"layers\.(wq|wk|wv)", P(None, "fsdp", "tp")),
+    (r"layers\.wo", P(None, "tp", "fsdp")),
+    (r"layers\.w_in", P(None, "fsdp", "tp")),
+    (r"layers\.w_out", P(None, "tp", "fsdp")),
+    (r"norm", P()),
+    (r"classifier\.w", P("fsdp", None)),
+    (r"classifier\.b", P()),
+]
+
+
+def init_bert_params(key: jax.Array, config: BertConfig, dtype=jnp.float32):
+    c = config
+    h, ff, L = c.hidden_size, c.intermediate_size, c.num_hidden_layers
+    keys = jax.random.split(key, 12)
+
+    def dense(k, *shape, in_dim):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) / np.sqrt(in_dim)).astype(dtype)
+
+    return {
+        "embed_tokens": (jax.random.normal(keys[0], (c.vocab_size, h)) * 0.02).astype(dtype),
+        "embed_positions": (jax.random.normal(keys[1], (c.max_position_embeddings, h)) * 0.02).astype(dtype),
+        "embed_types": (jax.random.normal(keys[2], (c.type_vocab_size, h)) * 0.02).astype(dtype),
+        "emb_norm": jnp.ones((h,), dtype=dtype),
+        "layers": {
+            "wq": dense(keys[3], L, h, h, in_dim=h),
+            "wk": dense(keys[4], L, h, h, in_dim=h),
+            "wv": dense(keys[5], L, h, h, in_dim=h),
+            "wo": dense(keys[6], L, h, h, in_dim=h),
+            "w_in": dense(keys[7], L, h, ff, in_dim=h),
+            "w_out": dense(keys[8], L, ff, h, in_dim=ff),
+            "attn_norm": jnp.ones((L, h), dtype=dtype),
+            "mlp_norm": jnp.ones((L, h), dtype=dtype),
+        },
+        "norm": jnp.ones((h,), dtype=dtype),
+        "classifier": {
+            "w": dense(keys[9], h, c.num_labels, in_dim=h),
+            "b": jnp.zeros((c.num_labels,), dtype=dtype),
+        },
+    }
+
+
+def _bert_block(config: BertConfig, attention_mask):
+    c = config
+    nh, hd = c.num_attention_heads, c.head_dim
+
+    def body(x, layer):
+        b, s, h = x.shape
+        y = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = (y @ layer["wq"]).reshape(b, s, nh, hd)
+        k = (y @ layer["wk"]).reshape(b, s, nh, hd)
+        v = (y @ layer["wv"]).reshape(b, s, nh, hd)
+        q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
+        k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
+        attn = attention(q, k, v, segment_mask=attention_mask, causal=False)
+        x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+        y = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        x = x + jax.nn.gelu(y @ layer["w_in"]) @ layer["w_out"]
+        return _constrain(x, P(("dp", "fsdp"), "cp", None)), None
+
+    if c.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    return body
+
+
+def bert_apply(
+    config: BertConfig,
+    params,
+    input_ids: jax.Array,                      # [b, s] int32
+    attention_mask: jax.Array | None = None,   # [b, s] 1 = real token
+    token_type_ids: jax.Array | None = None,   # [b, s] sentence-pair segments
+    labels: jax.Array | None = None,           # [b] class index
+):
+    c = config
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
+
+    pos = jnp.arange(s, dtype=jnp.int32)
+    x = (
+        params["embed_tokens"][input_ids]
+        + params["embed_positions"][pos][None, :, :]
+        + params["embed_types"][token_type_ids]
+    )
+    x = rms_norm(x, params["emb_norm"], c.norm_eps)
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+
+    x, _ = jax.lax.scan(_bert_block(c, attention_mask), x, params["layers"])
+    x = rms_norm(x, params["norm"], c.norm_eps)
+
+    pooled = x[:, 0, :]  # [CLS]
+    logits = pooled @ params["classifier"]["w"] + params["classifier"]["b"]
+
+    out = ModelOutput(logits=logits)
+    if labels is not None:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        out["loss"] = -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+        )
+    return out
+
+
+class BertForSequenceClassification:
+    """Factory mirroring :class:`LlamaForCausalLM`'s interface."""
+
+    @staticmethod
+    def from_config(config: BertConfig, seed: int = 0, dtype=jnp.float32) -> Model:
+        from ..big_modeling import is_empty_init
+
+        if is_empty_init():
+            params = jax.eval_shape(
+                lambda k: init_bert_params(k, config, dtype=dtype), jax.random.key(0)
+            )
+        else:
+            params = init_bert_params(jax.random.key(seed), config, dtype=dtype)
+
+        def apply_fn(p, **kwargs):
+            return bert_apply(config, p, **kwargs)
+
+        model = Model(
+            apply_fn, params,
+            partition_rules=BERT_PARTITION_RULES,
+            name="BertForSequenceClassification",
+        )
+        model.config = config
+        return model

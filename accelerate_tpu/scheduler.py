@@ -47,11 +47,14 @@ class AcceleratedScheduler:
         if self.split_batches:
             self._advance(1)
         else:
-            # reference semantics: one scheduler step per data-parallel rank.
-            # The torch world size maps to the mesh's data-parallel degree
-            # (dp×fsdp axes), not the host-process count.
+            # reference semantics (``scheduler.py:73-82``): ×num_processes per
+            # step, because each *process* only sees 1/num_processes of the
+            # batches. Here the loop consumes GLOBAL batches — sub-host mesh
+            # parallelism (dp×fsdp) never hides batches from the loop — so the
+            # multiplier is the host-process count, under which each host's
+            # loader really does yield len/num_processes batches.
             state = AcceleratorState()
-            num = state.data_parallel_size if state.initialized else 1
+            num = state.num_processes if state.initialized else 1
             self._advance(num)
 
     def _advance(self, n: int):

@@ -1,0 +1,74 @@
+"""Example-freshness comparison helpers.
+
+Reference: ``/root/reference/src/accelerate/test_utils/examples.py:26-146``
+strips comments/docstrings from example scripts and asserts each
+``by_feature/*`` script differs from the ``complete_*`` template only in
+its one feature. Same contract here: by_feature scripts must stay small
+deltas over the canonical loop, so the examples never drift apart.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+
+def significant_lines(path: str) -> list[str]:
+    """Source lines that matter for comparison: docstrings, comments, blank
+    lines and import-path noise stripped; whitespace normalised."""
+    with open(path) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    doc_ranges = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if (
+                node.body
+                and isinstance(node.body[0], ast.Expr)
+                and isinstance(node.body[0].value, ast.Constant)
+                and isinstance(node.body[0].value.value, str)
+            ):
+                doc_ranges.append((node.body[0].lineno, node.body[0].end_lineno))
+
+    out = []
+    for i, raw in enumerate(source.splitlines(), start=1):
+        if any(lo <= i <= hi for lo, hi in doc_ranges):
+            continue
+        line = raw.split("#")[0].strip()
+        if not line:
+            continue
+        out.append(" ".join(line.split()))
+    return out
+
+
+def novel_lines(feature_script: str, base_scripts: list[str]) -> list[str]:
+    """Lines in ``feature_script`` that appear in none of ``base_scripts`` —
+    the script's feature delta."""
+    base: set[str] = set()
+    for b in base_scripts:
+        base.update(significant_lines(b))
+    return [l for l in significant_lines(feature_script) if l not in base]
+
+
+def assert_single_feature_delta(
+    feature_script: str,
+    base_scripts: list[str],
+    required_markers: list[str],
+    max_novel: int = 45,
+):
+    """The by_feature contract: small delta over the canonical loop, and the
+    delta actually contains the feature (reference ``ExampleDifferenceTests``
+    semantics)."""
+    delta = novel_lines(feature_script, base_scripts)
+    name = os.path.basename(feature_script)
+    if len(delta) > max_novel:
+        raise AssertionError(
+            f"{name} diverged from the canonical loop: {len(delta)} novel lines "
+            f"(max {max_novel}); first few: {delta[:5]}"
+        )
+    joined = "\n".join(delta)
+    missing = [m for m in required_markers if m not in joined]
+    if missing:
+        raise AssertionError(
+            f"{name} is missing its feature markers {missing} in the delta"
+        )

@@ -408,6 +408,7 @@ class DataLoaderConfiguration(KwargsHandler):
     use_seedable_sampler: bool = False
     non_blocking: bool = False
     use_stateful_dataloader: bool = False
+    prefetch_batches: int = 2  # background collate+H2D lookahead depth (0 = sync)
 
 
 def add_model_config_to_megatron_parser(*a, **k):  # pragma: no cover

@@ -1,0 +1,91 @@
+"""Feature: checkpoint/resume (reference
+``examples/by_feature/checkpointing.py``) — ``save_state`` every epoch with
+``ProjectConfiguration`` rotation, ``load_state`` + ``skip_first_batches``
+to resume mid-run."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir=args.output_dir, automatic_checkpoint_naming=True, total_limit=3
+        ),
+    )
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    metric = PairMetric()
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader
+    )
+
+    starting_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resumed from checkpoint: {args.resume_from_checkpoint}")
+        accelerator.load_state(args.resume_from_checkpoint)
+        starting_epoch = int(args.resume_epoch)
+
+    for epoch in range(starting_epoch, num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        # one rotated checkpoint per epoch: checkpoints/checkpoint_<i>
+        accelerator.save_state()
+
+        model.eval()
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}:", eval_metric)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Checkpoint/resume example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--output_dir", type=str, default=".")
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--resume_epoch", type=int, default=0)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Feature: cross-process early stopping (reference
+``examples/by_feature/early_stopping.py``) — any process can
+``set_trigger()``; ``check_trigger()`` is a collective that returns True
+everywhere, so all ranks break together."""
+
+import argparse
+import sys, os
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+
+class EarlyStoppingCallback:
+    def __init__(self, threshold: float = 0.2, patience: int = 3):
+        self.threshold = threshold
+        self.patience = patience
+        self.count = 0
+
+    def check_early_stopping(self, eval_loss: float) -> bool:
+        self.count = self.count + 1 if eval_loss < self.threshold else 0
+        return self.count >= self.patience
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    callback = EarlyStoppingCallback(threshold=args.loss_threshold)
+
+    set_seed(seed)
+    train_dataloader, _, tokenizer = get_dataloaders(accelerator, batch_size)
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader
+    )
+
+    stopped_at = None
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+
+            # local decision → global flag: if ANY process trips the
+            # callback, every process sees check_trigger() == True
+            if callback.check_early_stopping(float(output.loss.item())):
+                accelerator.set_trigger()
+            if accelerator.check_trigger():
+                stopped_at = (epoch, step)
+                break
+        if stopped_at is not None:
+            break
+
+    accelerator.print(f"early stop at {stopped_at}" if stopped_at else "ran to completion")
+    accelerator.end_training()
+    return stopped_at
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Early-stopping example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--loss_threshold", type=float, default=0.2)
+    parser.add_argument("--num_epochs", type=int, default=5)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Feature: gradient accumulation (reference
+``examples/by_feature/gradient_accumulation.py``) — pass
+``gradient_accumulation_steps`` to the Accelerator and wrap the step in
+``accelerator.accumulate(model)``; the framework fuses the microbatch
+gradient sum into the compiled step."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    gradient_accumulation_steps = int(args.gradient_accumulation_steps)
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+    )
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    metric = PairMetric()
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            # the accumulate context owns the sync/no-sync bookkeeping — no
+            # manual `step % accumulation == 0` check needed
+            with accelerator.accumulate(model):
+                output = model(**batch)
+                accelerator.backward(output.loss)
+                optimizer.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}:", eval_metric)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Gradient-accumulation example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

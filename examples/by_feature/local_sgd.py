@@ -1,0 +1,65 @@
+"""Feature: Local SGD (reference ``examples/by_feature/local_sgd.py``) —
+each data-parallel replica takes K independent optimizer steps with zero
+cross-replica traffic; parameters are averaged every ``local_sgd_steps``."""
+
+import argparse
+import sys, os
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator, LocalSGD
+from accelerate_tpu.utils.random import set_seed
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+
+    set_seed(seed)
+    train_dataloader, _, tokenizer = get_dataloaders(accelerator, batch_size)
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader
+    )
+
+    last_loss = None
+    with LocalSGD(
+        accelerator=accelerator, model=model,
+        local_sgd_steps=int(args.local_sgd_steps), enabled=args.local_sgd_steps > 0,
+    ) as local_sgd:
+        for epoch in range(num_epochs):
+            model.train()
+            train_dataloader.set_epoch(epoch)
+            for step, batch in enumerate(train_dataloader):
+                output = model(**batch)
+                accelerator.backward(output.loss)
+                optimizer.step()
+                optimizer.zero_grad()
+                # count one local update; averages on every K-th call
+                local_sgd.step()
+                last_loss = float(output.loss.item())
+
+    accelerator.print(f"final loss {last_loss:.4f}")
+    accelerator.end_training()
+    return last_loss
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Local SGD example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--local_sgd_steps", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Feature: automatic OOM recovery (reference
+``examples/by_feature/memory.py``) — decorate the inner loop with
+``find_executable_batch_size``; on RESOURCE_EXHAUSTED the batch size halves
+and the loop restarts."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator, find_executable_batch_size
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, observed_batch_sizes = int(config["seed"]), []
+    metric = PairMetric()
+
+    @find_executable_batch_size(starting_batch_size=int(config["batch_size"]))
+    def inner_training_loop(batch_size):
+        # everything that depends on batch size lives INSIDE the decorated fn
+        # so a retry rebuilds it from scratch
+        observed_batch_sizes.append(batch_size)
+        accelerator.free_memory()
+        set_seed(seed)
+        train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+            accelerator, batch_size, EVAL_BATCH_SIZE
+        )
+        model = build_model(tokenizer, seed=seed)
+        optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optimizer, train_dataloader, eval_dataloader
+        )
+
+        for epoch in range(num_epochs):
+            model.train()
+            train_dl.set_epoch(epoch)
+            for step, batch in enumerate(train_dl):
+                output = model(**batch)
+                accelerator.backward(output.loss)
+                optimizer.step()
+                optimizer.zero_grad()
+
+            model.eval()
+            for step, batch in enumerate(eval_dl):
+                outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+                predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+                predictions, references = accelerator.gather_for_metrics(
+                    (predictions, batch["labels"])
+                )
+                metric.add_batch(predictions=predictions, references=references)
+
+            eval_metric = metric.compute()
+            accelerator.print(f"epoch {epoch}:", eval_metric)
+        return eval_metric
+
+    eval_metric = inner_training_loop()
+    accelerator.print("ran with batch sizes:", observed_batch_sizes)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Auto batch-size-halving example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

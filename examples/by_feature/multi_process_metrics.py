@@ -1,0 +1,80 @@
+"""Feature: exact distributed metrics (reference
+``examples/by_feature/multi_process_metrics.py``) — ``gather_for_metrics``
+gathers predictions from every data shard AND drops the wraparound padding
+the even-batches schedule added, so metric counts match the dataset
+exactly."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 24  # deliberately does NOT divide the 160-example dev set
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    metric = PairMetric()
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    eval_size = len(eval_dataloader.dataset)
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        model.eval()
+        samples_seen = 0
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            # gather_for_metrics de-duplicates the padded tail on the last
+            # batch — samples_seen must land exactly on the dataset size
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            samples_seen += len(np.asarray(references))
+            metric.add_batch(predictions=predictions, references=references)
+
+        assert samples_seen == eval_size, (samples_seen, eval_size)
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}: exact over {samples_seen} samples:", eval_metric)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Exact distributed metrics example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Feature: profiling (reference ``examples/by_feature/profiler.py``) —
+wrap training steps in ``accelerator.profile`` to capture a device trace
+(TensorBoard/Perfetto-compatible, via ``jax.profiler``)."""
+
+import argparse
+import sys, os
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.dataclasses import ProfileKwargs
+from accelerate_tpu.utils.random import set_seed
+
+
+def training_function(config, args):
+    profile_kwargs = ProfileKwargs(
+        output_trace_dir=args.trace_dir,
+        record_shapes=True,
+    )
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        kwargs_handlers=[profile_kwargs],
+    )
+    lr, seed, batch_size = config["lr"], int(config["seed"]), int(config["batch_size"])
+
+    set_seed(seed)
+    train_dataloader, _, tokenizer = get_dataloaders(accelerator, batch_size)
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader
+    )
+
+    model.train()
+    with accelerator.profile() as prof:
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+            if step >= int(args.profile_steps):
+                break
+
+    accelerator.print(f"trace written under {args.trace_dir}")
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Profiler example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--trace_dir", type=str, default="/tmp/accelerate_tpu_trace")
+    parser.add_argument("--profile_steps", type=int, default=4)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": 1, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

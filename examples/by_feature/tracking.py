@@ -1,0 +1,97 @@
+"""Feature: experiment tracking (reference
+``examples/by_feature/tracking.py``) — ``log_with=`` + ``init_trackers`` /
+``log`` / ``end_training``; trackers only run on the main process."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        log_with=args.log_with,
+        project_dir=args.project_dir,
+    )
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    metric = PairMetric()
+
+    # hyperparameters land in every tracker's run config
+    accelerator.init_trackers("accelerate_tpu_tracking_example", config)
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader
+    )
+
+    overall_step = 0
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        total_loss = 0.0
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+            total_loss += float(output.loss.item())
+            overall_step += 1
+
+        model.eval()
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}:", eval_metric)
+        accelerator.log(
+            {
+                "accuracy": eval_metric["accuracy"],
+                "f1": eval_metric["f1"],
+                "train_loss": total_loss / max(step + 1, 1),
+                "epoch": epoch,
+            },
+            step=overall_step,
+        )
+
+    accelerator.end_training()  # closes every tracker
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Tracking example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--log_with", type=str, default="tensorboard",
+                        help="tracker name or 'all'")
+    parser.add_argument("--project_dir", type=str, default="/tmp/accelerate_tpu_tracking")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

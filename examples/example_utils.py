@@ -1,0 +1,139 @@
+"""Shared helpers for the examples: offline tokenizer, dataset, metric.
+
+The reference examples lean on transformers/datasets/evaluate from the Hub
+(``/root/reference/examples/nlp_example.py:47-111``); this zero-egress build
+vendors the equivalents — a whitespace word-piece vocabulary built from the
+shipped CSVs, fixed-length padding (the reference pads to 128 on XLA for
+static shapes, :81-84), and an accuracy+F1 metric matching
+``evaluate.load("glue", "mrpc")``'s output keys.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+PAD, CLS, SEP, UNK = 0, 1, 2, 3
+MAX_LENGTH = 48  # static shapes: always pad to full length on TPU
+
+
+def read_split(name: str):
+    rows = []
+    with open(os.path.join(DATA_DIR, f"{name}.csv"), newline="") as f:
+        for row in csv.DictReader(f):
+            rows.append(
+                (int(row["label"] == "equivalent"), row["sentence1"], row["sentence2"])
+            )
+    return rows
+
+
+class WordTokenizer:
+    """Deterministic whitespace vocabulary over the training split."""
+
+    def __init__(self, rows):
+        words = sorted({w for _, s1, s2 in rows for w in (s1 + " " + s2).split()})
+        self.vocab = {w: i + 4 for i, w in enumerate(words)}  # 0..3 are specials
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + 4
+
+    def encode_pair(self, s1: str, s2: str, max_length: int = MAX_LENGTH):
+        """[CLS] s1 [SEP] s2 [SEP] with token-type ids, padded to max_length."""
+        a = [self.vocab.get(w, UNK) for w in s1.split()]
+        b = [self.vocab.get(w, UNK) for w in s2.split()]
+        ids = [CLS] + a + [SEP] + b + [SEP]
+        types = [0] * (len(a) + 2) + [1] * (len(b) + 1)
+        ids, types = ids[:max_length], types[:max_length]
+        mask = [1] * len(ids)
+        pad = max_length - len(ids)
+        return ids + [PAD] * pad, types + [0] * pad, mask + [0] * pad
+
+
+class ParaphraseDataset:
+    def __init__(self, rows, tokenizer: WordTokenizer, max_length: int = MAX_LENGTH):
+        self.examples = []
+        for label, s1, s2 in rows:
+            ids, types, mask = tokenizer.encode_pair(s1, s2, max_length)
+            self.examples.append(
+                {
+                    "input_ids": np.asarray(ids, np.int32),
+                    "token_type_ids": np.asarray(types, np.int32),
+                    "attention_mask": np.asarray(mask, np.int32),
+                    "labels": np.int32(label),
+                }
+            )
+
+    def __len__(self):
+        return len(self.examples)
+
+    def __getitem__(self, i):
+        return self.examples[i]
+
+
+class RandomSampler:
+    """Marker sampler: its type name tells prepare_data_loader to shuffle
+    (with the framework's seedable cross-process permutation)."""
+
+
+class SimpleLoader:
+    """Duck-typed loader for ``accelerator.prepare`` (dataset/batch_size/
+    drop_last/sampler/batch_sampler/collate_fn is the accepted contract)."""
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = False, drop_last: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.sampler = RandomSampler() if shuffle else None
+        self.batch_sampler = None
+        self.collate_fn = None
+
+
+def get_dataloaders(accelerator, batch_size: int = 16, eval_batch_size: int = 32):
+    """Tokenize the vendored corpus and build train/eval loaders (reference
+    ``get_dataloaders`` ``examples/nlp_example.py:47``)."""
+    train_rows = read_split("train")
+    with accelerator.main_process_first():
+        tokenizer = WordTokenizer(train_rows)
+        train = ParaphraseDataset(train_rows, tokenizer)
+        dev = ParaphraseDataset(read_split("dev"), tokenizer)
+    train_loader = SimpleLoader(train, batch_size, shuffle=True, drop_last=True)
+    eval_loader = SimpleLoader(dev, eval_batch_size)
+    return train_loader, eval_loader, tokenizer
+
+
+def build_model(tokenizer, seed: int = 42):
+    from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+    config = BertConfig.tiny(
+        vocab_size=tokenizer.vocab_size, hidden_size=128, layers=2, heads=4,
+        seq=MAX_LENGTH, num_labels=2,
+    )
+    return BertForSequenceClassification.from_config(config, seed=seed)
+
+
+class PairMetric:
+    """accuracy + F1, the keys ``evaluate.load("glue", "mrpc")`` reports."""
+
+    def __init__(self):
+        self.preds: list = []
+        self.refs: list = []
+
+    def add_batch(self, predictions, references):
+        self.preds.extend(np.asarray(predictions).reshape(-1).tolist())
+        self.refs.extend(np.asarray(references).reshape(-1).tolist())
+
+    def compute(self) -> dict:
+        p = np.asarray(self.preds)
+        r = np.asarray(self.refs)
+        self.preds, self.refs = [], []
+        tp = int(np.sum((p == 1) & (r == 1)))
+        fp = int(np.sum((p == 1) & (r == 0)))
+        fn = int(np.sum((p == 0) & (r == 1)))
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+        return {"accuracy": float(np.mean(p == r)), "f1": f1}

@@ -1,0 +1,103 @@
+"""The canonical 5-line-change training loop (BASELINE config #1).
+
+Mirrors the reference's ``examples/nlp_example.py:1-200`` — BERT-style
+encoder on a paraphrase-pair task — with the TPU-native framework: the same
+script runs unchanged on one chip, a v5e-8 data-parallel mesh, or a pod
+(``accelerate-tpu launch examples/nlp_example.py``); the vendored dataset
+replaces GLUE/MRPC (zero-egress environment, same schema).
+
+The five accelerate lines are marked with  # [accelerate].
+"""
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+from example_utils import PairMetric, build_model, get_dataloaders
+
+MAX_TPU_BATCH_SIZE = 16
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(  # [accelerate]
+        cpu=args.cpu, mixed_precision=args.mixed_precision
+    )
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    metric = PairMetric()
+
+    gradient_accumulation_steps = 1
+    if batch_size > MAX_TPU_BATCH_SIZE:
+        gradient_accumulation_steps = batch_size // MAX_TPU_BATCH_SIZE
+        batch_size = MAX_TPU_BATCH_SIZE
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    model = build_model(tokenizer, seed=seed)
+
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    num_steps = (len(train_dataloader.dataset) // batch_size) * num_epochs
+    lr_scheduler = optax.schedules.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps=20, decay_steps=max(num_steps, 21)
+    )
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = (
+        accelerator.prepare(  # [accelerate]
+            model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+        )
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            outputs = model(**batch)
+            loss = outputs.loss
+            loss = loss / gradient_accumulation_steps
+            accelerator.backward(loss)  # [accelerate]
+            if step % gradient_accumulation_steps == 0:
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(  # [accelerate]
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}:", eval_metric)  # [accelerate]
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Simple example of training script.")
+    parser.add_argument(
+        "--mixed_precision", type=str, default=None,
+        choices=["no", "fp16", "bf16", "fp8"],
+        help="Whether to use mixed precision (bf16 is the TPU-native default).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="If passed, will train on the CPU.")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

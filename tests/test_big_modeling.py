@@ -20,6 +20,7 @@ from accelerate_tpu.big_modeling import (
     load_checkpoint_in_model,
 )
 from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.modules import Model
 from accelerate_tpu.utils.memory import find_executable_batch_size, should_reduce_batch_size
 from accelerate_tpu.utils.modeling import (
     compute_module_sizes,
@@ -330,3 +331,92 @@ def test_auto_device_map_per_layer_granularity_respected(tmp_path):
     assert "cpu" in tiers and "0" in tiers
     out = loaded(**batch)
     np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# streaming pipeline: disk-read prefetch overlap + memory invariant
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_prefetches_next_segment_load_during_compute(monkeypatch):
+    """Segment i+1's host/disk load must overlap segment i's compute: wall
+    time ≈ load + N·max(load, compute), not N·(load + compute)."""
+    import time
+
+    from accelerate_tpu.big_modeling import TieredParams
+
+    N, F, C = 6, 0.04, 0.04
+    params = {f"w{i}": np.full((8,), float(i), np.float32) for i in range(N)}
+
+    def _seg_fn(i):
+        def fn(seg_params, carry):
+            # synchronous stand-in for blocking compute (pre-seeded below so
+            # the streaming loop uses it as the "compiled" segment fn)
+            time.sleep(C)
+            return carry + float(np.asarray(seg_params[f"w{i}"]).sum())
+
+        return fn
+
+    steps = [(f"s{i}", [f"w{i}"], _seg_fn(i)) for i in range(N)]
+    model = Model(lambda p: None, params, name="segmented")
+    model.segments = lambda x: {
+        "steps": steps,
+        "init": lambda: float(x),
+        "finalize": lambda c: c,
+    }
+
+    orig_fetch = TieredParams.fetch_host_or_disk
+
+    def slow_fetch(self, path, idx=None):
+        time.sleep(F)  # simulated slow disk read
+        return orig_fetch(self, path, idx)
+
+    monkeypatch.setattr(TieredParams, "fetch_host_or_disk", slow_fetch)
+    dispatched = cpu_offload(model)
+    # pre-seed the segment-fn cache: compute stays synchronous on the main
+    # thread, so wall time directly exposes whether loads overlap compute
+    dispatched._segment_fns = {f"s{i}": _seg_fn(i) for i in range(N)}
+    t0 = time.monotonic()
+    out = dispatched(0.0)
+    elapsed = time.monotonic() - t0
+    expected = sum(float(i) * 8 for i in range(N))
+    assert float(out) == expected
+    serial = N * (F + C)
+    assert elapsed < 0.8 * serial, f"no overlap: {elapsed:.3f}s vs serial {serial:.3f}s"
+
+
+def test_streaming_peak_memory_stays_below_full_model(tmp_path):
+    """Memory invariant (reference pins this in
+    benchmarks/big_model_inference/README.md:44-46): streaming a
+    disk-offloaded model must never materialise all params on device."""
+    from accelerate_tpu.big_modeling import DispatchedModel
+
+    config = LlamaConfig.tiny(layers=8, hidden_size=64)
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    total_bytes = sum(np.asarray(l).nbytes for l in jax.tree.leaves(model.params))
+    ids = np.random.default_rng(0).integers(0, 256, size=(1, 8)).astype(np.int32)
+
+    live_samples = []
+    orig = DispatchedModel._segment_params
+
+    def sampling(self, *a, **k):
+        out = orig(self, *a, **k)
+        live_samples.append(sum(x.nbytes for x in jax.live_arrays()))
+        return out
+
+    # baseline after dispatch: on the CPU backend device_get during offload
+    # pins a host-copy cache on each param array, which live_arrays counts —
+    # only arrays created during *streaming* are the invariant under test
+    dispatched = disk_offload(model, str(tmp_path))
+    baseline = sum(x.nbytes for x in jax.live_arrays())
+    try:
+        DispatchedModel._segment_params = sampling
+        dispatched(input_ids=ids)
+    finally:
+        DispatchedModel._segment_params = orig
+    peak_extra = max(live_samples) - baseline
+    # resident set at any instant: ≤2 segments of weights + activations —
+    # far below the whole model
+    assert peak_extra < 0.7 * total_bytes, (
+        f"peak {peak_extra} vs model {total_bytes}: streaming materialised too much"
+    )

@@ -1,0 +1,34 @@
+"""by_feature scripts stay single-feature deltas over the canonical loop
+(reference ``tests/test_examples.py::ExampleDifferenceTests`` via
+``test_utils/examples.py:26-146``)."""
+
+import os
+
+import pytest
+
+from accelerate_tpu.test_utils.examples import assert_single_feature_delta
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+BASES = [
+    os.path.join(EXAMPLES, "nlp_example.py"),
+    os.path.join(EXAMPLES, "complete_nlp_example.py"),
+]
+
+CASES = [
+    ("gradient_accumulation.py", ["accelerator.accumulate(model)", "gradient_accumulation_steps"]),
+    ("checkpointing.py", ["automatic_checkpoint_naming", "accelerator.save_state()"]),
+    ("memory.py", ["find_executable_batch_size"]),
+    ("profiler.py", ["accelerator.profile()", "ProfileKwargs"]),
+    ("early_stopping.py", ["accelerator.set_trigger()", "accelerator.check_trigger()"]),
+    ("local_sgd.py", ["LocalSGD", "local_sgd.step()"]),
+    ("tracking.py", ["log_with"]),
+    ("multi_process_metrics.py", ["samples_seen"]),
+]
+
+
+@pytest.mark.parametrize("script,markers", CASES, ids=[c[0] for c in CASES])
+def test_by_feature_is_single_feature_delta(script, markers):
+    assert_single_feature_delta(
+        os.path.join(EXAMPLES, "by_feature", script), BASES, markers
+    )

@@ -1,0 +1,91 @@
+"""Pipeline-parallel inference (reference ``inference.py:31-184``
+``prepare_pippy``; ``test_utils/scripts/external_deps/test_pippy.py``)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.inference import (
+    find_pippy_batch_size,
+    generate_stage_map,
+    prepare_pippy,
+)
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model_and_batch(layers=4):
+    config = LlamaConfig.tiny(layers=layers)
+    model = LlamaForCausalLM.from_config(config, seed=1)
+    ids = np.random.default_rng(0).integers(0, 256, size=(8, 16)).astype(np.int32)
+    return config, model, ids
+
+
+def test_pipelined_logits_match_single_device():
+    config, model, ids = _model_and_batch()
+    ref = model.apply_fn(model.params, input_ids=ids)["logits"]
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids}, devices=jax.devices()[:4]
+    )
+    out = pipelined(input_ids=ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_stage_params_are_disjoint_and_placed():
+    config, model, ids = _model_and_batch()
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids}, devices=jax.devices()[:4]
+    )
+    assert len(pipelined._stage_params) == 4
+    for s, params in enumerate(pipelined._stage_params):
+        for leaf in params.values():
+            assert leaf.devices() == {pipelined.devices[s]}
+    # layer slices are distributed, not replicated: the big embed lives on
+    # exactly one stage
+    owners = [s for s, p in enumerate(pipelined._stage_params) if "embed_tokens" in p]
+    assert len(owners) == 1
+
+
+def test_microbatching_pads_uneven_batch():
+    config, model, _ = _model_and_batch(layers=2)
+    ids = np.random.default_rng(0).integers(0, 256, size=(5, 16)).astype(np.int32)
+    ref = model.apply_fn(model.params, input_ids=ids)["logits"]
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids},
+        devices=jax.devices()[:2], num_chunks=2,
+    )
+    out = pipelined(input_ids=ids)
+    assert out.logits.shape[0] == 5
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_explicit_split_points():
+    config, model, ids = _model_and_batch(layers=2)
+    pipelined = prepare_pippy(
+        model, split_points=["layer"], example_kwargs={"input_ids": ids},
+        devices=jax.devices()[:2],
+    )
+    assert pipelined.hf_split_points == ["layer"]
+    ref = model.apply_fn(model.params, input_ids=ids)["logits"]
+    out = pipelined(input_ids=ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_find_pippy_batch_size():
+    assert find_pippy_batch_size((np.zeros((4, 2)),), {}) == 4
+    assert find_pippy_batch_size((), {"x": np.zeros((3,))}) == 3
+    assert find_pippy_batch_size((), {}) is None
+
+
+def test_model_without_segments_raises():
+    from accelerate_tpu.modules import Model
+
+    bare = Model(lambda p, x: x, {"w": np.zeros(2)})
+    with pytest.raises(ValueError, match="segment plan"):
+        prepare_pippy(bare, example_args=(np.zeros((2, 2)),))
+
+
+def test_stage_map_balances_bytes():
+    steps = [(f"s{i}", [f"w{i}"], lambda s, c: c) for i in range(8)]
+    flat = {f"w{i}": np.zeros((100,), np.float32) for i in range(8)}
+    bounds = generate_stage_map(steps, flat, 4)
+    assert bounds == [0, 2, 4, 6]
