@@ -71,4 +71,12 @@ def __getattr__(name):
         from .inference import prepare_pippy
 
         return prepare_pippy
+    if name in ("load_and_quantize_model", "BnbQuantizationConfig"):
+        from .utils import quantization
+
+        return getattr(quantization, name)
+    if name in ("ModelHook", "SequentialHook", "add_hook_to_module", "remove_hook_from_module"):
+        from . import hooks
+
+        return getattr(hooks, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -94,6 +94,49 @@ class _PendingNorm:
         return f"PendingNorm({self._opt._last_norm})"
 
 
+class ProfileContext:
+    """Schedule-driven ``jax.profiler`` session (the reference's
+    torch.profiler schedule semantics, ``dataclasses.py:406-513``): call
+    ``step()`` once per training step; capture runs only during 'active'
+    phases of the wait/warmup/active/repeat cycle."""
+
+    def __init__(self, handler: ProfileKwargs, trace_dir: str):
+        self.handler = handler
+        self.trace_dir = trace_dir
+        self.schedule = handler.build_schedule()
+        self.step_num = 0
+        self._tracing = False
+
+    def _maybe_start(self):
+        if self.schedule(self.step_num) == "active" and not self._tracing:
+            jax.profiler.start_trace(
+                self.trace_dir,
+                create_perfetto_trace=bool(self.handler.with_stack),
+            )
+            self._tracing = True
+
+    def _maybe_stop(self):
+        if self._tracing and self.schedule(self.step_num) != "active":
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def step(self):
+        if self.handler.profile_memory and self.schedule(self.step_num) == "active":
+            import os as _os
+
+            jax.profiler.save_device_memory_profile(
+                _os.path.join(self.trace_dir, f"memory_{self.step_num}.prof")
+            )
+        self.step_num += 1
+        self._maybe_stop()
+        self._maybe_start()
+
+    def _finish(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+
 class Accelerator:
     """Create once, ``prepare()`` your objects, train (reference
     ``Accelerator`` class ``accelerator.py:162``)."""
@@ -138,10 +181,29 @@ class Accelerator:
         self.megatron_lm_plugin = megatron_lm_plugin
         self.context_parallel_plugin = context_parallel_plugin
 
+        # Megatron facade lowers onto mesh axes (SURVEY §2.2: tp_degree →
+        # tp axis; sequence_parallelism → sequence-sharded activations,
+        # which ride the cp axis here — sized to the tp group like
+        # Megatron-SP; pp_degree has no training analog on TPU,
+        # prepare_pippy covers inference pipelining)
+        if megatron_lm_plugin is not None and mesh_plugin is None:
+            if getattr(megatron_lm_plugin, "pp_degree", 1) > 1:
+                raise NotImplementedError(
+                    "pipeline-parallel training is not a TPU-native strategy "
+                    "(GSPMD sharding wins); use prepare_pippy for inference "
+                    "pipelining, or tp/fsdp axes for training"
+                )
+            tp_degree = getattr(megatron_lm_plugin, "tp_degree", 1)
+            sp = getattr(megatron_lm_plugin, "sequence_parallelism", False)
+            mesh_plugin = MeshPlugin(tp=tp_degree, cp=tp_degree if sp and tp_degree > 1 else 1)
+
         # kwargs handlers (reference :387-421)
+        from .ops.fp8 import FP8RecipeKwargs
+
         self.scaler_handler = None
         self.init_handler = None
         self.profile_handler = None
+        self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -149,6 +211,8 @@ class Accelerator:
                 self.init_handler = handler
             elif isinstance(handler, ProfileKwargs):
                 self.profile_handler = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
 
         init_kwargs = self.init_handler.to_kwargs() if self.init_handler else {}
         self.state = AcceleratorState(
@@ -186,12 +250,16 @@ class Accelerator:
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             steps = gradient_accumulation_steps if gradient_accumulation_steps > 1 else env_steps
+            if steps == 1 and deepspeed_plugin is not None:
+                # a ds-config's accumulation governs the loop (reference
+                # merges it in ``accelerator.py:1669-1830``)
+                steps = deepspeed_plugin.gradient_accumulation_steps
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
         self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
 
         self.device_placement = device_placement
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
-        self.rng_types = rng_types or ["python", "numpy"]
+        self.rng_types = rng_types or ["python", "numpy", "jax"]
 
         # fp16 → static loss scale (no dynamic GradScaler needed on TPU)
         self._loss_scale = None
@@ -309,10 +377,13 @@ class Accelerator:
 
     @property
     def compute_dtype(self):
+        # fp8: non-matmul compute stays bf16; the zoo's dense projections
+        # additionally lower to scaled-float8 matmuls (ops/fp8.py) via the
+        # recipe attached in prepare_model
         return {
             "bf16": jnp.bfloat16,
             "fp16": jnp.float16,
-            "fp8": jnp.bfloat16,  # fp8 matmul support is generation-gated; bf16 fallback
+            "fp8": jnp.bfloat16,
         }.get(self.mixed_precision)
 
     # ------------------------------------------------------------------
@@ -397,7 +468,32 @@ class Accelerator:
                 result.append(self.prepare_scheduler(obj))
             else:
                 result.append(p)
+        if self.deepspeed_plugin is not None:
+            self._fill_deepspeed_auto()
         return result[0] if len(result) == 1 else tuple(result)
+
+    def _fill_deepspeed_auto(self):
+        """Resolve ``"auto"`` entries of an ingested DeepSpeed config file
+        from the prepared objects (reference ``accelerator.py:1669-1830``)."""
+        values = {
+            "gradient_accumulation_steps": self.gradient_accumulation_steps,
+            "zero_optimization.stage": self.deepspeed_plugin.zero_stage,
+        }
+        if self.deepspeed_plugin.gradient_clipping is not None:
+            values["gradient_clipping"] = self.deepspeed_plugin.gradient_clipping
+        if self._dataloaders:
+            try:
+                total = self._dataloaders[0].total_batch_size
+                micro = max(total // max(self.state.data_parallel_size, 1), 1)
+                values["train_micro_batch_size_per_gpu"] = micro
+                values["train_batch_size"] = total * self.gradient_accumulation_steps
+            except (ValueError, AttributeError):
+                pass
+        if self._optimizers:
+            lr = self._optimizers[0].learning_rate
+            if lr is not None:
+                values["optimizer.params.lr"] = lr
+        self.deepspeed_plugin.fill_auto(values)
 
     def prepare_model(self, model, device_placement: bool | None = None, evaluation_mode: bool = False):
         """(Reference ``prepare_model`` ``accelerator.py:1361``.)"""
@@ -413,6 +509,10 @@ class Accelerator:
             compute_dtype=self.compute_dtype,
             param_sharding=sharding,
         )
+        if self.mixed_precision == "fp8":
+            from .ops.fp8 import FP8RecipeKwargs
+
+            prepared.fp8_recipe = self.fp8_recipe_handler or FP8RecipeKwargs()
         prepared.params = params
         prepared.training = not evaluation_mode
         self._models.append(prepared)
@@ -741,21 +841,42 @@ class Accelerator:
 
     @contextlib.contextmanager
     def autocast(self, autocast_handler=None):
-        """Precision is a trace-time dtype policy on TPU — the context is
-        accepted for parity (reference ``accelerator.py:3435``)."""
+        """Precision is a trace-time dtype policy on TPU; with
+        ``AutocastKwargs(enabled=False)`` the compute-dtype cast is suspended
+        for the context — a full-precision island inside a mixed-precision
+        run (reference ``accelerator.py:3435``)."""
+        if autocast_handler is not None and not getattr(autocast_handler, "enabled", True):
+            saved = [(m, m.compute_dtype) for m in self._models]
+            for m, _ in saved:
+                m.compute_dtype = None
+            try:
+                yield
+            finally:
+                for m, dtype in saved:
+                    m.compute_dtype = dtype
+            return
         yield
 
     @contextlib.contextmanager
     def profile(self, profile_handler: ProfileKwargs | None = None):
-        """``jax.profiler`` trace (reference builds torch.profiler,
-        ``accelerator.py:3462-3519``)."""
+        """``jax.profiler`` capture (reference builds torch.profiler,
+        ``accelerator.py:3462-3519``). Yields a :class:`ProfileContext`
+        whose ``step()`` drives the wait/warmup/active schedule — tracing
+        starts on entering an active window and stops on leaving it, exactly
+        the reference's ``torch.profiler.schedule`` contract.
+        ``profile_memory`` additionally writes ``memory_<step>.prof``
+        (pprof-format device memory snapshots)."""
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         trace_dir = handler.output_trace_dir
         if trace_dir is None:
             yield None
             return
-        with jax.profiler.trace(trace_dir):
-            yield None
+        ctx = ProfileContext(handler, trace_dir)
+        try:
+            ctx._maybe_start()
+            yield ctx
+        finally:
+            ctx._finish()
 
     # ------------------------------------------------------------------
     # model/optimizer interop

@@ -278,6 +278,13 @@ def _ppart(p) -> str:
     return str(getattr(p, "name", p))
 
 
+@jax.jit
+def _dequant_on_device(q, scale):
+    """int8 → f32 upcast that runs on-device right after the H2D copy (the
+    bytes moved were int8; XLA fuses the multiply into the consumer)."""
+    return q.astype(jnp.float32) * scale
+
+
 class DispatchedModel:
     """Callable model over tiered params. With a cooperating model
     (``model.segments``) execution streams segment-by-segment with
@@ -338,22 +345,30 @@ class DispatchedModel:
 
     # -- streaming path ------------------------------------------------------
 
+    def _fetch_one(self, p, idx):
+        if idx is not None and (p, idx) in self.tiered.resident_slices:
+            return self.tiered.resident_slices[(p, idx)]
+        if p in self.tiered.resident:
+            value = self.tiered.resident[p]
+            return value if idx is None else value[idx]
+        return jax.device_put(np.asarray(self.tiered.fetch_host_or_disk(p, idx)))
+
     def _segment_params(self, seg_name, paths):
         """Device arrays for one segment; offloaded leaves H2D-copied
         (async). A ``(path, i)`` entry addresses layer i of a stacked leaf —
         for host/disk tiers this slices the numpy/memmap view, so one layer's
-        bytes move, not the whole stack."""
+        bytes move, not the whole stack. Quantized leaves live as
+        ``<path>.q``/``<path>.scale`` pairs — the int8 bytes are what cross
+        disk→host→HBM; dequantization runs on-device after the copy."""
         out = {}
         for entry in paths:
             p, idx = entry if isinstance(entry, tuple) else (entry, None)
-            if idx is not None and (p, idx) in self.tiered.resident_slices:
-                out[p] = self.tiered.resident_slices[(p, idx)]
-            elif p in self.tiered.resident:
-                value = self.tiered.resident[p]
-                out[p] = value if idx is None else value[idx]
-            else:
-                host_value = self.tiered.fetch_host_or_disk(p, idx)
-                out[p] = jax.device_put(np.asarray(host_value))
+            try:
+                out[p] = self._fetch_one(p, idx)
+            except KeyError:
+                q = self._fetch_one(f"{p}.q", idx)
+                scale = self._fetch_one(f"{p}.scale", idx)
+                out[p] = _dequant_on_device(q, scale)
         return out
 
     def _call_streaming(self, segments, *args, **kwargs):

@@ -127,7 +127,14 @@ def _restore_tree_like(live_tree, flat: dict[str, np.ndarray]):
 
 
 def _collect_rng_state() -> dict[str, Any]:
+    from .utils.random import jax_rng_state
+
     states = {"random_state": random.getstate(), "numpy_random_seed": np.random.get_state()}
+    jax_key = jax_rng_state()
+    if jax_key is not None:
+        # the framework jax key — the xm-seed analog in the reference's
+        # per-rank bundle (``checkpointing.py:144-161``)
+        states["jax_key"] = jax_key
     try:
         import torch
 
@@ -138,8 +145,12 @@ def _collect_rng_state() -> dict[str, Any]:
 
 
 def _restore_rng_state(states: dict[str, Any]):
+    from .utils.random import set_jax_rng_state
+
     random.setstate(states["random_state"])
     np.random.set_state(states["numpy_random_seed"])
+    if "jax_key" in states:
+        set_jax_rng_state(states["jax_key"])
     if "torch_manual_seed" in states:
         try:
             import torch

@@ -51,6 +51,8 @@ class ClusterConfig:
     mesh_tp: int = 1
     use_fsdp: bool = False
     fsdp_config: dict = field(default_factory=dict)
+    use_deepspeed: bool = False
+    deepspeed_config: dict = field(default_factory=dict)
     context_parallel_mode: str | None = None  # ring | ulysses | allgather
     debug: bool = False
     num_cpu_devices: int = 0  # >0 → virtual CPU mesh (testing)
@@ -105,6 +107,13 @@ class ClusterConfig:
             env["ACCELERATE_USE_FSDP"] = "true"
             for k, v in (self.fsdp_config or {}).items():
                 env[f"FSDP_{k.upper()}"] = str(v)
+        if self.use_deepspeed:
+            env["ACCELERATE_USE_DEEPSPEED"] = "true"
+            ds = self.deepspeed_config or {}
+            if "zero_stage" in ds:
+                env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] = str(ds["zero_stage"])
+            if ds.get("deepspeed_config_file"):
+                env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] = str(ds["deepspeed_config_file"])
         if self.context_parallel_mode:
             env["ACCELERATE_CP_MODE"] = self.context_parallel_mode
         if self.debug:
@@ -137,7 +146,9 @@ def _ask(prompt: str, default, cast=str):
 
 def get_cluster_input() -> ClusterConfig:
     """Interactive questionnaire (reference ``cluster.py:54``), linearised —
-    plain prompts instead of the cursor-menu UI."""
+    plain prompts instead of the cursor-menu UI, with the same
+    sub-questionnaires (multi-host, FSDP, DeepSpeed-style sharding, context
+    parallelism, TPU pod)."""
     cfg = ClusterConfig()
     env = _ask(
         "Compute environment? (jax_tpu / cpu_mesh for local testing)", "jax_tpu"
@@ -146,21 +157,59 @@ def get_cluster_input() -> ClusterConfig:
         cfg.compute_environment = "CPU_MESH"
         cfg.distributed_type = "CPU_MESH"
         cfg.num_cpu_devices = _ask("How many virtual CPU devices?", 8, int)
+
+    # -- multi-host sub-questionnaire (reference cluster.py:70-115) ---------
     cfg.num_machines = _ask("How many hosts (machines)?", 1, int)
     if cfg.num_machines > 1:
         cfg.distributed_type = "MULTI_HOST_TPU"
         cfg.machine_rank = _ask("Rank of this machine?", 0, int)
         cfg.coordinator_address = _ask("Coordinator address (host:port)?", "127.0.0.1:8476")
+        if _ask("Is this a GCP TPU pod managed via gcloud?", False, bool):
+            cfg.tpu_name = _ask("TPU name?", None)
+            cfg.tpu_zone = _ask("TPU zone?", None)
+
+    # -- sharding sub-questionnaire (reference FSDP/DeepSpeed menus) --------
     cfg.mesh_fsdp = _ask("FSDP (param-shard) mesh extent?", 1, int)
+    cfg.use_fsdp = cfg.mesh_fsdp > 1
+    if cfg.use_fsdp:
+        cfg.fsdp_config = {
+            "sharding_strategy": _ask(
+                "FSDP sharding strategy? (FULL_SHARD/SHARD_GRAD_OP/NO_SHARD)", "FULL_SHARD"
+            ),
+            "min_num_params": _ask("Minimum parameter count to shard a tensor?", 0, int),
+            "activation_checkpointing": _ask("Use activation checkpointing?", False, bool),
+            "cpu_offload": _ask("Offload optimizer state to host memory?", False, bool),
+        }
+    elif _ask("Use a DeepSpeed-style ZeRO config instead?", False, bool):
+        cfg.use_deepspeed = True
+        ds_file = _ask("Path to a DeepSpeed JSON config (empty = questionnaire)?", "")
+        if ds_file:
+            cfg.deepspeed_config = {"deepspeed_config_file": ds_file}
+        else:
+            stage = _ask("ZeRO stage? (0/1/2/3)", 2, int)
+            cfg.deepspeed_config = {"zero_stage": stage}
+            if stage >= 2 and _ask("Offload optimizer state to host?", False, bool):
+                cfg.deepspeed_config["offload_optimizer_device"] = "cpu"
+            if stage == 3 and _ask("Offload parameters to host?", False, bool):
+                cfg.deepspeed_config["offload_param_device"] = "cpu"
+        if cfg.deepspeed_config.get("zero_stage", 0) >= 1:
+            cfg.mesh_fsdp = _ask("ZeRO shard extent (mesh fsdp axis)?", 2, int)
+            cfg.use_fsdp = cfg.mesh_fsdp > 1
+
     cfg.mesh_tp = _ask("Tensor-parallel mesh extent?", 1, int)
     cfg.mesh_cp = _ask("Context-parallel (sequence) mesh extent?", 1, int)
     cfg.mesh_ep = _ask("Expert-parallel mesh extent?", 1, int)
     if cfg.mesh_cp > 1:
-        cfg.context_parallel_mode = _ask("Context parallel mode? (ring/ulysses)", "ring")
-    cfg.use_fsdp = cfg.mesh_fsdp > 1
-    cfg.mixed_precision = _ask("Mixed precision? (no/bf16/fp16)", "bf16")
+        cfg.context_parallel_mode = _ask(
+            "Context parallel mode? (ring/ulysses/allgather)", "ring"
+        )
+
+    cfg.mixed_precision = _ask("Mixed precision? (no/bf16/fp16/fp8)", "bf16")
     cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
     cfg.debug = _ask("Check distributed operations for shape agreement (debug mode)?", False, bool)
+    cfg.main_training_function = _ask(
+        "Main training function (for notebook_launcher)?", "main"
+    )
     return cfg
 
 

@@ -541,10 +541,12 @@ class DataLoaderShard(DataLoaderStateMixin):
         if self.rng_types:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.begin()
-        self.batches_yielded = 0
         it = self._raw_batches()
         skip = self.skip_batches + self._resume_skip
         self._resume_skip = 0
+        # position bookkeeping starts at the applied skip so a checkpoint
+        # taken after a mid-epoch resume records the TRUE epoch position
+        self.batches_yielded = skip
         if skip:
             it = itertools.islice(it, skip, None)
         use_thread = self.prefetch_batches > 0 and self._prefetch_safe
@@ -587,7 +589,12 @@ class DataLoaderShard(DataLoaderStateMixin):
     def load_state_dict(self, state: dict):
         self.iteration = state.get("iteration", 0)
         self.set_epoch(self.iteration)
-        self._resume_skip = state.get("batches_yielded", 0)
+        # batches_yielded counts the ABSOLUTE epoch position (including the
+        # structural skip_batches this loader re-applies on every iter);
+        # only the delta beyond that is the resume skip
+        self._resume_skip = max(
+            0, state.get("batches_yielded", 0) - self.skip_batches
+        )
 
 
 def to_global_array(batch, sharding):

@@ -1,0 +1,152 @@
+"""User-facing model hooks: attach behavior around a model's forward.
+
+Reference: ``/root/reference/src/accelerate/hooks.py`` — ``ModelHook``
+(:37), ``SequentialHook`` (:95), ``add_hook_to_module`` (:124),
+``remove_hook_from_module`` (:183). There the hook engine rewrites
+``module.forward`` and is the substrate for device alignment; here offload
+is handled by the streaming executor (``big_modeling.py``), so hooks are
+purely the *extension point*: users attach pre/post-forward callbacks to a
+prepared / dispatched / raw model without touching its internals.
+
+Semantics note for prepared models: calls are deferred (they return a
+``Deferred`` graph node), so ``pre_forward`` sees the host-side
+args/kwargs at call time and ``post_forward`` sees the deferred output —
+it may wrap or replace it; forcing still happens in the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .modules import Model, PreparedModel
+
+
+class ModelHook:
+    """(Reference ``ModelHook`` ``hooks.py:37``.) Subclass and override any
+    of the four callbacks; attach with :func:`add_hook_to_module`."""
+
+    no_grad = False  # parity field (grad staging is explicit here)
+
+    def init_hook(self, module):
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        return output
+
+    def detach_hook(self, module):
+        return module
+
+
+class SequentialHook(ModelHook):
+    """Run several hooks in order (reference ``SequentialHook`` ``hooks.py:95``)."""
+
+    def __init__(self, *hooks):
+        self.hooks = list(hooks)
+
+    def init_hook(self, module):
+        for hook in self.hooks:
+            module = hook.init_hook(module)
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        for hook in self.hooks:
+            args, kwargs = hook.pre_forward(module, *args, **kwargs)
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        for hook in self.hooks:
+            output = hook.post_forward(module, output)
+        return output
+
+    def detach_hook(self, module):
+        for hook in self.hooks:
+            module = hook.detach_hook(module)
+        return module
+
+
+def add_hook_to_module(module, hook: ModelHook, append: bool = False):
+    """Patch ``module``'s call to run ``hook`` around it (reference
+    ``add_hook_to_module`` ``hooks.py:124``). Works on :class:`Model`,
+    :class:`PreparedModel`, :class:`DispatchedModel` — anything callable
+    with an instance-patchable ``__call__`` path."""
+    if append and getattr(module, "_hf_hook", None) is not None:
+        old = module._hf_hook
+        remove_hook_from_module(module)
+        hook = SequentialHook(old, hook)
+
+    old_forward = _callable_of(module)
+    module = hook.init_hook(module)
+    module._hf_hook = hook
+    module._old_forward = old_forward
+
+    def new_forward(*args, **kwargs):
+        args, kwargs = module._hf_hook.pre_forward(module, *args, **kwargs)
+        output = old_forward(*args, **kwargs)
+        return module._hf_hook.post_forward(module, output)
+
+    _patch_callable(module, new_forward)
+    return module
+
+
+def remove_hook_from_module(module, recurse: bool = False):
+    """(Reference ``remove_hook_from_module`` ``hooks.py:183``.)"""
+    hook = getattr(module, "_hf_hook", None)
+    if hook is not None:
+        hook.detach_hook(module)
+        del module._hf_hook
+    if getattr(module, "_old_forward", None) is not None:
+        _patch_callable(module, None)
+        del module._old_forward
+    return module
+
+
+def _callable_of(module):
+    """The unhooked forward: prefer an existing patched slot's saved
+    original, else the REAL (pre-indirection) class ``__call__``."""
+    if getattr(module, "_accelerate_patched_call", None) is not None:
+        return module._old_forward
+    cls = type(module)
+    real = getattr(cls, "_accelerate_real_call", None) or cls.__call__
+    return real.__get__(module)
+
+
+def _patch_callable(module, fn):
+    """Instance-level call override. Python looks up ``__call__`` on the
+    type, so the class consults ``_accelerate_patched_call`` first."""
+    cls = type(module)
+    if not getattr(cls, "_accelerate_call_indirection", False):
+        real_call = cls.__call__
+        cls._accelerate_real_call = real_call
+
+        def dispatch(self, *args, **kwargs):
+            patched = getattr(self, "_accelerate_patched_call", None)
+            if patched is not None:
+                return patched(*args, **kwargs)
+            return real_call(self, *args, **kwargs)
+
+        cls.__call__ = dispatch
+        cls._accelerate_call_indirection = True
+    if fn is None:
+        if hasattr(module, "_accelerate_patched_call"):
+            del module._accelerate_patched_call
+    else:
+        module._accelerate_patched_call = fn
+
+
+class UserCpuOffloadHook:
+    """Handle returned by :func:`accelerate_tpu.big_modeling.cpu_offload`-
+    style helpers letting users detach offloading (reference
+    ``UserCpuOffloadHook`` ``hooks.py:671``)."""
+
+    def __init__(self, model, hook: ModelHook):
+        self.model = model
+        self.hook = hook
+
+    def offload(self):
+        self.hook.init_hook(self.model)
+
+    def remove(self):
+        remove_hook_from_module(self.model)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .big_modeling import _ppart
 from .logging import get_logger
 from .modules import Model, ModelOutput
 
@@ -158,8 +159,6 @@ class PipelinedModel:
                 carry = jax.device_put(carry, self.devices[s])
                 carry = self._stage_fn(s, steps)(self._stage_params[s], carry)
             outputs.append(plan["finalize"](carry))
-        out_cls = type(outputs[0]) if type(outputs[0]) is not dict and isinstance(outputs[0], dict) else None
-        plain = [dict(o) if out_cls else o for o in outputs]  # ModelOutput isn't a pytree
         # scalars (a loss) average over chunks weighted by REAL rows, so the
         # wraparound-padded tail chunk doesn't get full weight. (Padded rows
         # inside that chunk still enter its internal mean — pass
@@ -174,11 +173,9 @@ class PipelinedModel:
                 return jnp.concatenate(xs, axis=0)
             return jnp.sum(jnp.stack(xs) * weights)
 
-        out = jax.tree.map(_merge, *plain)
+        out = jax.tree.map(_merge, *outputs)  # ModelOutput is a registered pytree
         if pad:
             out = jax.tree.map(lambda x: x[:batch] if hasattr(x, "ndim") and x.ndim else x, out)
-        if out_cls is not None:
-            out = out_cls(out)
         return out
 
     forward = __call__
@@ -258,11 +255,3 @@ def prepare_pippy(
         "pipeline stages at %s over %d devices", split_names, len(wrapped.devices)
     )
     return wrapped
-
-
-def _ppart(p) -> str:
-    if hasattr(p, "key"):
-        return str(p.key)
-    if hasattr(p, "idx"):
-        return str(p.idx)
-    return str(getattr(p, "name", p))

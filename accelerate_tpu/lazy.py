@@ -59,15 +59,22 @@ class InputNode(Node):
 class ModelCallNode(Node):
     """Application of a prepared model to a pytree of (possibly deferred)
     inputs. ``model`` is static (closed over at trace time); array leaves of
-    args/kwargs become graph inputs."""
+    args/kwargs become graph inputs.
 
-    __slots__ = ("model", "call_args", "call_kwargs")
+    ``compute_dtype`` snapshots the model's precision policy AT CALL TIME —
+    replay happens later (at ``step()``/``force()``), by which point an
+    ``autocast(enabled=False)`` island has exited; the snapshot is what
+    makes the island apply to deferred calls made inside it. It is part of
+    the jit-cache signature (see ``linearize``)."""
+
+    __slots__ = ("model", "call_args", "call_kwargs", "compute_dtype")
 
     def __init__(self, model, call_args: tuple, call_kwargs: dict):
         super().__init__("model_call", ())
         self.model = model
         self.call_args = call_args
         self.call_kwargs = call_kwargs
+        self.compute_dtype = getattr(model, "compute_dtype", None)
 
 
 def _is_array(x) -> bool:
@@ -132,7 +139,9 @@ def linearize(root: Node):
                     inputs.append(leaf)
                     arg_ids.append(("leaf", idx, _leaf_sig(leaf)))
             my_id = len(sig_parts)
-            sig_parts.append(("model_call", m_idx, str(treedef), tuple(arg_ids)))
+            sig_parts.append(
+                ("model_call", m_idx, str(treedef), tuple(arg_ids), str(node.compute_dtype))
+            )
         else:
             child_ids = tuple(walk(as_node(a)) for a in node.args)
             my_id = len(sig_parts)
@@ -185,7 +194,9 @@ def replay(root: Node, input_values: list, params_env: dict[int, Any]):
             ]
             args, kwargs = jax.tree.unflatten(treedef, resolved)
             params = params_env.get(id(node.model))
-            out = node.model._raw_apply(params, *args, **kwargs)
+            out = node.model._raw_apply(
+                params, *args, _compute_dtype=node.compute_dtype, **kwargs
+            )
         elif node.op in _BINARY:
             out = _BINARY[node.op](ev(as_node(node.args[0])), ev(as_node(node.args[1])))
         elif node.op in _REDUCTIONS:
@@ -528,10 +539,14 @@ def fused_step_fn_for(
                 grads = jax.tree.map(lambda g: g * inv, grads)
                 finite = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
                 step_ok = jnp.all(jnp.stack(finite))
-            norm = optax.global_norm(grads)
             if clip_norm:
+                norm = optax.global_norm(grads)
                 factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * factor, grads)
+            else:
+                # no clip requested: don't pay a full reduction pass over the
+                # grads just to report a norm nobody asked for
+                norm = jnp.asarray(0.0, jnp.float32)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             # fp16 non-finite: keep old state (structure-preserving select)

@@ -162,20 +162,9 @@ class LocalSGD:
         def stacked_apply(params, *args, **kwargs):
             args = _leading_batch_reshape(args, R)
             kwargs = _leading_batch_reshape(kwargs, R)
-            out_cls = [None]
-
-            def _per_replica(p, a, kw):
-                out = base_apply(p, *a, **kw)
-                if isinstance(out, dict) and type(out) is not dict:
-                    out_cls[0] = type(out)  # ModelOutput isn't a pytree; unwrap for vmap
-                    out = dict(out)
-                return out
-
-            out = jax.vmap(_per_replica)(params, args, kwargs)
-            out = _merge_replica_outputs(out, R)
-            if out_cls[0] is not None:
-                out = out_cls[0](out)
-            return out
+            # ModelOutput is a registered pytree, so vmap returns it directly
+            out = jax.vmap(lambda p, a, kw: base_apply(p, *a, **kw))(params, args, kwargs)
+            return _merge_replica_outputs(out, R)
 
         inner.apply_fn = stacked_apply
 

@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
+from ..ops.fp8 import dense
 from ..ops.layers import rms_norm
 from .llama import _constrain
 
@@ -76,7 +77,7 @@ def init_bert_params(key: jax.Array, config: BertConfig, dtype=jnp.float32):
     h, ff, L = c.hidden_size, c.intermediate_size, c.num_hidden_layers
     keys = jax.random.split(key, 12)
 
-    def dense(k, *shape, in_dim):
+    def _init_dense(k, *shape, in_dim):
         return (jax.random.normal(k, shape, dtype=jnp.float32) / np.sqrt(in_dim)).astype(dtype)
 
     return {
@@ -85,18 +86,18 @@ def init_bert_params(key: jax.Array, config: BertConfig, dtype=jnp.float32):
         "embed_types": (jax.random.normal(keys[2], (c.type_vocab_size, h)) * 0.02).astype(dtype),
         "emb_norm": jnp.ones((h,), dtype=dtype),
         "layers": {
-            "wq": dense(keys[3], L, h, h, in_dim=h),
-            "wk": dense(keys[4], L, h, h, in_dim=h),
-            "wv": dense(keys[5], L, h, h, in_dim=h),
-            "wo": dense(keys[6], L, h, h, in_dim=h),
-            "w_in": dense(keys[7], L, h, ff, in_dim=h),
-            "w_out": dense(keys[8], L, ff, h, in_dim=ff),
+            "wq": _init_dense(keys[3], L, h, h, in_dim=h),
+            "wk": _init_dense(keys[4], L, h, h, in_dim=h),
+            "wv": _init_dense(keys[5], L, h, h, in_dim=h),
+            "wo": _init_dense(keys[6], L, h, h, in_dim=h),
+            "w_in": _init_dense(keys[7], L, h, ff, in_dim=h),
+            "w_out": _init_dense(keys[8], L, ff, h, in_dim=ff),
             "attn_norm": jnp.ones((L, h), dtype=dtype),
             "mlp_norm": jnp.ones((L, h), dtype=dtype),
         },
         "norm": jnp.ones((h,), dtype=dtype),
         "classifier": {
-            "w": dense(keys[9], h, c.num_labels, in_dim=h),
+            "w": _init_dense(keys[9], h, c.num_labels, in_dim=h),
             "b": jnp.zeros((c.num_labels,), dtype=dtype),
         },
     }
@@ -109,16 +110,16 @@ def _bert_block(config: BertConfig, attention_mask):
     def body(x, layer):
         b, s, h = x.shape
         y = rms_norm(x, layer["attn_norm"], c.norm_eps)
-        q = (y @ layer["wq"]).reshape(b, s, nh, hd)
-        k = (y @ layer["wk"]).reshape(b, s, nh, hd)
-        v = (y @ layer["wv"]).reshape(b, s, nh, hd)
+        q = dense(y, layer["wq"]).reshape(b, s, nh, hd)
+        k = dense(y, layer["wk"]).reshape(b, s, nh, hd)
+        v = dense(y, layer["wv"]).reshape(b, s, nh, hd)
         q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
         k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
         attn = attention(q, k, v, segment_mask=attention_mask, causal=False)
-        x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+        x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
         x = _constrain(x, P(("dp", "fsdp"), "cp", None))
         y = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-        x = x + jax.nn.gelu(y @ layer["w_in"]) @ layer["w_out"]
+        x = x + dense(jax.nn.gelu(dense(y, layer["w_in"])), layer["w_out"])
         return _constrain(x, P(("dp", "fsdp"), "cp", None)), None
 
     if c.remat:

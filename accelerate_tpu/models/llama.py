@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
+from ..ops.fp8 import dense
 from ..ops.layers import (
     apply_rope,
     cross_entropy_loss,
@@ -135,20 +136,20 @@ def llama_layer_apply(config: LlamaConfig, layer, x, cos, sin, positions, attent
     b, s, h = x.shape
     # attention
     y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-    q = (y @ layer["wq"]).reshape(b, s, nh, hd)
-    k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
-    v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+    q = dense(y, layer["wq"]).reshape(b, s, nh, hd)
+    k = dense(y, layer["wk"]).reshape(b, s, nkv, hd)
+    v = dense(y, layer["wv"]).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
-    x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+    x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
     # mlp (SwiGLU)
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
-    gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
-    x = x + gated @ layer["w_down"]
+    gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
+    x = x + dense(gated, layer["w_down"])
     return _constrain(x, P(("dp", "fsdp"), "cp", None))
 
 
@@ -196,7 +197,7 @@ def llama_apply(
     head = params.get("lm_head")
     if head is None:
         head = params["embed_tokens"].T
-    logits = x @ head
+    logits = dense(x, head)
     logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
 
     out = ModelOutput(logits=logits)

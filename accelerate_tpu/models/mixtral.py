@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
+from ..ops.fp8 import dense
 from ..ops.layers import apply_rope, cross_entropy_loss, rms_norm, rope_frequencies
 from .llama import _constrain
 
@@ -91,25 +92,25 @@ def init_mixtral_params(key: jax.Array, config: MixtralConfig, dtype=jnp.float32
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     keys = jax.random.split(key, 12)
 
-    def dense(k, *shape, in_dim):
+    def _init_dense(k, *shape, in_dim):
         return (jax.random.normal(k, shape, dtype=jnp.float32) / np.sqrt(in_dim)).astype(dtype)
 
     return {
         "embed_tokens": (jax.random.normal(keys[0], (c.vocab_size, h)) * 0.02).astype(dtype),
         "layers": {
-            "wq": dense(keys[1], L, h, nh * hd, in_dim=h),
-            "wk": dense(keys[2], L, h, nkv * hd, in_dim=h),
-            "wv": dense(keys[3], L, h, nkv * hd, in_dim=h),
-            "wo": dense(keys[4], L, nh * hd, h, in_dim=nh * hd),
-            "router": dense(keys[5], L, h, E, in_dim=h),
-            "e_gate": dense(keys[6], L, E, h, ff, in_dim=h),
-            "e_up": dense(keys[7], L, E, h, ff, in_dim=h),
-            "e_down": dense(keys[8], L, E, ff, h, in_dim=ff),
+            "wq": _init_dense(keys[1], L, h, nh * hd, in_dim=h),
+            "wk": _init_dense(keys[2], L, h, nkv * hd, in_dim=h),
+            "wv": _init_dense(keys[3], L, h, nkv * hd, in_dim=h),
+            "wo": _init_dense(keys[4], L, nh * hd, h, in_dim=nh * hd),
+            "router": _init_dense(keys[5], L, h, E, in_dim=h),
+            "e_gate": _init_dense(keys[6], L, E, h, ff, in_dim=h),
+            "e_up": _init_dense(keys[7], L, E, h, ff, in_dim=h),
+            "e_down": _init_dense(keys[8], L, E, ff, h, in_dim=ff),
             "attn_norm": jnp.ones((L, h), dtype=dtype),
             "mlp_norm": jnp.ones((L, h), dtype=dtype),
         },
         "norm": jnp.ones((h,), dtype=dtype),
-        "lm_head": dense(keys[9], h, c.vocab_size, in_dim=h),
+        "lm_head": _init_dense(keys[9], h, c.vocab_size, in_dim=h),
     }
 
 
@@ -169,15 +170,15 @@ def mixtral_layer_apply(config: MixtralConfig, layer, x, cos, sin, positions, at
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     b, s, h = x.shape
     y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-    q = (y @ layer["wq"]).reshape(b, s, nh, hd)
-    k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
-    v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+    q = dense(y, layer["wq"]).reshape(b, s, nh, hd)
+    k = dense(y, layer["wk"]).reshape(b, s, nkv, hd)
+    v = dense(y, layer["wv"]).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
-    x = x + attn.reshape(b, s, nh * hd) @ layer["wo"]
+    x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
     moe_out, aux = moe_ffn(config, layer, y)
@@ -211,7 +212,7 @@ def mixtral_apply(
     (x, aux_total), _ = jax.lax.scan(body_fn, (x, jnp.asarray(0.0, jnp.float32)), params["layers"])
 
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
-    logits = x @ params["lm_head"]
+    logits = dense(x, params["lm_head"])
     logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
 
     out = ModelOutput(logits=logits, aux_loss=aux_total / c.num_hidden_layers)

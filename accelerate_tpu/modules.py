@@ -22,7 +22,9 @@ from .lazy import Deferred, ModelCallNode
 
 class ModelOutput(dict):
     """Dict with attribute access (the transformers-style output object the
-    reference's examples rely on: ``outputs.loss`` / ``outputs.logits``)."""
+    reference's examples rely on: ``outputs.loss`` / ``outputs.logits``).
+    Registered as a pytree (below) so jit/vmap can return it and tree ops
+    traverse into it like a plain dict."""
 
     def __getattr__(self, name):
         try:
@@ -32,6 +34,16 @@ class ModelOutput(dict):
 
     def __setattr__(self, name, value):
         self[name] = value
+
+
+jax.tree_util.register_pytree_with_keys(
+    ModelOutput,
+    lambda d: (
+        tuple((jax.tree_util.DictKey(k), d[k]) for k in sorted(d)),
+        tuple(sorted(d)),
+    ),
+    lambda keys, children: ModelOutput(zip(keys, children)),
+)
 
 
 class Model:
@@ -100,6 +112,7 @@ class PreparedModel:
         self.params = model.params  # (re)sharded by prepare
         self.training = True
         self._pending_grads = None  # grads for optimizer-less models
+        self.fp8_recipe = None  # set by prepare when mixed_precision='fp8'
 
     # -- identity ------------------------------------------------------------
 
@@ -142,19 +155,35 @@ class PreparedModel:
 
     # -- execution -----------------------------------------------------------
 
-    def _raw_apply(self, params, *args, **kwargs):
-        """Called at trace time from the deferred replay."""
+    _DTYPE_UNSET = object()
+
+    def _raw_apply(self, params, *args, _compute_dtype=_DTYPE_UNSET, **kwargs):
+        """Called at trace time from the deferred replay. ``_compute_dtype``
+        is the policy snapshotted when the call was RECORDED (autocast
+        islands must bind at call time, not at the later trace time)."""
+        import contextlib
+
+        compute_dtype = (
+            self.compute_dtype if _compute_dtype is PreparedModel._DTYPE_UNSET else _compute_dtype
+        )
         if params is None:
             params = self.params
-        if self.compute_dtype is not None:
-            params = _cast_floats(params, self.compute_dtype)
-            args = _cast_floats(args, self.compute_dtype)
-            kwargs = _cast_floats(kwargs, self.compute_dtype)
-        if self._model.mutable_state is not None:
-            out = self.apply_with_state(params, *args, **kwargs)
+        if compute_dtype is not None:
+            params = _cast_floats(params, compute_dtype)
+            args = _cast_floats(args, compute_dtype)
+            kwargs = _cast_floats(kwargs, compute_dtype)
+        if self.fp8_recipe is not None:
+            from .ops.fp8 import fp8_autocast
+
+            ctx = fp8_autocast(enabled=True, fp8_format=self.fp8_recipe.fp8_format)
         else:
-            out = self._model.apply_fn(params, *args, **kwargs)
-        if self.compute_dtype is not None:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            if self._model.mutable_state is not None:
+                out = self.apply_with_state(params, *args, **kwargs)
+            else:
+                out = self._model.apply_fn(params, *args, **kwargs)
+        if compute_dtype is not None:
             out = jax.tree.map(
                 lambda x: x.astype(jnp.float32)
                 if hasattr(x, "dtype") and x.dtype in (jnp.bfloat16, jnp.float16)

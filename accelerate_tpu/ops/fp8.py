@@ -1,0 +1,126 @@
+"""fp8 matmul policy: scaled float8 projections with a hybrid-format VJP.
+
+Reference: fp8 via TransformerEngine module swaps + ``fp8_autocast``
+(``/root/reference/src/accelerate/utils/transformer_engine.py:26,119``) or
+MS-AMP (``accelerator.py:2034``). TPU-native equivalent: the model zoo's
+dense projections route through :func:`dense` (``ops/layers.py``), and under
+:func:`fp8_autocast` that lowers to a per-tensor-scaled float8 matmul —
+E4M3 activations/weights forward, E5M2 gradients backward (the
+TransformerEngine "HYBRID" recipe) via a ``custom_vjp``.
+
+The quantize→matmul is expressed as f8 casts + a bf16-accumulated dot, so
+it runs on every backend; on fp8-capable TPU generations XLA lowers the f8
+operand pair onto the native MXU path. The numerics (f8 rounding on every
+operand, including the gradients) are recipe-faithful everywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+_FP8_STATE = {"active": False, "format": "HYBRID"}
+
+
+def fp8_is_active() -> bool:
+    return _FP8_STATE["active"]
+
+
+@contextlib.contextmanager
+def fp8_autocast(enabled: bool = True, fp8_format: str = "HYBRID"):
+    """Trace-time switch: :func:`dense` calls inside the context compile to
+    fp8 matmuls (reference ``te.fp8_autocast`` shape)."""
+    prev = dict(_FP8_STATE)
+    _FP8_STATE.update(active=enabled, format=fp8_format.upper())
+    try:
+        yield
+    finally:
+        _FP8_STATE.update(prev)
+
+
+def _quantize(x, dtype, max_val):
+    """Per-tensor absmax scaling into the fp8 representable range."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = max_val / jnp.maximum(amax, 1e-12)
+    q = (x.astype(jnp.float32) * scale).astype(dtype)
+    return q, scale
+
+
+def _bf16_dot(a8, b8):
+    return jnp.matmul(
+        a8.astype(jnp.bfloat16), b8.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@jax.custom_vjp
+def fp8_matmul(x, w):
+    """``x [M, K] @ w [K, N]`` with E4M3 forward operands (2-D; the
+    :func:`dense` wrapper flattens leading dims)."""
+    x8, sx = _quantize(x, jnp.float8_e4m3fn, E4M3_MAX)
+    w8, sw = _quantize(w, jnp.float8_e4m3fn, E4M3_MAX)
+    return (_bf16_dot(x8, w8) / (sx * sw)).astype(x.dtype)
+
+
+def _fp8_matmul_fwd(x, w):
+    x8, sx = _quantize(x, jnp.float8_e4m3fn, E4M3_MAX)
+    w8, sw = _quantize(w, jnp.float8_e4m3fn, E4M3_MAX)
+    out = (_bf16_dot(x8, w8) / (sx * sw)).astype(x.dtype)
+    # f8 residuals: the activation-memory saving is part of the recipe.
+    # The zero-size markers carry (a) the primal dtypes — bwd outputs must
+    # match them exactly — and (b) the GRAD dtype, resolved from the recipe
+    # HERE at forward-trace time: jax traces the bwd rule later, after
+    # fp8_autocast has exited, so _FP8_STATE must not be read there.
+    grad_dtype = (
+        jnp.float8_e5m2 if _FP8_STATE["format"] == "HYBRID" else jnp.float8_e4m3fn
+    )
+    markers = (
+        jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype), jnp.zeros((0,), grad_dtype)
+    )
+    return out, (x8, sx, w8, sw, markers)
+
+
+def _fp8_matmul_bwd(res, g):
+    x8, sx, w8, sw, (x_marker, w_marker, g_marker) = res
+    grad_max = E5M2_MAX if g_marker.dtype == jnp.float8_e5m2 else E4M3_MAX
+    g8, sg = _quantize(g, g_marker.dtype, grad_max)
+    dx = (_bf16_dot(g8, w8.T) / (sg * sw)).astype(x_marker.dtype)   # [M, K]
+    dw = (_bf16_dot(x8.T, g8) / (sx * sg)).astype(w_marker.dtype)   # [K, N]
+    return dx, dw
+
+
+fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+def dense(x, w):
+    """Dense projection used by the model zoo: plain ``x @ w`` normally,
+    the scaled-fp8 matmul inside :func:`fp8_autocast`. ``x [..., K]``,
+    ``w [K, N]``."""
+    if not _FP8_STATE["active"]:
+        return x @ w
+    lead = x.shape[:-1]
+    out = fp8_matmul(x.reshape(-1, x.shape[-1]), w)
+    return out.reshape(*lead, w.shape[-1])
+
+
+@dataclass
+class FP8RecipeKwargs:
+    """(Reference ``FP8RecipeKwargs`` ``dataclasses.py:283``.) ``margin`` /
+    ``amax_history_len`` belong to TE's delayed-scaling bookkeeping — the
+    per-tensor just-in-time scaling here needs neither; accepted for
+    config parity. ``fp8_format`` selects E4M3-everywhere or HYBRID
+    (E5M2 grads)."""
+
+    margin: int = 0
+    interval: int = 1
+    fp8_format: str = "HYBRID"
+    amax_history_len: int = 1024
+    amax_compute_algo: str = "most_recent"
+    override_linear_precision: tuple = (False, False, False)
+    backend: str = "XLA"

@@ -27,7 +27,6 @@ import functools
 import logging
 import math
 import os
-import threading
 from typing import Any, Callable, Iterable
 
 import jax
@@ -49,20 +48,6 @@ from .utils.dataclasses import (
 from .utils.environment import parse_choice_from_env, parse_flag_from_env
 
 logger = logging.getLogger(__name__)
-
-
-class ThreadLocalSharedDict(threading.local):
-    """Thread-local storage descriptor (reference ``state.py:83-111`` used
-    this for torch_xla v2/v3 threads; kept for notebook launcher threads)."""
-
-    def __init__(self):
-        self._storage = {}
-
-    def __get__(self, obj, objtype=None):
-        return self._storage
-
-    def __set__(self, obj, value):
-        self._storage = value
 
 
 class PartialState:

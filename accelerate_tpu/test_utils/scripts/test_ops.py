@@ -1,0 +1,84 @@
+"""Launched assertion script: collectives vs closed-form expectations
+(reference ``test_utils/scripts/test_ops.py`` — ``test_gather`` :37,
+gather_object, broadcast, pad_across_processes, reduce sum/mean). Run via
+
+    accelerate-tpu launch --num_cpu_devices 8 -m accelerate_tpu.test_utils.scripts.test_ops
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def test_gather(accelerator):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+    from accelerate_tpu.mesh import data_sharding
+
+    # a globally-sharded array gathers back to the exact global values
+    x = jnp.arange(16, dtype=jnp.float32)
+    sharded = jax.device_put(x, data_sharding(accelerator.mesh))
+    g = ops.gather(sharded)
+    np.testing.assert_array_equal(np.asarray(g), np.arange(16, dtype=np.float32))
+    accelerator.print("gather ok")
+
+
+def test_gather_object(accelerator):
+    from accelerate_tpu import operations as ops
+
+    objs = ops.gather_object([f"proc-{accelerator.process_index}"])
+    assert objs == [f"proc-{i}" for i in range(accelerator.num_processes)], objs
+    accelerator.print("gather_object ok")
+
+
+def test_broadcast(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    value = jnp.full((3,), float(accelerator.process_index) + 7.0)
+    out = ops.broadcast(value, from_process=0)
+    np.testing.assert_allclose(np.asarray(out), 7.0)
+    accelerator.print("broadcast ok")
+
+
+def test_reduce(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    ones = jnp.ones((4,))
+    total = ops.reduce(ones, reduction="sum")
+    np.testing.assert_allclose(np.asarray(total), accelerator.num_processes * 1.0)
+    mean = ops.reduce(ones * 3.0, reduction="mean")
+    np.testing.assert_allclose(np.asarray(mean), 3.0)
+    accelerator.print("reduce ok")
+
+
+def test_pad_across_processes(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    t = jnp.ones((2 + accelerator.process_index, 3))
+    padded = ops.pad_across_processes(t, dim=0)
+    assert padded.shape[0] == 2 + accelerator.num_processes - 1, padded.shape
+    accelerator.print("pad_across_processes ok")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    test_gather(accelerator)
+    test_gather_object(accelerator)
+    test_broadcast(accelerator)
+    test_reduce(accelerator)
+    test_pad_across_processes(accelerator)
+    accelerator.print("ALL_OPS_OK")
+
+
+if __name__ == "__main__":
+    main()

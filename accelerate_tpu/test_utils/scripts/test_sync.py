@@ -1,0 +1,87 @@
+"""Launched assertion script: gradient accumulation semantics (reference
+``test_utils/scripts/test_sync.py`` — grads must NOT apply under no_sync /
+non-boundary microbatches, must apply on boundary steps, and k accumulated
+microbatches must equal one full-batch step). Run via
+
+    accelerate-tpu launch --num_cpu_devices 8 -m accelerate_tpu.test_utils.scripts.test_sync
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _params(model):
+    return {k: float(np.asarray(v)) for k, v in model.params.items()}
+
+
+def check_no_step_mid_accumulation(accelerator):
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionModel
+
+    model, opt = accelerator.prepare(RegressionModel(a=1.0, b=1.0), optax.sgd(0.1))
+    before = _params(model)
+    x = np.asarray([1.0, 2.0], np.float32)
+    y = np.asarray([3.0, 5.0], np.float32)
+    with accelerator.no_sync(model):
+        out = model(x=x, y=y)
+        accelerator.backward(out.loss)
+        opt.step()  # must be a no-op: not a sync step
+    assert _params(model) == before, "params moved during no_sync"
+    # boundary: now the step applies
+    out = model(x=x, y=y)
+    accelerator.backward(out.loss)
+    opt.step()
+    assert _params(model) != before, "params did not move on the sync step"
+    accelerator.print("no_sync/boundary ok")
+
+
+def check_accumulation_matches_full_batch(accelerator_factory):
+    import optax
+
+    from accelerate_tpu import GradientAccumulationPlugin
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils import RegressionModel
+
+    x = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    y = 2 * x + 3
+
+    def run(accum: int, chunks):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc = accelerator_factory(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum)
+        )
+        model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.5))
+        for i, sl in enumerate(chunks):
+            acc._do_sync()
+            out = model(x=x[sl], y=y[sl])
+            acc.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+        return _params(model)
+
+    full = run(1, [slice(None)])
+    micro = run(2, [slice(0, 2), slice(2, 4)])
+    for k in full:
+        np.testing.assert_allclose(micro[k], full[k], rtol=1e-5)
+    return full
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    check_no_step_mid_accumulation(accelerator)
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    check_accumulation_matches_full_batch(lambda **kw: Accelerator(**kw))
+    print("ALL_SYNC_OK")
+
+
+if __name__ == "__main__":
+    main()

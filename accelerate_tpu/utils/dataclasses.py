@@ -65,10 +65,6 @@ class RNGType(BaseEnum):
     GENERATOR = "generator"  # torch-compat CPU generator, if torch is in play
 
 
-class AutocastKwargs:
-    pass  # replaced by PrecisionPolicy below; kept as alias for API parity
-
-
 @dataclass
 class KwargsHandler:
     """Base for kwargs-passthrough dataclasses (reference ``dataclasses.py:82``)."""
@@ -79,6 +75,18 @@ class KwargsHandler:
     def to_kwargs(self) -> dict[str, Any]:
         default = self.__class__()
         return {k: v for k, v in self.to_dict().items() if getattr(default, k) != v}
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """(Reference ``dataclasses.py:96``.) ``enabled=False`` makes
+    ``Accelerator.autocast(autocast_handler=...)`` suspend the compute-dtype
+    cast for the duration of the context — full-precision islands inside a
+    mixed-precision run. ``cache_enabled`` is torch-autocast-specific and
+    accepted for parity."""
+
+    enabled: bool = True
+    cache_enabled: bool | None = None
 
 
 @dataclass
@@ -357,6 +365,59 @@ class DeepSpeedPlugin(KwargsHandler):
                 "ACCELERATE_GRADIENT_ACCUMULATION_STEPS", self.gradient_accumulation_steps
             )
         )
+        if self.hf_ds_config is None:
+            self.hf_ds_config = os.environ.get("ACCELERATE_DEEPSPEED_CONFIG_FILE")
+        if self.hf_ds_config is not None:
+            self._ingest_ds_config()
+
+    def _ingest_ds_config(self):
+        """Read a DeepSpeed JSON config (path or dict), honoring ``"auto"``
+        values (reference config ingestion ``accelerator.py:1651-1891`` +
+        ``dataclasses.py:1131-1151``): concrete values override plugin
+        fields; ``"auto"`` entries are resolved at ``prepare`` time by
+        :meth:`fill_auto` and readable back via ``deepspeed_config``."""
+        import json
+
+        cfg = self.hf_ds_config
+        if isinstance(cfg, str):
+            with open(cfg) as f:
+                cfg = json.load(f)
+        if not isinstance(cfg, dict):
+            raise ValueError(f"hf_ds_config must be a dict or a JSON path, got {type(cfg)}")
+        self.deepspeed_config = cfg
+        zero = cfg.get("zero_optimization", {})
+
+        def _take(value, current):
+            return current if value in (None, "auto") else value
+
+        self.zero_stage = int(_take(zero.get("stage"), self.zero_stage))
+        self.gradient_accumulation_steps = int(
+            _take(cfg.get("gradient_accumulation_steps"), self.gradient_accumulation_steps)
+        )
+        clip = _take(cfg.get("gradient_clipping"), self.gradient_clipping)
+        self.gradient_clipping = float(clip) if clip is not None else None
+        self.offload_optimizer_device = _take(
+            zero.get("offload_optimizer", {}).get("device"), self.offload_optimizer_device
+        )
+        self.offload_param_device = _take(
+            zero.get("offload_param", {}).get("device"), self.offload_param_device
+        )
+
+    def fill_auto(self, values: dict):
+        """Resolve ``"auto"`` entries from runtime values (reference
+        ``fill_match``, ``dataclasses.py:1131-1151``). ``values`` maps
+        dotted config keys → concrete values; only keys currently set to
+        ``"auto"`` are written."""
+        cfg = getattr(self, "deepspeed_config", None)
+        if cfg is None:
+            return
+        for dotted, value in values.items():
+            node = cfg
+            *parents, leaf = dotted.split(".")
+            for p in parents:
+                node = node.setdefault(p, {})
+            if node.get(leaf) == "auto":
+                node[leaf] = value
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         strategy = {0: "NO_SHARD", 1: "SHARD_GRAD_OP", 2: "SHARD_GRAD_OP", 3: "FULL_SHARD"}[
@@ -394,6 +455,7 @@ class CustomDtype(BaseEnum):
     ``dataclasses.py:697``)."""
 
     FP8 = "fp8"
+    INT8 = "int8"
     INT4 = "int4"
     INT2 = "int2"
 

@@ -17,25 +17,74 @@ import numpy as np
 
 from .imports import is_torch_available
 
+#: the framework-level jax PRNG key — seeded by ``set_seed``, advanced by
+#: ``split_rng_key``, synced by ``synchronize_rng_state("jax")`` and carried
+#: in checkpoint RNG bundles (the analog of the reference's xm seed,
+#: ``checkpointing.py:144-161``)
+_JAX_KEY = None
+
 
 def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
-    """Seed python/numpy(/torch) and return the matching JAX key seed.
+    """Seed python/numpy/jax(/torch) and return the seed used.
 
     ``device_specific`` offsets the seed by process index (reference
     ``random.py:40-44``) — per-host different data augmentation while the
     mesh step stays bitwise-deterministic from the TrainState key.
     """
+    global _JAX_KEY
     from ..state import PartialState
 
     if device_specific:
         seed += PartialState().process_index
     random.seed(seed)
     np.random.seed(seed % (2**32))
+    import jax
+
+    _JAX_KEY = jax.random.PRNGKey(seed)
     if is_torch_available():
         import torch
 
         torch.manual_seed(seed)
     return seed
+
+
+def get_rng_key():
+    """The current framework jax key (seeded lazily from entropy if
+    ``set_seed`` was never called)."""
+    global _JAX_KEY
+    if _JAX_KEY is None:
+        import jax
+
+        _JAX_KEY = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**63))
+    return _JAX_KEY
+
+
+def split_rng_key(num: int = 1):
+    """Split fresh subkey(s) off the framework key, advancing it."""
+    global _JAX_KEY
+    import jax
+
+    keys = jax.random.split(get_rng_key(), num + 1)
+    _JAX_KEY = keys[0]
+    return keys[1] if num == 1 else keys[1:]
+
+
+def jax_rng_state() -> np.ndarray | None:
+    """Raw key data for checkpoint bundles (None if never seeded)."""
+    if _JAX_KEY is None:
+        return None
+    import jax
+
+    return np.asarray(jax.random.key_data(_JAX_KEY))
+
+
+def set_jax_rng_state(data) -> None:
+    global _JAX_KEY
+    if data is None:
+        return
+    import jax
+
+    _JAX_KEY = jax.random.wrap_key_data(np.asarray(data, dtype=np.uint32))
 
 
 def synchronize_rng_state(rng_type: str | None = None, generator=None):
@@ -62,7 +111,11 @@ def synchronize_rng_state(rng_type: str | None = None, generator=None):
         broadcast_object_list(payload)
         generator.set_state(payload[0])
     elif rng_type == RNGType.JAX:
-        pass  # the TrainState key is identical on all hosts by construction
+        # broadcast the main process's framework key (keys created via
+        # set_seed agree already; this repairs drift from uneven splits)
+        payload = [jax_rng_state()]
+        broadcast_object_list(payload)
+        set_jax_rng_state(payload[0])
 
 
 def synchronize_rng_states(rng_types: Iterable[str], generator=None):

@@ -31,13 +31,15 @@ import time
 # ---------------------------------------------------------------------------
 
 
-def _bench_config(platform: str):
+def _bench_config(platform: str, remat: bool = False):
     from accelerate_tpu.models import LlamaConfig
 
     if platform == "cpu":  # smoke-test sizing
         return LlamaConfig.tiny(vocab_size=512, hidden_size=128, layers=2, heads=4, seq=128), 4, 128
     # ~470M-param slice of the llama2 architecture; fits one v5e chip with
-    # adam state in fp32
+    # adam state in fp32. remat=False is ~6% faster when activations fit
+    # (measured on v5e); the measurement modes fall back to remat=True on
+    # RESOURCE_EXHAUSTED so a more-contended chip still produces a number.
     return (
         LlamaConfig(
             vocab_size=32000,
@@ -47,7 +49,7 @@ def _bench_config(platform: str):
             num_attention_heads=16,
             num_key_value_heads=16,
             max_position_embeddings=1024,
-            remat=True,
+            remat=remat,
         ),
         4,
         1024,
@@ -121,6 +123,19 @@ def _mode_probe() -> None:
     print(f"BENCH_DEVKIND {dev.device_kind}")
 
 
+def _is_oom(e: Exception) -> bool:
+    return "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+
+
+def _forced_remat() -> bool | None:
+    """A mode subprocess may be told which remat setting to use (argv[3]) so
+    framework and raw always measure EQUIVALENT programs — vs_baseline on
+    mismatched remat would be skewed by the ~6% recompute cost."""
+    if len(sys.argv) > 3 and sys.argv[3] in ("0", "1"):
+        return sys.argv[3] == "1"
+    return None
+
+
 def _mode_framework(platform: str) -> None:
     import jax
     import jax.numpy as jnp
@@ -131,27 +146,41 @@ def _mode_framework(platform: str) -> None:
     from accelerate_tpu.models import LlamaForCausalLM
     from accelerate_tpu.state import AcceleratorState, GradientState
 
-    config, bsz, seq = _bench_config(platform)
-    batch = _make_batch(config, bsz, seq)
+    def _build_and_time(remat: bool):
+        config, bsz, seq = _bench_config(platform, remat=remat)
+        batch = _make_batch(config, bsz, seq)
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        accelerator = Accelerator(mixed_precision="bf16")
+        model, opt = accelerator.prepare(
+            LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
+        )
+        n_params = sum(int(x.size) for x in jax.tree.leaves(model.params))
+        sharding = data_sharding(accelerator.mesh)
+        dev_batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in batch.items()}
 
-    AcceleratorState._reset_state(reset_partial_state=True)
-    GradientState._reset_state()
-    accelerator = Accelerator(mixed_precision="bf16")
-    model, opt = accelerator.prepare(
-        LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
-    )
-    n_params = sum(int(x.size) for x in jax.tree.leaves(model.params))
-    sharding = data_sharding(accelerator.mesh)
-    dev_batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in batch.items()}
+        def step():
+            out = model(**dev_batch)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            return out.loss.force()
 
-    def step():
-        out = model(**dev_batch)
-        accelerator.backward(out.loss)
-        opt.step()
-        opt.zero_grad()
-        return out.loss.force()
+        return _timed_steps(step, n_warmup=2, n_steps=10) / 10, n_params
 
-    t = _timed_steps(step, n_warmup=2, n_steps=10) / 10
+    if _forced_remat() is not None:
+        t, n_params = _build_and_time(remat=_forced_remat())
+        print(f"BENCH_REMAT {int(_forced_remat())}")
+    else:
+        try:
+            t, n_params = _build_and_time(remat=False)
+            print("BENCH_REMAT 0")
+        except Exception as e:  # noqa: BLE001 — OOM → rematerialised fallback
+            if not _is_oom(e):
+                raise
+            jax.clear_caches()
+            t, n_params = _build_and_time(remat=True)
+            print("BENCH_REMAT 1")
     print(f"BENCH_PARAMS {n_params}")
     print(f"BENCH_RESULT {t:.6f}")
 
@@ -166,35 +195,50 @@ def _mode_raw(platform: str) -> None:
 
     from accelerate_tpu.models import LlamaForCausalLM
 
-    config, bsz, seq = _bench_config(platform)
-    batch = _make_batch(config, bsz, seq)
+    def _build_and_time(remat: bool):
+        config, bsz, seq = _bench_config(platform, remat=remat)
+        batch = _make_batch(config, bsz, seq)
 
-    model = LlamaForCausalLM.from_config(config, seed=0)
-    tx = optax.adamw(1e-4)
-    params = model.params
-    opt_state = tx.init(params)
-    dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        model = LlamaForCausalLM.from_config(config, seed=0)
+        tx = optax.adamw(1e-4)
+        params = model.params
+        opt_state = tx.init(params)
+        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
 
-    def loss_fn(p, b):
-        p16 = jax.tree.map(
-            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
-        )
-        return model.apply_fn(p16, **b)["loss"].astype(jnp.float32)
+        def loss_fn(p, b):
+            p16 = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
+            )
+            return model.apply_fn(p16, **b)["loss"].astype(jnp.float32)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, s, b):
-        loss, grads = jax.value_and_grad(loss_fn)(p, b)
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-        updates, s = tx.update(grads, s, p)
-        return optax.apply_updates(p, updates), s, loss
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(p, s, b):
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
 
-    state = {"p": params, "s": opt_state}
+        state = {"p": params, "s": opt_state}
 
-    def step():
-        state["p"], state["s"], loss = train_step(state["p"], state["s"], dev_batch)
-        return loss
+        def step():
+            state["p"], state["s"], loss = train_step(state["p"], state["s"], dev_batch)
+            return loss
 
-    t = _timed_steps(step, n_warmup=2, n_steps=10) / 10
+        return _timed_steps(step, n_warmup=2, n_steps=10) / 10
+
+    if _forced_remat() is not None:
+        t = _build_and_time(remat=_forced_remat())
+        print(f"BENCH_REMAT {int(_forced_remat())}")
+    else:
+        try:
+            t = _build_and_time(remat=False)
+            print("BENCH_REMAT 0")
+        except Exception as e:  # noqa: BLE001 — OOM → rematerialised fallback
+            if not _is_oom(e):
+                raise
+            jax.clear_caches()
+            t = _build_and_time(remat=True)
+            print("BENCH_REMAT 1")
     print(f"BENCH_RESULT {t:.6f}")
 
 
@@ -244,7 +288,7 @@ def _mode_attn(platform: str) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _run_subprocess(mode: str, platform: str, attempts: int = 5) -> dict:
+def _run_subprocess(mode: str, platform: str, attempts: int = 5, extra_args: tuple = ()) -> dict:
     """Run one measurement mode in a fresh process, retrying with backoff on
     transient backend-init failures (shared-chip contention shows up as
     ``UNAVAILABLE`` / ``ALREADY_EXISTS`` during client creation)."""
@@ -253,7 +297,7 @@ def _run_subprocess(mode: str, platform: str, attempts: int = 5) -> dict:
     for attempt in range(attempts):
         try:
             out = subprocess.run(
-                [sys.executable, __file__, mode, platform],
+                [sys.executable, __file__, mode, platform, *extra_args],
                 capture_output=True,
                 text=True,
                 timeout=1800,
@@ -285,7 +329,12 @@ def main():
     n_dev = int(probe.get("BENCH_NDEV", ["1"])[0])
 
     fw = _run_subprocess("framework", platform)
-    raw = _run_subprocess("raw", platform)
+    fw_remat = fw.get("BENCH_REMAT", ["0"])[0]
+    # raw must measure the SAME program variant (remat skews ~6%)
+    raw = _run_subprocess("raw", platform, extra_args=(fw_remat,))
+    if raw.get("BENCH_REMAT", [fw_remat])[0] != fw_remat:
+        # raw couldn't fit the commanded setting: re-match the framework run
+        fw = _run_subprocess("framework", platform, extra_args=("1",))
     try:
         attn = _run_subprocess("attn", platform, attempts=2)
         t_flash, t_block = (float(x) for x in attn["BENCH_ATTN"])

@@ -73,25 +73,21 @@ def training_function(config, args):
     )
 
     starting_epoch = 0
-    resume_step = None
     overall_step = 0
     if args.resume_from_checkpoint:
         accelerator.print(f"Resumed from checkpoint: {args.resume_from_checkpoint}")
         accelerator.load_state(args.resume_from_checkpoint)
-        # the loader's deep state was restored too; derive the position
+        # load_state restored the dataloader's deep state: the next
+        # iteration of train_dataloader resumes mid-epoch by itself (no
+        # skip_first_batches needed — that's the manual-resume API)
         overall_step = accelerator.step
         starting_epoch = overall_step // steps_per_epoch
-        resume_step = overall_step - starting_epoch * steps_per_epoch
 
     for epoch in range(starting_epoch, num_epochs):
         model.train()
         train_dataloader.set_epoch(epoch)
         total_loss = 0.0
-        if args.resume_from_checkpoint and epoch == starting_epoch and resume_step:
-            active_dataloader = accelerator.skip_first_batches(train_dataloader, resume_step)
-        else:
-            active_dataloader = train_dataloader
-        for step, batch in enumerate(active_dataloader):
+        for step, batch in enumerate(train_dataloader):
             outputs = model(**batch)
             loss = outputs.loss
             accelerator.backward(loss)

@@ -177,3 +177,23 @@ class TestDebugLauncher:
         from accelerate_tpu.test_utils.scripts.test_script import main
 
         debug_launcher(main, num_processes=2)
+
+
+@pytest.mark.slow
+def test_launched_ops_script():
+    """The test_ops assertion script through the product launcher
+    (reference ``tests/test_multigpu.py:48-53`` pattern)."""
+    from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
+
+    cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_ops"]
+    out = execute_subprocess_async(cmd)
+    assert "ALL_OPS_OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_launched_sync_script():
+    from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
+
+    cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_sync"]
+    out = execute_subprocess_async(cmd)
+    assert "ALL_SYNC_OK" in out.stdout

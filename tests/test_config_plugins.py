@@ -1,0 +1,172 @@
+"""DeepSpeed config-file ingestion + questionnaire depth + test_utils
+helpers (reference: ds-config `auto` handling ``accelerator.py:1651-1891``,
+``cluster.py:54`` questionnaire, ``test_utils/testing.py``)."""
+
+import json
+from unittest import mock
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, DeepSpeedPlugin
+from accelerate_tpu.commands.config import ClusterConfig, get_cluster_input
+from accelerate_tpu.test_utils import (
+    DEFAULT_LAUNCH_COMMAND,
+    RegressionDataset,
+    RegressionModel,
+    get_backend,
+    get_launch_command,
+    require_cpu,
+    require_tpu,
+)
+
+
+def _ds_config(tmp_path, **overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": "auto",
+        "train_batch_size": "auto",
+        "gradient_accumulation_steps": 2,
+        "gradient_clipping": 0.7,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "offload_param": {"device": "auto"},
+        },
+        "optimizer": {"type": "AdamW", "params": {"lr": "auto"}},
+    }
+    cfg.update(overrides)
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def test_ds_config_file_overrides_plugin_fields(tmp_path):
+    plugin = DeepSpeedPlugin(hf_ds_config=_ds_config(tmp_path))
+    assert plugin.zero_stage == 3
+    assert plugin.gradient_accumulation_steps == 2
+    assert plugin.gradient_clipping == 0.7
+    assert plugin.offload_optimizer_device == "cpu"
+    assert plugin.offload_param_device is None  # "auto" leaves the default
+    assert plugin.to_fsdp_plugin().sharding_strategy == "FULL_SHARD"
+
+
+def test_ds_config_auto_filled_at_prepare(tmp_path):
+    plugin = DeepSpeedPlugin(hf_ds_config=_ds_config(tmp_path))
+    accelerator = Accelerator(deepspeed_plugin=plugin)
+
+    class _Loader:
+        def __init__(self):
+            self.dataset = RegressionDataset(length=64)
+            self.batch_size = 16
+            self.drop_last = False
+            self.sampler = self.batch_sampler = self.collate_fn = None
+
+    model = RegressionModel()
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.05)
+    accelerator.prepare(model, tx, _Loader())
+    cfg = plugin.deepspeed_config
+    assert cfg["train_micro_batch_size_per_gpu"] != "auto"
+    assert cfg["train_batch_size"] == 16 * plugin.gradient_accumulation_steps
+    assert cfg["optimizer"]["params"]["lr"] == pytest.approx(0.05)
+
+
+def test_questionnaire_deepspeed_branch():
+    answers = iter([
+        "jax_tpu",  # compute env
+        "1",        # hosts
+        "1",        # fsdp extent (1 → offer deepspeed)
+        "yes",      # use deepspeed?
+        "",         # no config file → questionnaire
+        "3",        # zero stage
+        "yes",      # offload optimizer
+        "no",       # offload params
+        "4",        # zero shard extent
+        "2",        # tp
+        "1",        # cp
+        "1",        # ep
+        "bf16",     # precision
+        "1",        # accumulation
+        "no",       # debug
+        "main",     # main fn
+    ])
+    with mock.patch("builtins.input", lambda prompt="": next(answers)):
+        cfg = get_cluster_input()
+    assert cfg.use_deepspeed
+    assert cfg.deepspeed_config["zero_stage"] == 3
+    assert cfg.deepspeed_config["offload_optimizer_device"] == "cpu"
+    assert cfg.mesh_fsdp == 4 and cfg.use_fsdp
+    assert cfg.mesh_tp == 2
+    env = cfg.to_environment()
+    assert env["ACCELERATE_USE_DEEPSPEED"] == "true"
+    assert env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] == "3"
+
+
+def test_questionnaire_fsdp_branch_roundtrips(tmp_path):
+    answers = iter([
+        "cpu_mesh", "8",        # env + devices
+        "1",                    # hosts
+        "2",                    # fsdp extent
+        "FULL_SHARD", "0", "yes", "no",  # fsdp sub-questionnaire
+        "1", "2", "1",          # tp, cp, ep
+        "ulysses",              # cp mode
+        "bf16", "2", "yes",     # precision, accum, debug
+        "train",                # main fn
+    ])
+    with mock.patch("builtins.input", lambda prompt="": next(answers)):
+        cfg = get_cluster_input()
+    assert cfg.fsdp_config["activation_checkpointing"] is True
+    assert cfg.context_parallel_mode == "ulysses"
+    assert cfg.debug
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    loaded = ClusterConfig.load(path)
+    assert loaded.fsdp_config == cfg.fsdp_config
+    assert loaded.main_training_function == "train"
+
+
+def test_launch_command_builder():
+    cmd = get_launch_command(num_cpu_devices=4, mesh_tp=2, debug=True)
+    assert "--num_cpu_devices" in cmd and "4" in cmd
+    assert "--mesh_tp" in cmd and "2" in cmd
+    assert "--debug" in cmd
+    assert DEFAULT_LAUNCH_COMMAND[0].endswith("python") or "python" in DEFAULT_LAUNCH_COMMAND[0]
+
+
+def test_get_backend_and_require_markers():
+    platform, count, mem_fn = get_backend()
+    assert platform == "cpu" and count == 8
+    assert callable(mem_fn)
+
+    @require_cpu
+    def runs():
+        return True
+
+    assert runs()
+
+
+@require_tpu
+def test_require_tpu_skips_on_cpu():
+    raise AssertionError("must be skipped on the CPU mesh")
+
+def test_megatron_plugin_lowers_to_mesh_axes():
+    import jax
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import MegatronLMPlugin
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, sequence_parallelism=True))
+    shape = dict(acc.mesh.shape)
+    assert shape["tp"] == 2
+    assert shape["cp"] == 2  # Megatron-SP: sequence sharded over the tp group size
+
+
+def test_megatron_pp_raises():
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import MegatronLMPlugin
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    with pytest.raises(NotImplementedError, match="prepare_pippy"):
+        Accelerator(megatron_lm_plugin=MegatronLMPlugin(pp_degree=2))

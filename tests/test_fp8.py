@@ -1,0 +1,101 @@
+"""fp8 matmul policy (reference backends: TransformerEngine
+``utils/transformer_engine.py:26`` / MS-AMP ``accelerator.py:2034``;
+coverage row §2.5 fp8 — previously silently bf16)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.ops.fp8 import (
+    E4M3_MAX,
+    FP8RecipeKwargs,
+    dense,
+    fp8_autocast,
+    fp8_is_active,
+    fp8_matmul,
+)
+
+
+def test_fp8_matmul_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    exact = x @ w
+    out = fp8_matmul(x, w)
+    # e4m3 carries ~3 mantissa bits (~6% per-element); cancellation makes
+    # per-element relative error unbounded where the exact value ≈ 0, so
+    # bound the global relative error and the typical element
+    rel = np.abs(np.asarray(out - exact)) / (np.abs(np.asarray(exact)) + 1.0)
+    assert np.median(rel) < 0.05
+    norm_rel = np.linalg.norm(np.asarray(out - exact)) / np.linalg.norm(np.asarray(exact))
+    assert norm_rel < 0.05, norm_rel
+
+
+def test_dense_routes_by_context():
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 2), jnp.float32)
+    assert not fp8_is_active()
+    exact = dense(x, w)
+    np.testing.assert_array_equal(np.asarray(exact), np.asarray(x @ w))
+    with fp8_autocast():
+        assert fp8_is_active()
+        out = dense(x, w)
+    assert not fp8_is_active()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exact), rtol=0.05)
+
+
+def test_fp8_grads_flow_and_are_close():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+
+    def loss_fp8(w):
+        with fp8_autocast():
+            return jnp.sum(dense(x, w) ** 2)
+
+    def loss_exact(w):
+        return jnp.sum((x @ w) ** 2)
+
+    g8 = jax.grad(loss_fp8)(w)
+    g = jax.grad(loss_exact)(w)
+    cos = np.sum(np.asarray(g8) * np.asarray(g)) / (
+        np.linalg.norm(g8) * np.linalg.norm(g)
+    )
+    assert cos > 0.99, f"gradient direction diverged: cos={cos}"
+
+
+def test_fp8_training_decreases_loss():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    accelerator = Accelerator(
+        mixed_precision="fp8", kwargs_handlers=[FP8RecipeKwargs(fp8_format="HYBRID")]
+    )
+    config = LlamaConfig.tiny(vocab_size=128, hidden_size=64, layers=2, heads=4, seq=32)
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    model, opt = accelerator.prepare(model, optax.adamw(1e-2))
+    assert model.fp8_recipe is not None
+    ids = np.random.default_rng(0).integers(0, 128, size=(4, 32)).astype(np.int32)
+    losses = []
+    for _ in range(6):
+        out = model(input_ids=ids, labels=ids)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(out.loss.item())
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_fp8_quantization_is_actually_applied():
+    """The fp8 path must change numerics vs plain bf16 — no silent
+    fallthrough (the round-1 gap: fp8 mapped to bf16 with no policy)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 128)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(128, 8)), jnp.float32)
+    exact = np.asarray(x @ w)
+    with fp8_autocast():
+        out = np.asarray(dense(x, w))
+    assert not np.allclose(out, exact, rtol=1e-6), "fp8 path identical to fp32 — inactive"

@@ -1,0 +1,182 @@
+"""Multi-host code paths exercised without a cluster: the serialization /
+padding / agreement logic of the eager collectives (reference runs these
+under launched N-process tests, ``test_utils/scripts/test_ops.py``; here
+``multihost_utils`` is faked so the branches run in one process) and the
+pod-fanout command construction (VERDICT Weak-9)."""
+
+import numpy as np
+import pytest
+from unittest import mock
+
+# make jax.experimental.multihost_utils an existing attribute so
+# mock.patch can swap it (it loads lazily otherwise)
+from jax.experimental import multihost_utils as _real_multihost  # noqa: F401
+
+from accelerate_tpu import operations as ops
+from accelerate_tpu.state import PartialState
+
+
+@pytest.fixture
+def two_process_state():
+    state = PartialState()
+    saved = dict(num_processes=state.num_processes, process_index=state.process_index)
+    state.num_processes = 2
+    state.process_index = 0
+    yield state
+    state.num_processes = saved["num_processes"]
+    state.process_index = saved["process_index"]
+
+
+class _FakeMultihost:
+    """Emulates a 2-process world: the 'other' process's contribution is
+    primed per call."""
+
+    def __init__(self, other_payloads):
+        self.other = list(other_payloads)
+
+    def process_allgather(self, x, tiled=False):
+        other = self.other.pop(0)
+        if tiled:
+            return np.concatenate([np.asarray(x), np.asarray(other)])
+        return np.stack([np.asarray(x), np.asarray(other)])
+
+    def broadcast_one_to_all(self, x, is_source=True):
+        if is_source:
+            return np.asarray(x)
+        return np.asarray(self.other.pop(0))
+
+
+def test_gather_object_pads_and_unpacks_uneven_payloads(two_process_state):
+    import pickle
+
+    mine = ["short"]
+    theirs = ["a much longer object from the other process", {"k": 1}]
+    their_payload = np.frombuffer(pickle.dumps(theirs), dtype=np.uint8)
+    my_payload = np.frombuffer(pickle.dumps(mine), dtype=np.uint8)
+    max_size = max(their_payload.size, my_payload.size)
+    their_padded = np.zeros(max_size, np.uint8)
+    their_padded[: their_payload.size] = their_payload
+    fake = _FakeMultihost(
+        [np.array([their_payload.size], np.int64), their_padded]
+    )
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        out = ops.gather_object(mine)
+    assert out == mine + theirs
+
+
+def test_broadcast_object_list_receiver_side(two_process_state):
+    import pickle
+
+    two_process_state.process_index = 1  # not the source
+    source_obj = [{"weights": [1, 2, 3]}, "tag"]
+    payload = np.frombuffer(pickle.dumps(source_obj), dtype=np.uint8)
+    fake = _FakeMultihost([np.array([payload.size], np.int64), payload])
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        received = [None]
+        ops.broadcast_object_list(received)
+    assert received == source_obj
+
+
+def test_verify_operation_raises_on_shape_mismatch(two_process_state):
+    import pickle
+
+    two_process_state.debug = True
+    # the other process reports a different shape for the same gather
+    other_meta = [((4, 4), "float32")]
+    their_payload = np.frombuffer(pickle.dumps([other_meta[0]]), dtype=np.uint8)
+
+    # gather() first runs the debug meta agreement via gather_object
+    my_meta = ((2, 2), "float32")
+    my_payload = np.frombuffer(pickle.dumps([my_meta]), dtype=np.uint8)
+    max_size = max(their_payload.size, my_payload.size)
+    their_padded = np.zeros(max_size, np.uint8)
+    their_padded[: their_payload.size] = their_payload
+    fake = _FakeMultihost([np.array([their_payload.size], np.int64), their_padded])
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        with pytest.raises(ops.DistributedOperationException, match="Mismatch"):
+            ops.gather(np.zeros((2, 2), np.float32))
+
+
+def test_verify_operation_passes_on_agreement(two_process_state):
+    import pickle
+
+    two_process_state.debug = True
+    meta = ((2, 2), "float32")
+    payload = np.frombuffer(pickle.dumps([meta]), dtype=np.uint8)
+    # call 1+2: meta agreement gather_object; call 3: the actual allgather
+    fake = _FakeMultihost([
+        np.array([payload.size], np.int64), payload,
+        np.ones((2, 2), np.float32),
+    ])
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        out = ops.gather(np.zeros((2, 2), np.float32))
+    assert np.asarray(out).shape == (4, 2)  # tiled concat of 2 processes
+
+
+# ---------------------------------------------------------------------------
+# pod fanout (commands/tpu.py)
+# ---------------------------------------------------------------------------
+
+
+def _pod_cfg(**kw):
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    defaults = dict(num_machines=2, tpu_name="my-pod", tpu_zone="us-central2-b")
+    defaults.update(kw)
+    return ClusterConfig(**defaults)
+
+
+def test_build_pod_commands_explicit_coordinator():
+    from accelerate_tpu.commands.tpu import build_pod_commands
+
+    cfg = _pod_cfg(coordinator_address="10.0.0.2:8476")
+    cmds = build_pod_commands(
+        cfg, "train.py", ["--lr", "1e-3"], {"ACCELERATE_MIXED_PRECISION": "bf16"}
+    )
+    assert len(cmds) == 2
+    for worker, cmd in enumerate(cmds):
+        assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod"]
+        assert f"--worker={worker}" in cmd
+        inner = cmd[-1]
+        assert f"ACCELERATE_PROCESS_ID='{worker}'" in inner
+        assert "ACCELERATE_NUM_PROCESSES='2'" in inner
+        assert "ACCELERATE_COORDINATOR_ADDR='10.0.0.2:8476'" in inner
+        assert "ACCELERATE_MIXED_PRECISION='bf16'" in inner
+        assert inner.endswith("python3 train.py --lr 1e-3")
+        # the round-1 bug: a literal $(hostname -i) that never expands
+        assert "hostname" not in inner
+
+
+def test_resolve_coordinator_asks_gcloud_for_worker0():
+    from accelerate_tpu.commands import tpu as tpu_mod
+
+    cfg = _pod_cfg(coordinator_address=None)
+    fake = mock.Mock(returncode=0, stdout="10.128.0.7\n")
+    with mock.patch.object(tpu_mod.subprocess, "run", return_value=fake) as run:
+        addr = tpu_mod.resolve_coordinator(cfg)
+    assert addr == "10.128.0.7:8476"
+    called = run.call_args[0][0]
+    assert "describe" in called and "my-pod" in called
+
+
+def test_resolve_coordinator_falls_back_to_autodetect():
+    from accelerate_tpu.commands import tpu as tpu_mod
+
+    cfg = _pod_cfg(coordinator_address=None)
+    with mock.patch.object(tpu_mod.subprocess, "run", side_effect=OSError("no gcloud")):
+        assert tpu_mod.resolve_coordinator(cfg) is None
+    # None coordinator → workers use jax's TPU-pod metadata auto-detect;
+    # the env must then omit the coordinator entirely
+    with mock.patch.object(tpu_mod.subprocess, "run", side_effect=OSError("no gcloud")):
+        cmds = tpu_mod.build_pod_commands(cfg, "t.py", [], {})
+    assert "ACCELERATE_COORDINATOR_ADDR" not in cmds[0][-1]
+
+
+def test_pod_fanout_dry_run_prints(capsys):
+    from accelerate_tpu.commands.tpu import pod_fanout
+
+    cfg = _pod_cfg(coordinator_address="10.0.0.2:8476")
+    rc = pod_fanout(cfg, "train.py", [], {}, dry_run=True)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("gcloud compute tpus tpu-vm ssh") == 2
