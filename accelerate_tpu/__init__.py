@@ -79,4 +79,8 @@ def __getattr__(name):
         from . import hooks
 
         return getattr(hooks, name)
+    if name == "generate":
+        from .generation import generate
+
+        return generate
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

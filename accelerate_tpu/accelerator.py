@@ -182,10 +182,12 @@ class Accelerator:
         self.context_parallel_plugin = context_parallel_plugin
 
         # Megatron facade lowers onto mesh axes (SURVEY §2.2: tp_degree →
-        # tp axis; sequence_parallelism → sequence-sharded activations,
-        # which ride the cp axis here — sized to the tp group like
-        # Megatron-SP; pp_degree has no training analog on TPU,
-        # prepare_pippy covers inference pipelining)
+        # tp axis; pp_degree has no training analog on TPU — prepare_pippy
+        # covers inference pipelining). Megatron-SP shards activations over
+        # the EXISTING tp group, which has no 1:1 GSPMD mapping here; the
+        # cp axis is this framework's (strictly more general) sequence
+        # sharding, so the flag only points users there rather than
+        # silently multiplying the device requirement.
         if megatron_lm_plugin is not None and mesh_plugin is None:
             if getattr(megatron_lm_plugin, "pp_degree", 1) > 1:
                 raise NotImplementedError(
@@ -193,9 +195,13 @@ class Accelerator:
                     "(GSPMD sharding wins); use prepare_pippy for inference "
                     "pipelining, or tp/fsdp axes for training"
                 )
-            tp_degree = getattr(megatron_lm_plugin, "tp_degree", 1)
-            sp = getattr(megatron_lm_plugin, "sequence_parallelism", False)
-            mesh_plugin = MeshPlugin(tp=tp_degree, cp=tp_degree if sp and tp_degree > 1 else 1)
+            if getattr(megatron_lm_plugin, "sequence_parallelism", False):
+                logger.info(
+                    "Megatron sequence_parallelism maps onto the cp mesh axis "
+                    "here; size it explicitly (MeshPlugin(cp=...) or "
+                    "--mesh_cp) to shard sequence activations"
+                )
+            mesh_plugin = MeshPlugin(tp=getattr(megatron_lm_plugin, "tp_degree", 1))
 
         # kwargs handlers (reference :387-421)
         from .ops.fp8 import FP8RecipeKwargs
@@ -846,14 +852,18 @@ class Accelerator:
         for the context — a full-precision island inside a mixed-precision
         run (reference ``accelerator.py:3435``)."""
         if autocast_handler is not None and not getattr(autocast_handler, "enabled", True):
-            saved = [(m, m.compute_dtype) for m in self._models]
-            for m, _ in saved:
+            # suspend BOTH precision policies: the dtype cast and the fp8
+            # matmul recipe (deferred calls snapshot them at record time)
+            saved = [(m, m.compute_dtype, m.fp8_recipe) for m in self._models]
+            for m, _, _ in saved:
                 m.compute_dtype = None
+                m.fp8_recipe = None
             try:
                 yield
             finally:
-                for m, dtype in saved:
+                for m, dtype, recipe in saved:
                     m.compute_dtype = dtype
+                    m.fp8_recipe = recipe
             return
         yield
 

@@ -278,13 +278,6 @@ def _ppart(p) -> str:
     return str(getattr(p, "name", p))
 
 
-@jax.jit
-def _dequant_on_device(q, scale):
-    """int8 → f32 upcast that runs on-device right after the H2D copy (the
-    bytes moved were int8; XLA fuses the multiply into the consumer)."""
-    return q.astype(jnp.float32) * scale
-
-
 class DispatchedModel:
     """Callable model over tiered params. With a cooperating model
     (``model.segments``) execution streams segment-by-segment with
@@ -359,16 +352,21 @@ class DispatchedModel:
         for host/disk tiers this slices the numpy/memmap view, so one layer's
         bytes move, not the whole stack. Quantized leaves live as
         ``<path>.q``/``<path>.scale`` pairs — the int8 bytes are what cross
-        disk→host→HBM; dequantization runs on-device after the copy."""
+        disk→host→HBM; they stay :class:`QTensor`s here and the segment's
+        compiled fn dequantizes in-kernel (fused into the consuming matmul —
+        no materialised full-precision copy)."""
+        from .utils.quantization import QTensor
+
         out = {}
         for entry in paths:
             p, idx = entry if isinstance(entry, tuple) else (entry, None)
             try:
                 out[p] = self._fetch_one(p, idx)
             except KeyError:
-                q = self._fetch_one(f"{p}.q", idx)
-                scale = self._fetch_one(f"{p}.scale", idx)
-                out[p] = _dequant_on_device(q, scale)
+                out[p] = QTensor(
+                    self._fetch_one(f"{p}.q", idx),
+                    self._fetch_one(f"{p}.scale", idx),
+                )
         return out
 
     def _call_streaming(self, segments, *args, **kwargs):
@@ -401,7 +399,17 @@ class DispatchedModel:
             key = name if isinstance(name, str) else name[0]
             jit_fn = self._segment_fns.get(key)
             if jit_fn is None:
-                jit_fn = jax.jit(fn)
+                from .utils.quantization import dequantize_tree
+
+                def _dequant_then(fn):
+                    # QTensor leaves upcast INSIDE the compiled segment so
+                    # XLA fuses q*scale into the consumer
+                    def wrapped(seg, carry):
+                        return fn(dequantize_tree(seg), carry)
+
+                    return wrapped
+
+                jit_fn = jax.jit(_dequant_then(fn))
                 self._segment_fns[key] = jit_fn
             carry = jit_fn(seg_params, carry)
         return plan["finalize"](carry)

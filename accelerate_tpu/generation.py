@@ -1,0 +1,84 @@
+"""Autoregressive generation over any framework model wrapper.
+
+The reference delegates generation to ``transformers.generate`` running on
+its hooked/offloaded modules — what its big-model-inference benchmark
+measures as s/token (``benchmarks/big_model_inference/README.md:27-37``).
+This build ships its own loop so the same measurement exists for zoo
+models behind any executor: a plain :class:`Model`, a prepared model, a
+:class:`DispatchedModel` streaming from host/disk, or a pipelined model.
+
+Design for XLA: the token buffer has a STATIC shape ``[b, prompt+max_new]``
+(right-padded, mask-tracked), so every decode step reuses one compiled
+forward; the step index only changes mask values and the gather position.
+With a causal model, logits at position ``cur-1`` are unaffected by the
+padded tail, so full-length forwards are exact. (For offload-tier models
+the weight streaming dominates decode time, which is precisely the
+benchmarked regime; a resident-model KV cache is a latency optimisation,
+not a correctness one.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _logits_of(out):
+    logits = out["logits"] if isinstance(out, dict) else out.logits
+    if hasattr(logits, "force"):  # deferred (prepared model)
+        logits = logits.force()
+    return logits
+
+
+def generate(
+    model,
+    input_ids,
+    max_new_tokens: int = 20,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    eos_token_id: int | None = None,
+    seed: int = 0,
+    attention_mask=None,
+):
+    """Greedy / temperature-sampled decoding. Returns ``[b, prompt+new]``
+    int32 token ids (right-padded with ``eos`` after a sequence finishes).
+    """
+    ids = np.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, prompt_len = ids.shape
+    total = prompt_len + max_new_tokens
+    buf = np.zeros((b, total), np.int32)
+    buf[:, :prompt_len] = ids
+    mask = np.zeros((b, total), np.int32)
+    if attention_mask is not None:
+        mask[:, :prompt_len] = np.asarray(attention_mask)
+    else:
+        mask[:, :prompt_len] = 1
+    # per-row decode position: right-padded shorter prompts continue from
+    # THEIR last real token, not the batch-uniform column
+    lengths = mask.sum(axis=1).astype(np.int64)
+
+    key = jax.random.PRNGKey(seed)
+    finished = np.zeros((b,), bool)
+    rows = np.arange(b)
+    for _ in range(max_new_tokens):
+        out = model(input_ids=jnp.asarray(buf), attention_mask=jnp.asarray(mask))
+        all_logits = np.asarray(jax.device_get(_logits_of(out)))
+        logits = all_logits[rows, lengths - 1, :]
+        if do_sample:
+            key, sub = jax.random.split(key)
+            scaled = jnp.asarray(logits) / max(temperature, 1e-6)
+            next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
+        else:
+            next_tok = logits.argmax(axis=-1)
+        if eos_token_id is not None:
+            next_tok = np.where(finished, eos_token_id, next_tok)
+            finished |= next_tok == eos_token_id
+        buf[rows, lengths] = next_tok
+        mask[rows, lengths] = 1
+        lengths += 1
+        if eos_token_id is not None and finished.all():
+            break
+    return buf[:, : int(lengths.max())]

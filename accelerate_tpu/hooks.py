@@ -69,9 +69,15 @@ class SequentialHook(ModelHook):
 
 def add_hook_to_module(module, hook: ModelHook, append: bool = False):
     """Patch ``module``'s call to run ``hook`` around it (reference
-    ``add_hook_to_module`` ``hooks.py:124``). Works on :class:`Model`,
-    :class:`PreparedModel`, :class:`DispatchedModel` — anything callable
-    with an instance-patchable ``__call__`` path."""
+    ``add_hook_to_module`` ``hooks.py:124``). Works on callable model
+    wrappers — :class:`PreparedModel`, ``DispatchedModel``,
+    ``PipelinedModel``. A raw :class:`Model` is not callable (apply via
+    ``apply_fn``); prepare it first."""
+    if not callable(module):
+        raise TypeError(
+            f"{type(module).__name__} is not callable — hooks wrap a model's "
+            "call; prepare() or dispatch_model() it first"
+        )
     if append and getattr(module, "_hf_hook", None) is not None:
         old = module._hf_hook
         remove_hook_from_module(module)

@@ -241,8 +241,10 @@ def prepare_pippy(
     else:
         names = [n if isinstance(n, str) else n[0] for n, _, _ in steps]
         bounds = [0] + [names.index(sp) for sp in split_points]
-        if len(bounds) > len(devices):
-            raise ValueError(f"{len(bounds)} stages but only {len(devices)} devices")
+    # dedup + drop empty trailing stages BEFORE counting against devices
+    bounds = sorted({b for b in bounds if b < len(steps)})
+    if len(bounds) > len(devices):
+        raise ValueError(f"{len(bounds)} stages but only {len(devices)} devices")
     split_names = []
     for b in bounds[1:]:
         n = steps[b][0]

@@ -61,13 +61,13 @@ class ModelCallNode(Node):
     inputs. ``model`` is static (closed over at trace time); array leaves of
     args/kwargs become graph inputs.
 
-    ``compute_dtype`` snapshots the model's precision policy AT CALL TIME —
-    replay happens later (at ``step()``/``force()``), by which point an
-    ``autocast(enabled=False)`` island has exited; the snapshot is what
-    makes the island apply to deferred calls made inside it. It is part of
-    the jit-cache signature (see ``linearize``)."""
+    ``compute_dtype``/``fp8_recipe`` snapshot the model's precision policy
+    AT CALL TIME — replay happens later (at ``step()``/``force()``), by
+    which point an ``autocast(enabled=False)`` island has exited; the
+    snapshot is what makes the island apply to deferred calls made inside
+    it. Both are part of the jit-cache signature (see ``linearize``)."""
 
-    __slots__ = ("model", "call_args", "call_kwargs", "compute_dtype")
+    __slots__ = ("model", "call_args", "call_kwargs", "compute_dtype", "fp8_recipe")
 
     def __init__(self, model, call_args: tuple, call_kwargs: dict):
         super().__init__("model_call", ())
@@ -75,6 +75,7 @@ class ModelCallNode(Node):
         self.call_args = call_args
         self.call_kwargs = call_kwargs
         self.compute_dtype = getattr(model, "compute_dtype", None)
+        self.fp8_recipe = getattr(model, "fp8_recipe", None)
 
 
 def _is_array(x) -> bool:
@@ -140,7 +141,11 @@ def linearize(root: Node):
                     arg_ids.append(("leaf", idx, _leaf_sig(leaf)))
             my_id = len(sig_parts)
             sig_parts.append(
-                ("model_call", m_idx, str(treedef), tuple(arg_ids), str(node.compute_dtype))
+                (
+                    "model_call", m_idx, str(treedef), tuple(arg_ids),
+                    str(node.compute_dtype),
+                    getattr(node.fp8_recipe, "fp8_format", None),
+                )
             )
         else:
             child_ids = tuple(walk(as_node(a)) for a in node.args)
@@ -195,7 +200,10 @@ def replay(root: Node, input_values: list, params_env: dict[int, Any]):
             args, kwargs = jax.tree.unflatten(treedef, resolved)
             params = params_env.get(id(node.model))
             out = node.model._raw_apply(
-                params, *args, _compute_dtype=node.compute_dtype, **kwargs
+                params, *args,
+                _compute_dtype=node.compute_dtype,
+                _fp8_recipe=node.fp8_recipe,
+                **kwargs,
             )
         elif node.op in _BINARY:
             out = _BINARY[node.op](ev(as_node(node.args[0])), ev(as_node(node.args[1])))

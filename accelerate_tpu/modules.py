@@ -157,25 +157,28 @@ class PreparedModel:
 
     _DTYPE_UNSET = object()
 
-    def _raw_apply(self, params, *args, _compute_dtype=_DTYPE_UNSET, **kwargs):
+    def _raw_apply(
+        self, params, *args, _compute_dtype=_DTYPE_UNSET, _fp8_recipe=_DTYPE_UNSET, **kwargs
+    ):
         """Called at trace time from the deferred replay. ``_compute_dtype``
-        is the policy snapshotted when the call was RECORDED (autocast
-        islands must bind at call time, not at the later trace time)."""
+        / ``_fp8_recipe`` are the policies snapshotted when the call was
+        RECORDED (autocast islands must bind at call time, not at the later
+        trace time)."""
         import contextlib
 
-        compute_dtype = (
-            self.compute_dtype if _compute_dtype is PreparedModel._DTYPE_UNSET else _compute_dtype
-        )
+        unset = PreparedModel._DTYPE_UNSET
+        compute_dtype = self.compute_dtype if _compute_dtype is unset else _compute_dtype
+        fp8_recipe = self.fp8_recipe if _fp8_recipe is unset else _fp8_recipe
         if params is None:
             params = self.params
         if compute_dtype is not None:
             params = _cast_floats(params, compute_dtype)
             args = _cast_floats(args, compute_dtype)
             kwargs = _cast_floats(kwargs, compute_dtype)
-        if self.fp8_recipe is not None:
+        if fp8_recipe is not None:
             from .ops.fp8 import fp8_autocast
 
-            ctx = fp8_autocast(enabled=True, fp8_format=self.fp8_recipe.fp8_format)
+            ctx = fp8_autocast(enabled=True, fp8_format=fp8_recipe.fp8_format)
         else:
             ctx = contextlib.nullcontext()
         with ctx:

@@ -83,6 +83,14 @@ def dequantize_tree(params, dtype=jnp.float32):
     )
 
 
+#: embedding/head names across the model zoo — bnb never swaps
+#: ``nn.Embedding`` (quality: one outlier token row would crush the
+#: per-channel resolution of every other row); same default here
+DEFAULT_SKIP_MODULES = [
+    "embed_tokens", "embed_positions", "embed_types", "wte", "wpe", "lm_head",
+]
+
+
 @dataclass
 class BnbQuantizationConfig:
     """Parity surface of the reference's config (``dataclasses.py:2365``);
@@ -95,6 +103,7 @@ class BnbQuantizationConfig:
     skip_modules: list = field(default_factory=list)
     keep_in_fp32_modules: list = field(default_factory=list)
     torch_dtype: Any = None  # compute dtype of the dequantized matmul
+    quantize_embeddings: bool = False  # override the DEFAULT_SKIP_MODULES guard
 
     @property
     def compute_dtype(self):
@@ -119,6 +128,12 @@ def _eligible(path: str, leaf, config: BnbQuantizationConfig) -> bool:
     for pat in list(config.skip_modules) + list(config.keep_in_fp32_modules):
         if re.fullmatch(pat, path) or path == pat or path.startswith(pat + "."):
             return False
+    if not config.quantize_embeddings:
+        # embedding guard matches path SEGMENTS, so nested layouts
+        # ('transformer.wte', 'model.embed_tokens') are protected too
+        segments = path.split(".")
+        if any(name in segments for name in DEFAULT_SKIP_MODULES):
+            return False
     return True
 
 
@@ -130,15 +145,15 @@ def quantize_model_params(model: Model, config: BnbQuantizationConfig) -> Model:
     from ..big_modeling import _ppart
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(model.params)
-    new_leaves = []
-    n_quantized = 0
-    for path, leaf in flat:
-        key = ".".join(_ppart(p) for p in path)
-        if _eligible(key, leaf, config):
-            new_leaves.append(quantize_array(leaf))
-            n_quantized += 1
-        else:
-            new_leaves.append(leaf)
+    plan = [
+        (path, leaf, _eligible(".".join(_ppart(p) for p in path), leaf, config))
+        for path, leaf in flat
+    ]
+    if not any(e for _, _, e in plan):
+        # check BEFORE mutating: a failed call must leave the model intact
+        raise ValueError("no parameters were eligible for quantization")
+
+    new_leaves = [quantize_array(leaf) if e else leaf for _, leaf, e in plan]
     model.params = jax.tree_util.tree_unflatten(
         jax.tree.structure(model.params), new_leaves
     )
@@ -152,8 +167,6 @@ def quantize_model_params(model: Model, config: BnbQuantizationConfig) -> Model:
     model.apply_fn = quantized_apply
     model.is_quantized = True
     model.quantization_config = config
-    if n_quantized == 0:
-        raise ValueError("no parameters were eligible for quantization")
     return model
 
 

@@ -136,6 +136,24 @@ def _forced_remat() -> bool | None:
     return None
 
 
+def _time_with_remat_policy(build_and_time, jax):
+    """Run a (time, aux) builder under the remat policy: the forced setting
+    if given, else prefer remat=False. Either way, an OOM at remat=False
+    falls back to remat=True — the parent re-matches the other mode when
+    the reported BENCH_REMAT flags disagree."""
+    forced = _forced_remat()
+    first = forced if forced is not None else False
+    try:
+        t, aux = build_and_time(remat=first)
+        return t, aux, first
+    except Exception as e:  # noqa: BLE001 — OOM → rematerialised fallback
+        if first is True or not _is_oom(e):
+            raise
+        jax.clear_caches()
+        t, aux = build_and_time(remat=True)
+        return t, aux, True
+
+
 def _mode_framework(platform: str) -> None:
     import jax
     import jax.numpy as jnp
@@ -168,19 +186,8 @@ def _mode_framework(platform: str) -> None:
 
         return _timed_steps(step, n_warmup=2, n_steps=10) / 10, n_params
 
-    if _forced_remat() is not None:
-        t, n_params = _build_and_time(remat=_forced_remat())
-        print(f"BENCH_REMAT {int(_forced_remat())}")
-    else:
-        try:
-            t, n_params = _build_and_time(remat=False)
-            print("BENCH_REMAT 0")
-        except Exception as e:  # noqa: BLE001 — OOM → rematerialised fallback
-            if not _is_oom(e):
-                raise
-            jax.clear_caches()
-            t, n_params = _build_and_time(remat=True)
-            print("BENCH_REMAT 1")
+    t, n_params, used_remat = _time_with_remat_policy(_build_and_time, jax)
+    print(f"BENCH_REMAT {int(used_remat)}")
     print(f"BENCH_PARAMS {n_params}")
     print(f"BENCH_RESULT {t:.6f}")
 
@@ -226,19 +233,10 @@ def _mode_raw(platform: str) -> None:
 
         return _timed_steps(step, n_warmup=2, n_steps=10) / 10
 
-    if _forced_remat() is not None:
-        t = _build_and_time(remat=_forced_remat())
-        print(f"BENCH_REMAT {int(_forced_remat())}")
-    else:
-        try:
-            t = _build_and_time(remat=False)
-            print("BENCH_REMAT 0")
-        except Exception as e:  # noqa: BLE001 — OOM → rematerialised fallback
-            if not _is_oom(e):
-                raise
-            jax.clear_caches()
-            t = _build_and_time(remat=True)
-            print("BENCH_REMAT 1")
+    t, _, used_remat = _time_with_remat_policy(
+        lambda remat: (_build_and_time(remat), None), jax
+    )
+    print(f"BENCH_REMAT {int(used_remat)}")
     print(f"BENCH_RESULT {t:.6f}")
 
 

@@ -1,0 +1,131 @@
+"""Big-model-inference benchmark: load time + s/token for a disk-offloaded
+model, the measurement behind the reference's published table
+(``/root/reference/benchmarks/big_model_inference/README.md:27-37``; the
+OPT-30B fp32 + disk row is 112.3 s load / 33.9 s/token on 2× Titan RTX).
+
+The chip here can't hold OPT-30B, so the comparison is made on the
+*bandwidth-normalised* metric the disk-offload regime is governed by:
+
+    effective_stream_bandwidth = model_bytes_streamed_per_token / s_per_token
+
+The reference row moves ~120 GB (fp32 30B) per generated token at
+33.9 s/token → **3.54 GB/s** effective. Any configuration whose pipeline
+sustains a higher effective bandwidth beats that row shape-for-shape; int8
+quantized loading additionally divides the bytes per token by 4.
+
+Run: ``python benchmarks/big_model_inference/bench_offload.py [--layers N]``
+Prints one JSON line per configuration (fp32 disk, int8 disk).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _drop_page_cache() -> bool:
+    """Cold-cache the disk tier so s/token includes the real read (the
+    reference's 120 GB model couldn't fit its 32 GB page cache either)."""
+    try:
+        subprocess.run(["sync"], check=True)
+        with open("/proc/sys/vm/drop_caches", "w") as f:
+            f.write("3\n")
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def run_config(tag: str, quantize: bool, layers: int, hidden: int, tokens: int) -> dict:
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import dispatch_model
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils.quantization import BnbQuantizationConfig, quantize_model_params
+
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=hidden, intermediate_size=hidden * 4,
+        num_hidden_layers=layers, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=256, remat=False,
+    )
+    t0 = time.perf_counter()
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    if quantize:
+        model = quantize_model_params(
+            model, BnbQuantizationConfig(quantize_embeddings=True)
+        )
+    offload_dir = tempfile.mkdtemp(prefix=f"bench_offload_{tag}_")
+    try:
+        dispatched = dispatch_model(model, {"": "disk"}, offload_dir=offload_dir)
+        load_s = time.perf_counter() - t0
+
+        bytes_on_disk = sum(
+            os.path.getsize(os.path.join(offload_dir, f))
+            for f in os.listdir(offload_dir)
+            if f.endswith(".dat")
+        )
+
+        ids = np.random.default_rng(0).integers(0, 32000, size=(1, 32)).astype(np.int32)
+        # warmup: one token (compiles every segment fn)
+        generate(dispatched, ids, max_new_tokens=1)
+        # each measured token starts cold-cache so its disk read is real
+        # (same input each time → identical shapes, compile stays cached)
+        per_token = []
+        cold = True
+        for _ in range(tokens):
+            cold = _drop_page_cache() and cold
+            t0 = time.perf_counter()
+            generate(dispatched, ids, max_new_tokens=1)
+            per_token.append(time.perf_counter() - t0)
+        s_per_token = sum(per_token) / len(per_token)
+
+        bw = bytes_on_disk / s_per_token
+        return {
+            "config": tag,
+            "load_s": round(load_s, 2),
+            "model_bytes": bytes_on_disk,
+            "cold_cache": cold,
+            "s_per_token": round(s_per_token, 4),
+            "effective_stream_gb_per_s": round(bw / 1e9, 3),
+            "reference_opt30b_row_gb_per_s": 3.54,
+            "beats_reference_row": bw / 1e9 > 3.54,
+        }
+    finally:
+        shutil.rmtree(offload_dir, ignore_errors=True)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--layers", type=int, default=12)
+    parser.add_argument("--hidden", type=int, default=1024)
+    parser.add_argument("--tokens", type=int, default=4)
+    parser.add_argument(
+        "--platform", default="cpu", choices=("cpu", "tpu"),
+        help="cpu (default) measures the streaming pipeline against local "
+        "disk+RAM; tpu uses the attached chip — NOTE: in dev environments "
+        "where the chip sits behind a network tunnel, H2D bandwidth "
+        "measures the tunnel, not the pipeline",
+    )
+    args = parser.parse_args()
+    if args.platform == "cpu":
+        # the config update wins over site plugins that ignore JAX_PLATFORMS
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    for tag, quantize in (("fp32_disk", False), ("int8_disk", True)):
+        result = run_config(tag, quantize, args.layers, args.hidden, args.tokens)
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

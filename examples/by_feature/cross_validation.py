@@ -1,0 +1,92 @@
+"""Feature: k-fold cross validation (reference
+``examples/by_feature/cross_validation.py``) — train one model per fold,
+evaluate each on its held-out slice, report the fold-averaged accuracy."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import (
+    PairMetric,
+    ParaphraseDataset,
+    SimpleLoader,
+    WordTokenizer,
+    build_model,
+    read_split,
+)
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    n_folds = int(args.num_folds)
+
+    set_seed(seed)
+    rows = read_split("train")
+    tokenizer = WordTokenizer(rows)
+    fold_size = len(rows) // n_folds
+    accuracies = []
+
+    for fold in range(n_folds):
+        accelerator.free_memory()
+        lo, hi = fold * fold_size, (fold + 1) * fold_size
+        train_rows = rows[:lo] + rows[hi:]
+        eval_rows = rows[lo:hi]
+        train_dl = SimpleLoader(
+            ParaphraseDataset(train_rows, tokenizer), batch_size, shuffle=True, drop_last=True
+        )
+        eval_dl = SimpleLoader(ParaphraseDataset(eval_rows, tokenizer), 32)
+        model = build_model(tokenizer, seed=seed + fold)
+        optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optimizer, train_dl, eval_dl
+        )
+
+        for epoch in range(num_epochs):
+            model.train()
+            train_dl.set_epoch(epoch)
+            for batch in train_dl:
+                output = model(**batch)
+                accelerator.backward(output.loss)
+                optimizer.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        metric = PairMetric()
+        for batch in eval_dl:
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+        acc = metric.compute()["accuracy"]
+        accuracies.append(acc)
+        accelerator.print(f"fold {fold}: accuracy {acc:.4f}")
+
+    accelerator.print(f"cross-validated accuracy: {np.mean(accuracies):.4f} over {n_folds} folds")
+    accelerator.end_training()
+    return float(np.mean(accuracies))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="K-fold cross-validation example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

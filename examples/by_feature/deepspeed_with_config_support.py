@@ -1,0 +1,82 @@
+"""Feature: DeepSpeed-style config file (reference
+``examples/by_feature/deepspeed_with_config_support.py``) — a ZeRO JSON
+config (with ``"auto"`` values) drives the sharding plugin; ``auto``
+entries are resolved at ``prepare()`` from the live objects."""
+
+import argparse
+import json
+import sys, os
+import tempfile
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator, DeepSpeedPlugin
+from accelerate_tpu.utils.random import set_seed
+
+DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": "auto",
+    "train_batch_size": "auto",
+    "gradient_accumulation_steps": 2,
+    "gradient_clipping": 1.0,
+    "zero_optimization": {"stage": 2},
+    "optimizer": {"type": "AdamW", "params": {"lr": "auto"}},
+}
+
+
+def training_function(config, args):
+    if args.ds_config:
+        ds_path = args.ds_config
+    else:
+        f = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
+        json.dump(DS_CONFIG, f)
+        f.close()
+        ds_path = f.name
+    plugin = DeepSpeedPlugin(hf_ds_config=ds_path)
+    accelerator = Accelerator(cpu=args.cpu, deepspeed_plugin=plugin)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+
+    set_seed(seed)
+    train_dataloader, _, tokenizer = get_dataloaders(accelerator, batch_size)
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader
+    )
+    # "auto" entries are now concrete
+    accelerator.print("resolved ds config:", json.dumps(plugin.deepspeed_config))
+    assert plugin.deepspeed_config["train_micro_batch_size_per_gpu"] != "auto"
+
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            # the config's accumulation steps govern the accumulate context
+            with accelerator.accumulate(model):
+                output = model(**batch)
+                accelerator.backward(output.loss)
+                accelerator.clip_grad_norm_(model, plugin.gradient_clipping)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch}: loss {output.loss.item():.4f}")
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="DeepSpeed-config example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--ds_config", type=str, default=None,
+                        help="path to a DeepSpeed JSON config")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Distributed inference via ``split_between_processes`` (reference
+``examples/inference/distributed/*``): each process takes its slice of the
+prompt list, runs the model locally, and rank 0 gathers the results."""
+
+import argparse
+import sys, os
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_prompts", type=int, default=10)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    config = LlamaConfig.tiny(vocab_size=512, hidden_size=64, layers=2, heads=4, seq=32)
+    model = accelerator.prepare_model(LlamaForCausalLM.from_config(config, seed=0))
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, size=(32,)).astype(np.int32) for _ in range(args.num_prompts)]
+
+    # each process handles its contiguous slice (padded so every process
+    # gets work; reference `split_between_processes(..., apply_padding=True)`)
+    with accelerator.split_between_processes(prompts, apply_padding=True) as shard:
+        local = []
+        for prompt in shard:
+            out = model(input_ids=prompt[None, :])
+            local.append(int(np.asarray(out.logits.force())[0, -1].argmax()))
+
+    results = accelerator.gather_for_metrics(local, use_gather_object=True)
+    accelerator.print(f"next-token predictions for {args.num_prompts} prompts: "
+                      f"{results[: args.num_prompts]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,7 +159,9 @@ def test_megatron_plugin_lowers_to_mesh_axes():
     acc = Accelerator(megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, sequence_parallelism=True))
     shape = dict(acc.mesh.shape)
     assert shape["tp"] == 2
-    assert shape["cp"] == 2  # Megatron-SP: sequence sharded over the tp group size
+    # SP does NOT multiply the device requirement (Megatron shards over the
+    # existing tp group; here the cp axis is sized explicitly by the user)
+    assert shape["cp"] == 1
 
 
 def test_megatron_pp_raises():

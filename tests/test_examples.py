@@ -163,3 +163,66 @@ def test_nlp_example_under_launcher():
     )
     assert out.returncode == 0, f"launch failed:\n{out.stdout}\n{out.stderr}"
     assert "epoch 0" in out.stdout
+
+
+def test_cv_example_reaches_quality_bar():
+    stdout = _run("cv_example.py", "--num_epochs", "8")
+    last = [l for l in stdout.splitlines() if l.startswith("epoch")][-1]
+    acc = float(last.split("accuracy ")[1])
+    assert acc >= 0.8, f"cv accuracy bar missed: {last}"
+
+
+def test_deepspeed_config_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "deepspeed_with_config_support.py"), "--num_epochs", "1"
+    )
+    assert "resolved ds config" in stdout and '"auto"' not in stdout.split("resolved ds config:")[1].splitlines()[0]
+
+
+def test_cross_validation_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "cross_validation.py"), "--num_folds", "2",
+        "--num_epochs", "1",
+    )
+    assert "cross-validated accuracy" in stdout
+
+
+def test_pippy_inference_examples():
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "pippy", "llama.py"),
+        "--layers", "4", "--hidden", "64", "--batch", "4", "--seq", "16",
+    )
+    assert "stages split at" in stdout and "logits" in stdout
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "pippy", "gpt2.py"),
+        "--layers", "4", "--batch", "4", "--seq", "16",
+    )
+    assert "stages split at" in stdout
+
+
+def test_split_inference_example():
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "distributed", "split_inference.py"),
+        "--num_prompts", "4",
+    )
+    assert "next-token predictions" in stdout
+
+
+def test_config_yaml_templates_load():
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    tpl_dir = os.path.join(EXAMPLES, "config_yaml_templates")
+    for name in os.listdir(tpl_dir):
+        cfg = ClusterConfig.load(os.path.join(tpl_dir, name))
+        env = cfg.to_environment()
+        assert "ACCELERATE_MIXED_PRECISION" in env, name
+
+
+def test_deepspeed_templates_ingest():
+    from accelerate_tpu import DeepSpeedPlugin
+
+    tpl_dir = os.path.join(EXAMPLES, "deepspeed_config_templates")
+    p2 = DeepSpeedPlugin(hf_ds_config=os.path.join(tpl_dir, "zero_stage2_config.json"))
+    assert p2.zero_stage == 2
+    p3 = DeepSpeedPlugin(hf_ds_config=os.path.join(tpl_dir, "zero_stage3_offload_config.json"))
+    assert p3.zero_stage == 3 and p3.offload_param_device == "cpu"

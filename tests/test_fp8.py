@@ -99,3 +99,24 @@ def test_fp8_quantization_is_actually_applied():
     with fp8_autocast():
         out = np.asarray(dense(x, w))
     assert not np.allclose(out, exact, rtol=1e-6), "fp8 path identical to fp32 — inactive"
+
+
+def test_autocast_island_suspends_fp8():
+    """AutocastKwargs(enabled=False) must suspend the fp8 recipe too —
+    deferred calls inside the island run exact matmuls."""
+    from accelerate_tpu.utils.dataclasses import AutocastKwargs
+    from accelerate_tpu.test_utils import RegressionModel
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(mixed_precision="fp8")
+    model = accelerator.prepare_model(RegressionModel(a=1.0, b=0.0))
+    assert model.fp8_recipe is not None
+    x = np.asarray([1.0 / 3.0], np.float32)
+    with accelerator.autocast(autocast_handler=AutocastKwargs(enabled=False)):
+        island = model(x=x)
+        assert model.fp8_recipe is None
+    assert model.fp8_recipe is not None
+    inside = float(np.asarray(island.prediction.force()))
+    assert inside == np.float32(1.0 / 3.0)

@@ -1,0 +1,93 @@
+"""Generation loop (the reference's s/token benchmark path goes through
+transformers.generate on hooked models; here the framework owns the loop —
+``accelerate_tpu/generation.py``)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import cpu_offload
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+
+def _model(cls=LlamaForCausalLM, cfg=None):
+    cfg = cfg or LlamaConfig.tiny(layers=2, seq=64)
+    return cls.from_config(cfg, seed=0), cfg
+
+
+def test_greedy_matches_stepwise_argmax():
+    model, cfg = _model()
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 8)).astype(np.int32)
+    out = generate(_as_callable(model), ids, max_new_tokens=4)
+    assert out.shape == (2, 12)
+    # re-derive token 1 by hand: argmax at the prompt boundary
+    full = model.apply_fn(model.params, input_ids=out[:, :12],
+                          attention_mask=np.asarray(out[:, :12] >= 0, np.int32))
+    # positions 8..10 predicted tokens must equal the argmax of the logits
+    # one position earlier (greedy consistency)
+    logits = np.asarray(full["logits"])
+    for t in range(8, 11):
+        np.testing.assert_array_equal(out[:, t], logits[:, t - 1, :].argmax(-1))
+
+
+class _as_callable:
+    """Minimal callable over a raw Model (generation accepts any callable)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def __call__(self, **kw):
+        return self.model.apply_fn(self.model.params, **kw)
+
+
+def test_generate_through_streaming_offload():
+    model, cfg = _model()
+    ref = generate(_as_callable(model), np.zeros((1, 4), np.int32), max_new_tokens=3)
+    dispatched = cpu_offload(model)
+    out = generate(dispatched, np.zeros((1, 4), np.int32), max_new_tokens=3)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_generate_gpt2_and_eos():
+    model, cfg = _model(GPT2LMHeadModel, GPT2Config.tiny(layers=2, seq=64))
+    wrapped = _as_callable(model)
+    ids = np.random.default_rng(1).integers(0, 256, size=(1, 4)).astype(np.int32)
+    out = generate(wrapped, ids, max_new_tokens=6)
+    assert out.shape == (1, 10)
+    # eos halts: pick the actually-generated first token as "eos"
+    eos = int(out[0, 4])
+    halted = generate(wrapped, ids, max_new_tokens=6, eos_token_id=eos)
+    assert halted.shape[1] <= 10
+    assert int(halted[0, 4]) == eos
+
+
+def test_sampling_respects_temperature_determinism():
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    ids = np.zeros((1, 4), np.int32)
+    a = generate(wrapped, ids, max_new_tokens=4, do_sample=True, seed=7)
+    b = generate(wrapped, ids, max_new_tokens=4, do_sample=True, seed=7)
+    np.testing.assert_array_equal(a, b)  # same seed → same tokens
+
+
+def test_ragged_prompts_decode_from_their_own_positions():
+    """Right-padded shorter prompts must continue from THEIR last real
+    token — batched output equals each row generated alone."""
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    rng = np.random.default_rng(3)
+    long_p = rng.integers(0, 256, size=(6,)).astype(np.int32)
+    short_p = rng.integers(0, 256, size=(3,)).astype(np.int32)
+
+    batch_ids = np.zeros((2, 6), np.int32)
+    batch_ids[0] = long_p
+    batch_ids[1, :3] = short_p
+    mask = np.asarray([[1] * 6, [1, 1, 1, 0, 0, 0]], np.int32)
+    out = generate(wrapped, batch_ids, max_new_tokens=3, attention_mask=mask)
+
+    solo_long = generate(wrapped, long_p[None], max_new_tokens=3)
+    solo_short = generate(wrapped, short_p[None], max_new_tokens=3)
+    np.testing.assert_array_equal(out[0, :9], solo_long[0])
+    np.testing.assert_array_equal(out[1, 3:6], solo_short[0, 3:6])

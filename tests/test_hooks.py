@@ -145,3 +145,11 @@ def test_autocast_island_binds_at_call_time():
     outside = float(np.asarray(model(x=x).prediction.force()))
     assert inside == np.float32(1.0 / 3.0), "island call was downcast"
     assert outside != inside, "bf16 policy did not apply outside the island"
+
+
+def test_hook_on_raw_model_raises():
+    from accelerate_tpu.modules import Model
+
+    bare = Model(lambda p, x: x, {"w": np.zeros(2)})
+    with pytest.raises(TypeError, match="not callable"):
+        add_hook_to_module(bare, ModelHook())

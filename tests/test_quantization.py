@@ -70,7 +70,9 @@ def test_device_map_sizing_halves_with_int8():
     fp32_bytes = sum(
         int(np.prod(s)) * 4 for s, _ in fp32_shapes.values()
     )
-    model = quantize_model_params(model, BnbQuantizationConfig())
+    model = quantize_model_params(
+        model, BnbQuantizationConfig(quantize_embeddings=True)
+    )
     q_shapes = flat_param_shapes(model)
     q_bytes = 0
     for shape, dtype in q_shapes.values():
@@ -115,3 +117,23 @@ def test_load_and_quantize_model_auto_map(tmp_path):
     out = np.asarray(quantized(input_ids=ids).logits)
     denom = max(np.abs(ref).max(), 1.0)
     assert np.max(np.abs(out - ref)) / denom < 0.05
+
+
+def test_embeddings_skipped_by_default():
+    from accelerate_tpu.utils.quantization import DEFAULT_SKIP_MODULES
+
+    config, model, _ = _tiny_llama()
+    model = quantize_model_params(model, BnbQuantizationConfig())
+    assert not isinstance(model.params["embed_tokens"], QTensor)
+    assert not isinstance(model.params["lm_head"], QTensor)
+    assert isinstance(model.params["layers"]["wq"], QTensor)
+    assert "wte" in DEFAULT_SKIP_MODULES  # gpt2 names covered too
+
+
+def test_quantize_failure_leaves_model_intact():
+    config, model, _ = _tiny_llama()
+    orig_apply = model.apply_fn
+    with pytest.raises(ValueError, match="eligible"):
+        quantize_model_params(model, BnbQuantizationConfig(skip_modules=["layers"]))
+    assert model.apply_fn is orig_apply
+    assert not getattr(model, "is_quantized", False)
