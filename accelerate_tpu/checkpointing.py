@@ -165,9 +165,40 @@ def _restore_rng_state(states: dict[str, Any]):
 # ---------------------------------------------------------------------------
 
 
-def save_accelerator_state(accelerator, output_dir: str | None = None, safe_serialization: bool = True):
+#: in-flight async checkpoint write (single-worker: saves are ordered)
+_ASYNC_SAVE: dict[str, Any] = {"executor": None, "future": None}
+
+
+def wait_for_checkpoint():
+    """Block until a pending ``async_save`` finished writing (orbax-style
+    contract: training continues while files land; the next save/load —
+    or an explicit call — joins the writer). Multi-process note: this
+    joins the LOCAL writer; ``load_accelerator_state`` additionally
+    barriers so no process reads files another process is still writing."""
+    future = _ASYNC_SAVE["future"]
+    if future is not None:
+        try:
+            future.result()
+        finally:
+            # a failed write must not poison every later save/load — the
+            # exception surfaces once, then the slot clears
+            _ASYNC_SAVE["future"] = None
+
+
+def save_accelerator_state(
+    accelerator,
+    output_dir: str | None = None,
+    safe_serialization: bool = True,
+    async_save: bool = False,
+):
     """(Reference ``save_accelerator_state`` ``checkpointing.py:53`` +
-    rotation ``accelerator.py:3004-3028``.)"""
+    rotation ``accelerator.py:3004-3028``.)
+
+    ``async_save=True`` → the device→host gather (a collective, main-thread
+    only) runs now, the file writes land on a background worker, and the
+    call returns immediately; see :func:`wait_for_checkpoint`.
+    """
+    wait_for_checkpoint()  # saves are ordered; never interleave two writers
     if output_dir is None:
         if accelerator.project_dir is None:
             raise ValueError("pass output_dir or set project_dir on the Accelerator")
@@ -187,43 +218,59 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
     model_flats = [_flatten_tree(m.params) for m in accelerator._models]
     opt_flats = [_flatten_tree(o.opt_state) for o in accelerator._optimizers]
 
-    # …then only the main process touches the filesystem.
-    if accelerator.is_main_process:
-        for i, flat in enumerate(model_flats):
-            suffix = "" if i == 0 else f"_{i}"
-            save_array_dict(flat, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), safe_serialization)
-        for i, flat in enumerate(opt_flats):
-            suffix = "" if i == 0 else f"_{i}"
-            save_array_dict(flat, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), safe_serialization)
-        for i, sched in enumerate(accelerator._schedulers):
-            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
-                pickle.dump(sched.state_dict(), f)
-        for i, dl in enumerate(accelerator._dataloaders):
-            # deep sampler/loader state: epoch + mid-epoch position, so
-            # load_state resumes without a manual skip_first_batches
-            # (reference saves sampler/dataloader state_dicts,
-            # ``checkpointing.py:116-143``)
-            state = dl.state_dict()
-            with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
-                pickle.dump(state, f)
-        for i, obj in enumerate(accelerator._custom_objects):
-            with open(os.path.join(output_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "wb") as f:
-                pickle.dump(obj.state_dict(), f)
-        with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
-            json.dump({"step": accelerator.step, "iteration": accelerator.save_iteration}, f)
-    else:
-        del model_flats, opt_flats
+    # Snapshot every host-side state NOW (the background writer must see
+    # this step's values, not whatever the training loop mutates next)…
+    sched_states = [s.state_dict() for s in accelerator._schedulers]
+    # deep sampler/loader state: epoch + mid-epoch position, so load_state
+    # resumes without a manual skip_first_batches (reference saves
+    # sampler/dataloader state_dicts, ``checkpointing.py:116-143``)
+    dl_states = [dl.state_dict() for dl in accelerator._dataloaders]
+    custom_states = [obj.state_dict() for obj in accelerator._custom_objects]
+    meta = {"step": accelerator.step, "iteration": accelerator.save_iteration}
+    rng_state = _collect_rng_state()
+    is_main = accelerator.is_main_process
+    process_index = accelerator.process_index
+    if not is_main:  # only the main process touches the array files
+        model_flats, opt_flats = [], []
 
-    # per-process RNG bundle (every process writes its own, like the
-    # reference's random_states_{i}.pkl)
-    with open(
-        os.path.join(output_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"), "wb"
-    ) as f:
-        pickle.dump(_collect_rng_state(), f)
+    def _write_files():
+        if is_main:
+            for i, flat in enumerate(model_flats):
+                suffix = "" if i == 0 else f"_{i}"
+                save_array_dict(flat, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), safe_serialization)
+            for i, flat in enumerate(opt_flats):
+                suffix = "" if i == 0 else f"_{i}"
+                save_array_dict(flat, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), safe_serialization)
+            for i, state in enumerate(sched_states):
+                with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
+                    pickle.dump(state, f)
+            for i, state in enumerate(dl_states):
+                with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
+                    pickle.dump(state, f)
+            for i, state in enumerate(custom_states):
+                with open(os.path.join(output_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "wb") as f:
+                    pickle.dump(state, f)
+            with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
+                json.dump(meta, f)
+        # per-process RNG bundle (every process writes its own, like the
+        # reference's random_states_{i}.pkl)
+        with open(os.path.join(output_dir, f"{RNG_STATE_NAME}_{process_index}.pkl"), "wb") as f:
+            pickle.dump(rng_state, f)
+        logger.info(f"Saved state to {output_dir}")
 
     accelerator.project_configuration.iteration += 1
+    if async_save:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if _ASYNC_SAVE["executor"] is None:
+            _ASYNC_SAVE["executor"] = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="checkpoint-writer"
+            )
+        _ASYNC_SAVE["future"] = _ASYNC_SAVE["executor"].submit(_write_files)
+        return output_dir
+
+    _write_files()
     accelerator.wait_for_everyone()
-    logger.info(f"Saved state to {output_dir}")
     return output_dir
 
 
@@ -240,6 +287,10 @@ def _sorted_checkpoints(checkpoints_dir: str) -> list[str]:
 
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """(Reference ``load_accelerator_state`` ``checkpointing.py:165``.)"""
+    wait_for_checkpoint()  # an in-flight async save must land first…
+    # …on EVERY process before ANY process reads (each joins its own
+    # writer above, then all meet here)
+    accelerator.wait_for_everyone()
     if input_dir is None:
         if accelerator.project_dir is None:
             raise ValueError("pass input_dir or set project_dir on the Accelerator")

@@ -31,6 +31,60 @@ def _logits_of(out):
     return logits
 
 
+def _cache_backend(model):
+    """(apply_fn, params) when the model supports the KV-cache decode path.
+
+    Only plain :class:`Model`s and :class:`PreparedModel`s qualify — a
+    DispatchedModel's ``params`` property would MATERIALISE the whole
+    offloaded model, defeating the tiering (those models use the streaming
+    full-forward path, where weight movement dominates anyway). A prepared
+    model's compute-dtype policy is applied around the raw apply."""
+    from .modules import Model, PreparedModel, _cast_floats
+
+    if isinstance(model, PreparedModel):
+        inner = model._model
+        if not getattr(inner, "supports_kv_cache", False):
+            return None
+        dtype = model.compute_dtype
+
+        def apply(p, **kw):
+            if dtype is not None:
+                p = _cast_floats(p, dtype)
+            return inner.apply_fn(p, **kw)
+
+        return apply, model.params
+    if isinstance(model, Model) and getattr(model, "supports_kv_cache", False):
+        return model.apply_fn, model.params
+    return None
+
+
+def _jitted_for(apply_fn, total: int):
+    """Per-apply-fn compile cache: generate() may be called many times in a
+    serving loop; the prefill/decode programs must compile once."""
+    cache = getattr(apply_fn, "_generation_jit_cache", None)
+    if cache is None:
+        cache = {}
+        try:
+            apply_fn._generation_jit_cache = cache
+        except AttributeError:  # non-function callable; fall back per call
+            pass
+    entry = cache.get(total)
+    if entry is None:
+        prefill = jax.jit(
+            lambda p, i, m: apply_fn(
+                p, input_ids=i, attention_mask=m, use_cache=True, max_cache_len=total
+            )
+        )
+        decode = jax.jit(
+            lambda p, tok, kv, idx: apply_fn(
+                p, input_ids=tok, kv_cache=kv, cache_index=idx
+            )
+        )
+        entry = (prefill, decode)
+        cache[total] = entry
+    return entry
+
+
 def generate(
     model,
     input_ids,
@@ -40,10 +94,24 @@ def generate(
     eos_token_id: int | None = None,
     seed: int = 0,
     attention_mask=None,
+    use_cache: bool = False,
 ):
     """Greedy / temperature-sampled decoding. Returns ``[b, prompt+new]``
     int32 token ids (right-padded with ``eos`` after a sequence finishes).
+
+    ``use_cache=True`` runs prefill-then-decode with a per-layer KV cache
+    (O(cache) per token instead of O(n²) re-forwards) when the model
+    declares ``supports_kv_cache``; other models silently use the
+    full-forward path, which is equally correct — and for offload-streamed
+    models equally fast, since weight movement dominates there anyway.
     """
+    if use_cache:
+        backend = _cache_backend(model)
+        if backend is not None:
+            return _generate_cached(
+                backend, input_ids, max_new_tokens, do_sample, temperature,
+                eos_token_id, seed, attention_mask,
+            )
     ids = np.asarray(input_ids)
     if ids.ndim == 1:
         ids = ids[None, :]
@@ -78,6 +146,60 @@ def generate(
             finished |= next_tok == eos_token_id
         buf[rows, lengths] = next_tok
         mask[rows, lengths] = 1
+        lengths += 1
+        if eos_token_id is not None and finished.all():
+            break
+    return buf[:, : int(lengths.max())]
+
+
+def _generate_cached(
+    backend, input_ids, max_new_tokens, do_sample, temperature,
+    eos_token_id, seed, attention_mask,
+):
+    """Prefill + per-token cached decode (see ``llama_apply``'s decode
+    mode). Each decode step appends K/V at every row's own position, so
+    ragged right-padded prompts behave exactly like the full-forward path."""
+    apply_fn, params = backend
+    ids = np.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, prompt_len = ids.shape
+    total = prompt_len + max_new_tokens
+    mask = (
+        np.asarray(attention_mask, np.int32)
+        if attention_mask is not None
+        else np.ones((b, prompt_len), np.int32)
+    )
+    lengths = mask.sum(axis=1).astype(np.int64)
+    buf = np.zeros((b, total), np.int32)
+    buf[:, :prompt_len] = ids
+
+    prefill, decode = _jitted_for(apply_fn, total)
+    out = prefill(params, jnp.asarray(ids), jnp.asarray(mask))
+    cache = out["kv_cache"]
+    all_logits = np.asarray(jax.device_get(out["logits"]))
+    rows = np.arange(b)
+    logits = all_logits[rows, lengths - 1, :]
+
+    key = jax.random.PRNGKey(seed)
+    finished = np.zeros((b,), bool)
+    for _ in range(max_new_tokens):
+        if do_sample:
+            key, sub = jax.random.split(key)
+            scaled = jnp.asarray(logits) / max(temperature, 1e-6)
+            next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
+        else:
+            next_tok = logits.argmax(axis=-1)
+        if eos_token_id is not None:
+            next_tok = np.where(finished, eos_token_id, next_tok)
+            finished |= next_tok == eos_token_id
+        buf[rows, lengths] = next_tok
+        out = decode(
+            params, jnp.asarray(next_tok[:, None].astype(np.int32)),
+            cache, jnp.asarray(lengths, jnp.int32),
+        )
+        cache = out["kv_cache"]
+        logits = np.asarray(jax.device_get(out["logits"]))[:, 0, :]
         lengths += 1
         if eos_token_id is not None and finished.all():
             break

@@ -135,6 +135,12 @@ def gpt2_apply(
 ):
     c = config
     b, s = input_ids.shape
+    if s > c.max_position_embeddings:
+        raise ValueError(
+            f"sequence length {s} exceeds max_position_embeddings "
+            f"{c.max_position_embeddings}: the position-embedding lookup "
+            "would silently clamp, producing wrong logits"
+        )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 

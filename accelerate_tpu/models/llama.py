@@ -128,9 +128,14 @@ def init_llama_params(key: jax.Array, config: LlamaConfig, dtype=jnp.float32):
     return params
 
 
-def llama_layer_apply(config: LlamaConfig, layer, x, cos, sin, positions, attention_mask):
+def llama_layer_apply(
+    config: LlamaConfig, layer, x, cos, sin, positions, attention_mask,
+    return_kv: bool = False,
+):
     """One transformer block on UNstacked layer params — shared by the
-    training scan body and the streaming (offload) executor."""
+    training scan body and the streaming (offload) executor.
+    ``return_kv`` additionally returns the (rotated K, V) this block just
+    computed, so the prefill cache reuses them instead of re-projecting."""
     c = config
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     b, s, h = x.shape
@@ -150,7 +155,10 @@ def llama_layer_apply(config: LlamaConfig, layer, x, cos, sin, positions, attent
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
     gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
     x = x + dense(gated, layer["w_down"])
-    return _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    if return_kv:
+        return x, (k, v)
+    return x
 
 
 def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
@@ -180,18 +188,61 @@ def llama_apply(
     attention_mask: jax.Array | None = None,  # [b, s] 1=real
     labels: jax.Array | None = None,  # [b, s]; -100 ignored
     positions: jax.Array | None = None,
+    use_cache: bool = False,
+    kv_cache=None,  # {"k","v"}: [L, b, max_cache, n_kv, hd] (decode step)
+    cache_index: jax.Array | None = None,  # [b] per-row write position
+    max_cache_len: int | None = None,
 ):
+    """Forward pass; three modes:
+
+    * training/eval (default) — full causal attention;
+    * **prefill** (``use_cache=True``) — same, plus the per-layer K/V
+      written into a ``[L, b, max_cache_len, n_kv, hd]`` cache returned as
+      ``out.kv_cache``;
+    * **decode** (``kv_cache=`` + ``cache_index=``) — ``input_ids`` is one
+      token per row; K/V append at each row's own position (ragged-batch
+      safe) and attention runs token-vs-cache in O(max_cache) — the KV-cache
+      inference path (the reference gets this from transformers' generate).
+    """
     c = config
     b, s = input_ids.shape
+    if s > c.max_position_embeddings:
+        raise ValueError(
+            f"sequence length {s} exceeds max_position_embeddings "
+            f"{c.max_position_embeddings}: RoPE position tables would "
+            "silently clamp, producing wrong logits"
+        )
+    cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
+
+    if kv_cache is not None:
+        return _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
+
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
 
     x = params["embed_tokens"][input_ids]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
-    body = _block(c, cos, sin, positions, attention_mask)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if use_cache:
+        max_cache = int(max_cache_len or c.max_position_embeddings)
+        if max_cache > c.max_position_embeddings:
+            raise ValueError(
+                f"max_cache_len {max_cache} exceeds max_position_embeddings "
+                f"{c.max_position_embeddings}: RoPE tables would silently "
+                "clamp — raise max_position_embeddings on the config"
+            )
+
+        def body(x, layer):
+            pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+            out, (k, v) = llama_layer_apply(
+                c, layer, x, cos, sin, positions, attention_mask, return_kv=True
+            )
+            return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        x, (k_cache, v_cache) = jax.lax.scan(body, x, params["layers"])
+    else:
+        body = _block(c, cos, sin, positions, attention_mask)
+        x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -201,12 +252,62 @@ def llama_apply(
     logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
 
     out = ModelOutput(logits=logits)
+    if use_cache:
+        out["kv_cache"] = {"k": k_cache, "v": v_cache}
     if labels is not None:
         # causal shift: predict token t+1 from prefix ≤ t
         shifted_logits = logits[:, :-1, :]
         shifted_labels = labels[:, 1:]
         out["loss"] = cross_entropy_loss(shifted_logits, shifted_labels)
     return out
+
+
+def _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
+    """One cached decode step: s == 1 token per row, appended at
+    ``cache_index[b]``; attention is q(1) against the cache prefix."""
+    b, s = input_ids.shape
+    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    max_cache = kv_cache["k"].shape[2]
+    rows = jnp.arange(b)
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
+    positions = idx[:, None]  # [b, 1]
+
+    x = params["embed_tokens"][input_ids]
+
+    def body(x, xs):
+        layer, k_cache_l, v_cache_l = xs
+        y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
+        q = apply_rope(dense(y, layer["wq"]).reshape(b, s, nh, hd), cos, sin, positions)
+        k = apply_rope(dense(y, layer["wk"]).reshape(b, s, nkv, hd), cos, sin, positions)
+        v = dense(y, layer["wv"]).reshape(b, s, nkv, hd)
+        k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
+        v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
+        # GQA repeat + mask to each row's valid prefix (≤ its own position)
+        rep = nh // nkv
+        kk = jnp.repeat(k_cache_l, rep, axis=2) if rep > 1 else k_cache_l
+        vv = jnp.repeat(v_cache_l, rep, axis=2) if rep > 1 else v_cache_l
+        valid = (jnp.arange(max_cache)[None, :] <= idx[:, None])  # [b, max]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32)
+        ) / jnp.sqrt(float(hd))
+        scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32)).astype(x.dtype)
+        x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
+        y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
+        x = x + dense(gated, layer["w_down"])
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    logits = dense(x, head)
+    return ModelOutput(logits=logits, kv_cache={"k": k_cache, "v": v_cache})
 
 
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")
@@ -328,7 +429,7 @@ class LlamaForCausalLM:
             params = make_params(jax.random.PRNGKey(seed))
 
         def apply_fn(p, input_ids=None, attention_mask=None, labels=None, positions=None, **kw):
-            return llama_apply(config, p, input_ids, attention_mask, labels, positions)
+            return llama_apply(config, p, input_ids, attention_mask, labels, positions, **kw)
 
         model = Model(
             apply_fn,
@@ -339,6 +440,7 @@ class LlamaForCausalLM:
         model.config = config
         model.segments = llama_segments(config)
         model.stacked_params_prefix = "layers"
+        model.supports_kv_cache = True
         model.convert_state_dict = lambda flat: convert_hf_llama_state_dict(flat, config)
         # tied embeddings are a single leaf in this functional design (no
         # separate lm_head param exists), so no tie group is declared

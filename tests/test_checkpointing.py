@@ -134,3 +134,49 @@ def test_save_model_weights(tmp_path):
 
     flat = load_array_dict(str(tmp_path / "m" / "model"))
     assert float(flat["a"]) == 5.0
+
+
+def test_async_save_roundtrip(tmp_path):
+    """async_save returns before files land; the next load joins the
+    writer (orbax-style contract — SURVEY §3.6 'sharded async checkpoint')."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.checkpointing import wait_for_checkpoint
+    from accelerate_tpu.test_utils import RegressionModel
+
+    accelerator = Accelerator()
+    model, opt = accelerator.prepare(RegressionModel(a=1.5, b=-0.5), optax.sgd(0.1))
+    out = accelerator.save_state(str(tmp_path / "ckpt"), async_save=True)
+    wait_for_checkpoint()
+    assert (tmp_path / "ckpt" / "accelerator_state.json").exists()
+
+    # mutate, save async again, then load WITHOUT waiting — load must join
+    model.params = {"a": model.params["a"] * 0 + 9.0, "b": model.params["b"]}
+    accelerator.save_state(str(tmp_path / "ckpt2"), async_save=True)
+    accelerator.load_state(str(tmp_path / "ckpt2"))
+    assert float(np.asarray(model.params["a"])) == 9.0
+
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    assert float(np.asarray(model.params["a"])) == 1.5
+
+
+def test_async_save_snapshots_state_at_call_time(tmp_path):
+    """Values mutated right after an async save must NOT leak into the
+    files (the writer sees a snapshot)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.checkpointing import wait_for_checkpoint
+    from accelerate_tpu.test_utils import RegressionModel
+
+    accelerator = Accelerator()
+    model, opt = accelerator.prepare(RegressionModel(a=3.0, b=0.0), optax.sgd(0.1))
+    accelerator.step = 7
+    accelerator.save_state(str(tmp_path / "snap"), async_save=True)
+    accelerator.step = 999  # training races ahead
+    wait_for_checkpoint()
+    import json
+
+    meta = json.loads((tmp_path / "snap" / "accelerator_state.json").read_text())
+    assert meta["step"] == 7

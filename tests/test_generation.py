@@ -91,3 +91,86 @@ def test_ragged_prompts_decode_from_their_own_positions():
     solo_short = generate(wrapped, short_p[None], max_new_tokens=3)
     np.testing.assert_array_equal(out[0, :9], solo_long[0])
     np.testing.assert_array_equal(out[1, 3:6], solo_short[0, 3:6])
+
+
+def test_cached_generation_matches_full_forward():
+    """KV-cache decode must produce token-for-token the same greedy output
+    as O(n²) re-forwards, including ragged right-padded batches."""
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 256, size=(2, 6)).astype(np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=5)
+    cached = generate(model, ids, max_new_tokens=5, use_cache=True)
+    np.testing.assert_array_equal(cached, ref)
+
+    # ragged batch
+    mask = np.asarray([[1] * 6, [1, 1, 1, 0, 0, 0]], np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=4, attention_mask=mask)
+    cached = generate(model, ids, max_new_tokens=4, attention_mask=mask, use_cache=True)
+    np.testing.assert_array_equal(cached[0], ref[0])
+    np.testing.assert_array_equal(cached[1, :7], ref[1, :7])
+
+
+def test_cached_generation_on_prepared_model():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model, cfg = _model()
+    ids = np.random.default_rng(6).integers(0, 256, size=(1, 5)).astype(np.int32)
+    ref = generate(_as_callable(model), ids, max_new_tokens=4)
+    prepared = accelerator.prepare_model(model)
+    cached = generate(prepared, ids, max_new_tokens=4, use_cache=True)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_use_cache_falls_back_for_unsupported_models():
+    model, cfg = _model(GPT2LMHeadModel, GPT2Config.tiny(layers=2, seq=64))
+    wrapped = _as_callable(model)
+    ids = np.random.default_rng(7).integers(0, 256, size=(1, 4)).astype(np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=3)
+    out = generate(wrapped, ids, max_new_tokens=3, use_cache=True)  # silent fallback
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_generation_past_max_positions_raises():
+    model, cfg = _model()  # tiny seq=64
+    wrapped = _as_callable(model)
+    ids = np.zeros((1, 60), np.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(wrapped, ids, max_new_tokens=10)  # 70 > 64
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(model, ids, max_new_tokens=10, use_cache=True)
+
+
+def test_cached_generation_compiles_once():
+    model, cfg = _model()
+    ids = np.zeros((1, 4), np.int32)
+    generate(model, ids, max_new_tokens=3, use_cache=True)
+    cache = model.apply_fn._generation_jit_cache
+    assert len(cache) == 1
+    generate(model, ids, max_new_tokens=3, use_cache=True)
+    assert len(cache) == 1  # same jit objects reused
+
+
+def test_dispatched_model_never_takes_cached_path():
+    """use_cache on a DispatchedModel must stream, not materialise."""
+    from accelerate_tpu.big_modeling import DispatchedModel
+
+    model, cfg = _model()
+    dispatched = cpu_offload(model)
+    called = {"materialize": 0}
+    orig = DispatchedModel._materialize_full
+
+    def counting(self):
+        called["materialize"] += 1
+        return orig(self)
+
+    DispatchedModel._materialize_full = counting
+    try:
+        ref = generate(_as_callable(model), np.zeros((1, 4), np.int32), max_new_tokens=2)
+        out = generate(dispatched, np.zeros((1, 4), np.int32), max_new_tokens=2, use_cache=True)
+    finally:
+        DispatchedModel._materialize_full = orig
+    assert called["materialize"] == 0
+    np.testing.assert_array_equal(out, ref)
