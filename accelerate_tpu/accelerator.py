@@ -235,7 +235,8 @@ class Accelerator:
         from .ops.attention import AttentionContext, set_attention_context
 
         cp_mode = None
-        if dict(self.state.mesh.shape).get("cp", 1) > 1:
+        mesh_shape = dict(self.state.mesh.shape)
+        if mesh_shape.get("cp", 1) > 1:
             if context_parallel_plugin is not None:
                 cp_mode = context_parallel_plugin.mode
             else:
@@ -246,6 +247,40 @@ class Accelerator:
                     raise ValueError(
                         f"ACCELERATE_CP_MODE={cp_mode!r} — expected ring|ulysses|allgather"
                     )
+            import re as _re
+
+            timeout_match = _re.search(
+                r"collective_call_terminate_timeout_seconds=(\d+)",
+                os.environ.get("XLA_FLAGS", ""),
+            )
+            # ≥300s gives a 1-core host room to schedule the subgroup
+            # collectives; a smaller value is as unsafe as none. (A flag
+            # exported after backend init is undetectable — the launcher
+            # and test conftest both set it before.)
+            timeout_ok = timeout_match is not None and int(timeout_match.group(1)) >= 300
+            if (
+                cp_mode == "ring"
+                and self.device.platform == "cpu"
+                and mesh_shape.get("dp", 1) > 1
+                and not timeout_ok
+            ):
+                # On few-core hosts, XLA CPU's default 40s collective
+                # rendezvous window ABORTS training programs that mix
+                # per-dp-replica cp ppermute subgroups with dp reduction
+                # groups (slow cross-subgroup scheduling, not a true
+                # deadlock — verified to complete with the window raised).
+                # The launcher/conftest set
+                # --xla_cpu_collective_call_terminate_timeout_seconds, which
+                # lets the real ring run; without it, protect the user with
+                # the numerically identical allgather formulation:
+                logger.warning(
+                    "cp_mode='ring' with dp>1 runs as 'allgather' on the CPU "
+                    "debug backend without "
+                    "--xla_cpu_collective_call_terminate_timeout_seconds in "
+                    "XLA_FLAGS (the default 40s rendezvous window aborts); "
+                    "TPU executes the real ring"
+                )
+                cp_mode = "allgather"
         set_attention_context(AttentionContext(mesh=self.state.mesh, cp_mode=cp_mode))
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration(

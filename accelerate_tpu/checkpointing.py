@@ -185,6 +185,20 @@ def wait_for_checkpoint():
             _ASYNC_SAVE["future"] = None
 
 
+def _join_writer_then_barrier(accelerator):
+    """Join the local async writer, ALWAYS reach the cross-process barrier,
+    then surface any local write failure — raising before the barrier would
+    leave the other processes hanging in it forever."""
+    error = None
+    try:
+        wait_for_checkpoint()
+    except Exception as e:  # noqa: BLE001 — surfaced after the barrier
+        error = e
+    accelerator.wait_for_everyone()
+    if error is not None:
+        raise error
+
+
 def save_accelerator_state(
     accelerator,
     output_dir: str | None = None,
@@ -198,7 +212,12 @@ def save_accelerator_state(
     only) runs now, the file writes land on a background worker, and the
     call returns immediately; see :func:`wait_for_checkpoint`.
     """
-    wait_for_checkpoint()  # saves are ordered; never interleave two writers
+    # join the previous writer, then barrier — saves are ordered, and the
+    # barrier bounds cross-process skew to ONE in-flight checkpoint (the
+    # rotation below deletes directories other processes may otherwise
+    # still be writing into). A local write failure must surface AFTER the
+    # barrier, or the other processes hang in it while this one raises.
+    _join_writer_then_barrier(accelerator)
     if output_dir is None:
         if accelerator.project_dir is None:
             raise ValueError("pass output_dir or set project_dir on the Accelerator")
@@ -287,10 +306,9 @@ def _sorted_checkpoints(checkpoints_dir: str) -> list[str]:
 
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """(Reference ``load_accelerator_state`` ``checkpointing.py:165``.)"""
-    wait_for_checkpoint()  # an in-flight async save must land first…
-    # …on EVERY process before ANY process reads (each joins its own
-    # writer above, then all meet here)
-    accelerator.wait_for_everyone()
+    # an in-flight async save must land on EVERY process before ANY
+    # process reads (each joins its own writer, then all meet)
+    _join_writer_then_barrier(accelerator)
     if input_dir is None:
         if accelerator.project_dir is None:
             raise ValueError("pass input_dir or set project_dir on the Accelerator")

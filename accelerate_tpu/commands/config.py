@@ -126,7 +126,11 @@ class ClusterConfig:
             env["JAX_PLATFORMS"] = "cpu"
             flags = os.environ.get("XLA_FLAGS", "")
             env["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={self.num_cpu_devices}"
+                flags
+                + f" --xla_force_host_platform_device_count={self.num_cpu_devices}"
+                # few-core hosts time-slice device threads; the default 40s
+                # collective rendezvous window would abort heavy programs
+                + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
             ).strip()
             # a CPU-mesh child must not open a TPU-plugin session (single
             # physical chip ⇒ concurrent sessions deadlock); clearing the

@@ -45,17 +45,38 @@ def _cache_backend(model):
         inner = model._model
         if not getattr(inner, "supports_kv_cache", False):
             return None
-        dtype = model.compute_dtype
+        # the wrapping closure is cached on the PreparedModel — a fresh
+        # closure per call would carry a fresh jit cache and recompile
+        # prefill/decode on every generate()
+        apply = getattr(model, "_cached_generation_apply", None)
+        if apply is None:
+            dtype = model.compute_dtype
 
-        def apply(p, **kw):
-            if dtype is not None:
-                p = _cast_floats(p, dtype)
-            return inner.apply_fn(p, **kw)
+            def apply(p, **kw):
+                if dtype is not None:
+                    p = _cast_floats(p, dtype)
+                return inner.apply_fn(p, **kw)
 
+            model._cached_generation_apply = apply
         return apply, model.params
     if isinstance(model, Model) and getattr(model, "supports_kv_cache", False):
         return model.apply_fn, model.params
     return None
+
+
+def _pick_next(logits, do_sample, temperature, key, finished, eos_token_id):
+    """Shared decode-step semantics (sampling, eos masking) for the cached
+    and full-forward loops — they must never diverge."""
+    if do_sample:
+        key, sub = jax.random.split(key)
+        scaled = jnp.asarray(logits) / max(temperature, 1e-6)
+        next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
+    else:
+        next_tok = logits.argmax(axis=-1)
+    if eos_token_id is not None:
+        next_tok = np.where(finished, eos_token_id, next_tok)
+        finished = finished | (next_tok == eos_token_id)
+    return next_tok, key, finished
 
 
 def _jitted_for(apply_fn, total: int):
@@ -78,7 +99,10 @@ def _jitted_for(apply_fn, total: int):
         decode = jax.jit(
             lambda p, tok, kv, idx: apply_fn(
                 p, input_ids=tok, kv_cache=kv, cache_index=idx
-            )
+            ),
+            # alias the KV buffers: without donation each step transiently
+            # holds TWO full [L, b, total, n_kv, hd] caches in device memory
+            donate_argnums=(2,),
         )
         entry = (prefill, decode)
         cache[total] = entry
@@ -135,15 +159,9 @@ def generate(
         out = model(input_ids=jnp.asarray(buf), attention_mask=jnp.asarray(mask))
         all_logits = np.asarray(jax.device_get(_logits_of(out)))
         logits = all_logits[rows, lengths - 1, :]
-        if do_sample:
-            key, sub = jax.random.split(key)
-            scaled = jnp.asarray(logits) / max(temperature, 1e-6)
-            next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
-        else:
-            next_tok = logits.argmax(axis=-1)
-        if eos_token_id is not None:
-            next_tok = np.where(finished, eos_token_id, next_tok)
-            finished |= next_tok == eos_token_id
+        next_tok, key, finished = _pick_next(
+            logits, do_sample, temperature, key, finished, eos_token_id
+        )
         buf[rows, lengths] = next_tok
         mask[rows, lengths] = 1
         lengths += 1
@@ -166,10 +184,14 @@ def _generate_cached(
     b, prompt_len = ids.shape
     total = prompt_len + max_new_tokens
     mask = (
-        np.asarray(attention_mask, np.int32)
+        np.atleast_2d(np.asarray(attention_mask, np.int32))
         if attention_mask is not None
         else np.ones((b, prompt_len), np.int32)
     )
+    if mask.shape != (b, prompt_len):
+        raise ValueError(
+            f"attention_mask shape {mask.shape} does not match input_ids {(b, prompt_len)}"
+        )
     lengths = mask.sum(axis=1).astype(np.int64)
     buf = np.zeros((b, total), np.int32)
     buf[:, :prompt_len] = ids
@@ -183,24 +205,18 @@ def _generate_cached(
 
     key = jax.random.PRNGKey(seed)
     finished = np.zeros((b,), bool)
-    for _ in range(max_new_tokens):
-        if do_sample:
-            key, sub = jax.random.split(key)
-            scaled = jnp.asarray(logits) / max(temperature, 1e-6)
-            next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
-        else:
-            next_tok = logits.argmax(axis=-1)
-        if eos_token_id is not None:
-            next_tok = np.where(finished, eos_token_id, next_tok)
-            finished |= next_tok == eos_token_id
+    for step in range(max_new_tokens):
+        next_tok, key, finished = _pick_next(
+            logits, do_sample, temperature, key, finished, eos_token_id
+        )
         buf[rows, lengths] = next_tok
+        lengths += 1
+        if step == max_new_tokens - 1 or (eos_token_id is not None and finished.all()):
+            break  # the last token needs no forward — its logits are unused
         out = decode(
             params, jnp.asarray(next_tok[:, None].astype(np.int32)),
-            cache, jnp.asarray(lengths, jnp.int32),
+            cache, jnp.asarray(lengths - 1, jnp.int32),
         )
         cache = out["kv_cache"]
         logits = np.asarray(jax.device_get(out["logits"]))[:, 0, :]
-        lengths += 1
-        if eos_token_id is not None and finished.all():
-            break
     return buf[:, : int(lengths.max())]

@@ -82,8 +82,22 @@ def ring_attention_local(
     axis_name: str = "cp",
     causal: bool = True,
     scale: float | None = None,
+    use_flash: bool | None = None,
 ) -> jax.Array:
-    """Ring attention body (call inside shard_map over ``axis_name``)."""
+    """Ring attention body (call inside shard_map over ``axis_name``).
+
+    On TPU the per-chunk compute runs the Mosaic flash kernel with a
+    whole-ring custom VJP (``ops/ring_flash.py``) — O(s) memory and
+    MXU-tiled chunk attention; elsewhere (and as the numerical oracle) the
+    einsum online-softmax body below."""
+    if use_flash is None:
+        use_flash = jax.devices()[0].platform == "tpu"
+    if use_flash:
+        from ..ops.ring_flash import ring_flash_attention_local
+
+        return ring_flash_attention_local(
+            q, k, v, kv_valid, axis_name=axis_name, causal=causal, scale=scale
+        )
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape

@@ -1,0 +1,86 @@
+"""Feature: automatic gradient accumulation (reference
+``examples/by_feature/automatic_gradient_accumulation.py``) — combine
+``find_executable_batch_size`` with on-the-fly accumulation: when the batch
+halves after an OOM, the accumulation steps double so the EFFECTIVE batch
+(and therefore the training dynamics) stay constant."""
+
+import argparse
+import sys, os
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator, find_executable_batch_size
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, observed = int(config["seed"]), []
+    target_batch_size = int(config["batch_size"])
+    metric = PairMetric()
+
+    @find_executable_batch_size(starting_batch_size=target_batch_size)
+    def inner_training_loop(batch_size):
+        # effective batch stays fixed: smaller microbatch → more accumulation
+        accumulation = max(target_batch_size // batch_size, 1)
+        observed.append((batch_size, accumulation))
+        accelerator.gradient_accumulation_steps = accumulation
+        accelerator.free_memory()
+        set_seed(seed)
+        train_dl, eval_dl, tokenizer = get_dataloaders(
+            accelerator, batch_size, EVAL_BATCH_SIZE
+        )
+        model = build_model(tokenizer, seed=seed)
+        optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optimizer, train_dl, eval_dl
+        )
+
+        for epoch in range(num_epochs):
+            model.train()
+            train_dl.set_epoch(epoch)
+            for step, batch in enumerate(train_dl):
+                with accelerator.accumulate(model):
+                    output = model(**batch)
+                    accelerator.backward(output.loss)
+                    optimizer.step()
+                    optimizer.zero_grad()
+
+            model.eval()
+            for step, batch in enumerate(eval_dl):
+                outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+                predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+                predictions, references = accelerator.gather_for_metrics(
+                    (predictions, batch["labels"])
+                )
+                metric.add_batch(predictions=predictions, references=references)
+            eval_metric = metric.compute()
+            accelerator.print(f"epoch {epoch}:", eval_metric)
+        return eval_metric
+
+    eval_metric = inner_training_loop()
+    accelerator.print("ran with (batch_size, accumulation):", observed)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Automatic gradient accumulation example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

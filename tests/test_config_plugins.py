@@ -172,3 +172,40 @@ def test_megatron_pp_raises():
     GradientState._reset_state()
     with pytest.raises(NotImplementedError, match="prepare_pippy"):
         Accelerator(megatron_lm_plugin=MegatronLMPlugin(pp_degree=2))
+
+
+def test_ring_with_dp_downgrades_without_timeout_flag(monkeypatch):
+    """XLA CPU's default 40s collective rendezvous window aborts ring+dp>1
+    training programs on few-core hosts; without the extended-timeout flag
+    the accelerator must route to the allgather formulation. With the flag
+    (which the launcher/conftest set) the real ring runs."""
+    import os
+
+    from accelerate_tpu import ContextParallelPlugin, MeshPlugin
+    from accelerate_tpu.ops.attention import get_attention_context
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    bare = re.sub(
+        r"--xla_cpu_collective_call_terminate_timeout_seconds=\d+", "", flags
+    )
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    with monkeypatch.context() as m:
+        m.setenv("XLA_FLAGS", bare)
+        Accelerator(
+            mesh_plugin=MeshPlugin(dp=2, fsdp=2, cp=2),
+            context_parallel_plugin=ContextParallelPlugin(mode="ring"),
+        )
+        assert get_attention_context().cp_mode == "allgather"
+
+    # with the flag present (the test env default): real ring, even dp>1
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        mesh_plugin=MeshPlugin(dp=2, fsdp=2, cp=2),
+        context_parallel_plugin=ContextParallelPlugin(mode="ring"),
+    )
+    assert get_attention_context().cp_mode == "ring"

@@ -226,3 +226,15 @@ def test_deepspeed_templates_ingest():
     assert p2.zero_stage == 2
     p3 = DeepSpeedPlugin(hf_ds_config=os.path.join(tpl_dir, "zero_stage3_offload_config.json"))
     assert p3.zero_stage == 3 and p3.offload_param_device == "cpu"
+
+
+def test_schedule_free_example():
+    stdout = _run(os.path.join(BY_FEATURE, "schedule_free.py"), "--num_epochs", "1")
+    assert "epoch 0" in stdout
+
+
+def test_automatic_gradient_accumulation_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "automatic_gradient_accumulation.py"), "--num_epochs", "1"
+    )
+    assert "ran with (batch_size, accumulation): [(16, 1)]" in stdout
