@@ -125,13 +125,16 @@ class ClusterConfig:
         if self.num_cpu_devices > 0:
             env["JAX_PLATFORMS"] = "cpu"
             flags = os.environ.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = (
-                flags
-                + f" --xla_force_host_platform_device_count={self.num_cpu_devices}"
-                # few-core hosts time-slice device threads; the default 40s
-                # collective rendezvous window would abort heavy programs
-                + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+            flags = (
+                flags + f" --xla_force_host_platform_device_count={self.num_cpu_devices}"
             ).strip()
+            if "collective_call_terminate_timeout" not in flags:
+                # few-core hosts time-slice device threads; the default 40s
+                # collective rendezvous window would abort heavy programs.
+                # (Guarded: a user-chosen value must not be clobbered —
+                # XLA's flag parsing is last-wins.)
+                flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+            env["XLA_FLAGS"] = flags
             # a CPU-mesh child must not open a TPU-plugin session (single
             # physical chip ⇒ concurrent sessions deadlock); clearing the
             # pool var makes any site-level TPU registration a no-op

@@ -45,19 +45,25 @@ def _cache_backend(model):
         inner = model._model
         if not getattr(inner, "supports_kv_cache", False):
             return None
-        # the wrapping closure is cached on the PreparedModel — a fresh
+        # the wrapping closures are cached on the PreparedModel — a fresh
         # closure per call would carry a fresh jit cache and recompile
-        # prefill/decode on every generate()
-        apply = getattr(model, "_cached_generation_apply", None)
+        # prefill/decode on every generate(). Keyed by the CURRENT
+        # compute_dtype: autocast(enabled=False) islands mutate it, and a
+        # stale snapshot would make generation blind to the policy.
+        cache = getattr(model, "_cached_generation_apply", None)
+        if cache is None:
+            cache = {}
+            model._cached_generation_apply = cache
+        dtype = model.compute_dtype
+        apply = cache.get(dtype)
         if apply is None:
-            dtype = model.compute_dtype
 
             def apply(p, **kw):
                 if dtype is not None:
                     p = _cast_floats(p, dtype)
                 return inner.apply_fn(p, **kw)
 
-            model._cached_generation_apply = apply
+            cache[dtype] = apply
         return apply, model.params
     if isinstance(model, Model) and getattr(model, "supports_kv_cache", False):
         return model.apply_fn, model.params

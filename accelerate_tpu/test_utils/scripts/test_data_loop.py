@@ -1,0 +1,88 @@
+"""Launched assertion script: end-of-dataloader / remainder / even-batches
+behavior (reference ``test_utils/scripts/test_distributed_data_loop.py``).
+Run via
+
+    accelerate-tpu launch --num_cpu_devices 8 -m accelerate_tpu.test_utils.scripts.test_data_loop
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _RangeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.float32(i)}
+
+
+class _Loader:
+    def __init__(self, dataset, batch_size, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.sampler = self.batch_sampler = self.collate_fn = None
+
+
+def check_end_of_dataloader_flags_last_batch(accelerator):
+    dl = accelerator.prepare(_Loader(_RangeDataset(32), 8))
+    seen = []
+    for batch in dl:
+        seen.append(dl.end_of_dataloader)
+    assert seen == [False, False, False, True], seen
+    accelerator.print("end_of_dataloader ok")
+
+
+def check_remainder_feeds_gather_for_metrics(accelerator):
+    # 30 samples, batch 8 → the last batch wraps 2 duplicates; the metric
+    # gather must drop them and land exactly on the dataset size
+    dl = accelerator.prepare(_Loader(_RangeDataset(30), 8))
+    total = 0
+    for batch in dl:
+        x = accelerator.gather_for_metrics(batch["x"])
+        total += int(np.asarray(x).shape[0])
+    assert total == 30, total
+    accelerator.print("remainder dedup ok")
+
+
+def check_drop_last(accelerator):
+    dl = accelerator.prepare(_Loader(_RangeDataset(30), 8, drop_last=True))
+    xs = [np.asarray(b["x"]) for b in dl]
+    assert len(xs) == 3 and all(x.shape[0] == 8 for x in xs), [x.shape for x in xs]
+    accelerator.print("drop_last ok")
+
+
+def check_epoch_reshuffle(accelerator):
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(use_seedable_sampler=True)
+    dl = acc.prepare(_Loader(_RangeDataset(32), 8))
+    dl.set_epoch(0)
+    first = [np.asarray(b["x"]).tolist() for b in dl]
+    dl.set_epoch(0)
+    again = [np.asarray(b["x"]).tolist() for b in dl]
+    dl.set_epoch(1)
+    second = [np.asarray(b["x"]).tolist() for b in dl]
+    assert first == again, "same epoch must reproduce the same order"
+    assert first != second, "different epochs must reshuffle"
+    accelerator.print("seedable epoch reshuffle ok")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    check_end_of_dataloader_flags_last_batch(accelerator)
+    check_remainder_feeds_gather_for_metrics(accelerator)
+    check_drop_last(accelerator)
+    check_epoch_reshuffle(accelerator)
+    accelerator.print("ALL_DATA_LOOP_OK")
+
+
+if __name__ == "__main__":
+    main()

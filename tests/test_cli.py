@@ -197,3 +197,12 @@ def test_launched_sync_script():
     cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_sync"]
     out = execute_subprocess_async(cmd)
     assert "ALL_SYNC_OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_launched_data_loop_script():
+    from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
+
+    cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_data_loop"]
+    out = execute_subprocess_async(cmd)
+    assert "ALL_DATA_LOOP_OK" in out.stdout

@@ -174,3 +174,24 @@ def test_dispatched_model_never_takes_cached_path():
         DispatchedModel._materialize_full = orig
     assert called["materialize"] == 0
     np.testing.assert_array_equal(out, ref)
+
+
+def test_cached_generation_respects_autocast_island():
+    """The cached-apply closure must key on the live compute_dtype, not a
+    stale snapshot — autocast islands mutate it."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.dataclasses import AutocastKwargs
+
+    accelerator = Accelerator(mixed_precision="bf16")
+    model, cfg = _model()
+    prepared = accelerator.prepare_model(model)
+    ids = np.random.default_rng(8).integers(0, 256, size=(1, 5)).astype(np.int32)
+
+    with accelerator.autocast(autocast_handler=AutocastKwargs(enabled=False)):
+        full_precision = generate(prepared, ids, max_new_tokens=3, use_cache=True)
+    bf16 = generate(prepared, ids, max_new_tokens=3, use_cache=True)
+    # two distinct closures cached, one per dtype policy
+    assert len(prepared._cached_generation_apply) == 2
+    assert None in prepared._cached_generation_apply
+    # both decode sane token streams (values may differ by precision)
+    assert full_precision.shape == bf16.shape == (1, 8)
