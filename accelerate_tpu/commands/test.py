@@ -6,20 +6,27 @@ from __future__ import annotations
 import os
 
 
+#: the bundled assertion-script suite (reference ships test_script plus
+#: test_ops/test_sync/test_distributed_data_loop under the same dir)
+ALL_SCRIPTS = ("test_script.py", "test_ops.py", "test_sync.py", "test_data_loop.py")
+
+
 def test_command(args) -> int:
     from ..test_utils import scripts
 
-    script = os.path.join(os.path.dirname(scripts.__file__), "test_script.py")
-
     from .launch import launch_command, launch_command_parser
 
+    names = ALL_SCRIPTS if getattr(args, "all", False) else ("test_script.py",)
     parser = launch_command_parser()
     forwarded = ["--num_cpu_devices", str(args.num_cpu_devices)] if args.num_cpu_devices else []
-    largs = parser.parse_args([*forwarded, script])
-    rc = launch_command(largs)
-    if rc == 0:
-        print("Test is a success! You are ready for your distributed training!")
-    return rc
+    for name in names:
+        script = os.path.join(os.path.dirname(scripts.__file__), name)
+        largs = parser.parse_args([*forwarded, script])
+        rc = launch_command(largs)
+        if rc != 0:
+            return rc
+    print("Test is a success! You are ready for your distributed training!")
+    return 0
 
 
 def add_parser(subparsers):
@@ -27,6 +34,10 @@ def add_parser(subparsers):
     p.add_argument(
         "--num_cpu_devices", type=int, default=0,
         help="run on a virtual CPU mesh of this many devices",
+    )
+    p.add_argument(
+        "--all", action="store_true",
+        help="run the full assertion-script suite (ops/sync/data-loop too)",
     )
     p.set_defaults(func=test_command)
     return p

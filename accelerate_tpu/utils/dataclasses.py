@@ -23,7 +23,7 @@ import enum
 import functools
 import os
 import warnings
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from datetime import timedelta
 from typing import Any, Callable, Iterable, Literal
 
@@ -462,7 +462,9 @@ class CustomDtype(BaseEnum):
 
 @dataclass
 class DataLoaderConfiguration(KwargsHandler):
-    """(Reference ``dataclasses.py`` DataLoaderConfiguration.)"""
+    """(Reference ``dataclasses.py`` DataLoaderConfiguration; every knob is
+    also env-reachable as ``ACCELERATE_<NAME>`` — exported manually or via
+    ``accelerate-tpu launch``'s environment passthrough.)"""
 
     split_batches: bool = False
     dispatch_batches: bool | None = None
@@ -472,6 +474,19 @@ class DataLoaderConfiguration(KwargsHandler):
     use_stateful_dataloader: bool = False
     prefetch_batches: int = 2  # background collate+H2D lookahead depth (0 = sync)
 
+    def __post_init__(self):
+        # precedence: explicit non-default ctor args > env > defaults
+        # (the reference's plugin self-hydration contract)
+        from .environment import str_to_bool
 
-def add_model_config_to_megatron_parser(*a, **k):  # pragma: no cover
-    raise NotImplementedError("Megatron engine does not exist in the TPU-native build")
+        defaults = {f.name: f.default for f in fields(self)}
+        for name in (
+            "split_batches", "even_batches", "use_seedable_sampler",
+            "non_blocking", "use_stateful_dataloader", "dispatch_batches",
+        ):
+            env = os.environ.get(f"ACCELERATE_{name.upper()}")
+            if env is not None and getattr(self, name) == defaults[name]:
+                setattr(self, name, bool(str_to_bool(env)))
+        env = os.environ.get("ACCELERATE_PREFETCH_BATCHES")
+        if env is not None and self.prefetch_batches == defaults["prefetch_batches"]:
+            self.prefetch_batches = int(env)
