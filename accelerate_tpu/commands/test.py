@@ -22,9 +22,11 @@ def test_command(args) -> int:
     for name in names:
         script = os.path.join(os.path.dirname(scripts.__file__), name)
         largs = parser.parse_args([*forwarded, script])
-        rc = launch_command(largs)
-        if rc != 0:
-            return rc
+        try:
+            launch_command(largs)  # raises on a nonzero child exit
+        except RuntimeError as e:
+            print(f"FAILED: {name}: {e}")
+            return 1
     print("Test is a success! You are ready for your distributed training!")
     return 0
 

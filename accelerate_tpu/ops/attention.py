@@ -38,8 +38,8 @@ class AttentionContext:
     batch_axes: tuple[str, ...] = ("dp", "fsdp")
     head_axis: str = "tp"
     impl: Literal["auto", "flash", "blockwise", "reference"] = "auto"
-    block_q: int = 128
-    block_kv: int = 128
+    block_q: int = 512
+    block_kv: int = 1024
 
 
 _current = AttentionContext()
@@ -164,9 +164,11 @@ def attention(
             block_q=ctx.block_q, block_kv=ctx.block_kv,
         )
     if impl == "blockwise":
+        # the pure-JAX fallback has its own sweet spot — the Pallas-tuned
+        # kv block would 8x the materialised score tile on CPU
         return blockwise_attention(
             q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
-            block_kv=max(ctx.block_kv, 128),
+            block_kv=min(max(ctx.block_kv, 128), 512),
         )
     if not causal:
         from .layers import dot_product_attention

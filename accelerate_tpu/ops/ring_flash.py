@@ -29,11 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .flash_attention import NEG_INF, _bwd_call, _fwd_call, _pad_to
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((max(x, 1) + m - 1) // m) * m
+from .flash_attention import NEG_INF, _bwd_call, _fit_block, _fwd_call, _pad_to
 
 
 def _merge(o_run, lse_run, o_c, lse_c):
@@ -169,8 +165,8 @@ def ring_flash_attention_local(
     axis_name: str = "cp",
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 512,
+    block_kv: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Ring attention body with flash-kernel chunks (call inside shard_map
@@ -180,8 +176,8 @@ def ring_flash_attention_local(
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
 
-    block_q = min(_round_up(block_q, 8), _round_up(s_loc, 8))
-    block_kv = min(_round_up(block_kv, 128), _round_up(s_loc, 128))
+    block_q = _fit_block(s_loc, block_q, 8)
+    block_kv = _fit_block(s_loc, block_kv, 128)
     sq_p = int(np.ceil(s_loc / block_q)) * block_q
     skv_p = int(np.ceil(s_loc / block_kv)) * block_kv
 
