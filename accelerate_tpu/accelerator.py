@@ -182,26 +182,23 @@ class Accelerator:
         self.context_parallel_plugin = context_parallel_plugin
 
         # Megatron facade lowers onto mesh axes (SURVEY §2.2: tp_degree →
-        # tp axis; pp_degree has no training analog on TPU — prepare_pippy
-        # covers inference pipelining). Megatron-SP shards activations over
-        # the EXISTING tp group, which has no 1:1 GSPMD mapping here; the
-        # cp axis is this framework's (strictly more general) sequence
-        # sharding, so the flag only points users there rather than
-        # silently multiplying the device requirement.
+        # tp axis; pp_degree → pp axis, which runs the GPipe schedule in
+        # parallel/pipeline.py for stacked-layer models). Megatron-SP shards
+        # activations over the EXISTING tp group, which has no 1:1 GSPMD
+        # mapping here; the cp axis is this framework's (strictly more
+        # general) sequence sharding, so the flag only points users there
+        # rather than silently multiplying the device requirement.
         if megatron_lm_plugin is not None and mesh_plugin is None:
-            if getattr(megatron_lm_plugin, "pp_degree", 1) > 1:
-                raise NotImplementedError(
-                    "pipeline-parallel training is not a TPU-native strategy "
-                    "(GSPMD sharding wins); use prepare_pippy for inference "
-                    "pipelining, or tp/fsdp axes for training"
-                )
             if getattr(megatron_lm_plugin, "sequence_parallelism", False):
                 logger.info(
                     "Megatron sequence_parallelism maps onto the cp mesh axis "
                     "here; size it explicitly (MeshPlugin(cp=...) or "
                     "--mesh_cp) to shard sequence activations"
                 )
-            mesh_plugin = MeshPlugin(tp=getattr(megatron_lm_plugin, "tp_degree", 1))
+            mesh_plugin = MeshPlugin(
+                tp=getattr(megatron_lm_plugin, "tp_degree", 1),
+                pp=getattr(megatron_lm_plugin, "pp_degree", 1),
+            )
 
         # kwargs handlers (reference :387-421)
         from .ops.fp8 import FP8RecipeKwargs
@@ -236,6 +233,17 @@ class Accelerator:
 
         cp_mode = None
         mesh_shape = dict(self.state.mesh.shape)
+        if mesh_shape.get("pp", 1) > 1:
+            # fail at construction, not at the first forward
+            from .parallel.pipeline import set_default_microbatches, validate_pipeline_axes
+
+            validate_pipeline_axes(mesh_shape)
+
+            # honour the requested schedule depth (reference field
+            # ``num_micro_batches``, utils/dataclasses.py:1912); the
+            # facade default of 1 means "unset" → auto
+            _mb = getattr(megatron_lm_plugin, "num_micro_batches", 0) or 0
+            set_default_microbatches(_mb if _mb > 1 else 0)
         if mesh_shape.get("cp", 1) > 1:
             if context_parallel_plugin is not None:
                 cp_mode = context_parallel_plugin.mode

@@ -45,6 +45,7 @@ class ClusterConfig:
     gradient_accumulation_steps: int = 1
     # mesh axes (-1 = absorb remaining devices)
     mesh_dp: int = -1
+    mesh_pp: int = 1
     mesh_fsdp: int = 1
     mesh_ep: int = 1
     mesh_cp: int = 1
@@ -98,6 +99,7 @@ class ClusterConfig:
             "ACCELERATE_MIXED_PRECISION": str(self.mixed_precision),
             "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
             "ACCELERATE_MESH_DP": str(self.mesh_dp),
+            "ACCELERATE_MESH_PP": str(self.mesh_pp),
             "ACCELERATE_MESH_FSDP": str(self.mesh_fsdp),
             "ACCELERATE_MESH_EP": str(self.mesh_ep),
             "ACCELERATE_MESH_CP": str(self.mesh_cp),
@@ -206,6 +208,7 @@ def get_cluster_input() -> ClusterConfig:
     cfg.mesh_tp = _ask("Tensor-parallel mesh extent?", 1, int)
     cfg.mesh_cp = _ask("Context-parallel (sequence) mesh extent?", 1, int)
     cfg.mesh_ep = _ask("Expert-parallel mesh extent?", 1, int)
+    cfg.mesh_pp = _ask("Pipeline-parallel (GPipe stage) mesh extent?", 1, int)
     if cfg.mesh_cp > 1:
         cfg.context_parallel_mode = _ask(
             "Context parallel mode? (ring/ulysses/allgather)", "ring"

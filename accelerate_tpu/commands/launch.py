@@ -40,6 +40,7 @@ def launch_command_parser(subparsers=None):
     )
     # mesh
     p.add_argument("--mesh_dp", type=int, default=None)
+    p.add_argument("--mesh_pp", type=int, default=None)
     p.add_argument("--mesh_fsdp", type=int, default=None)
     p.add_argument("--mesh_ep", type=int, default=None)
     p.add_argument("--mesh_cp", type=int, default=None)
@@ -82,7 +83,7 @@ def _merge_args_into_config(args, cfg: ClusterConfig) -> ClusterConfig:
     """CLI flags override the config file (reference
     ``_validate_launch_command``, ``launch.py:966``)."""
     for cli, attr in [
-        ("mesh_dp", "mesh_dp"), ("mesh_fsdp", "mesh_fsdp"), ("mesh_ep", "mesh_ep"),
+        ("mesh_dp", "mesh_dp"), ("mesh_pp", "mesh_pp"), ("mesh_fsdp", "mesh_fsdp"), ("mesh_ep", "mesh_ep"),
         ("mesh_cp", "mesh_cp"), ("mesh_tp", "mesh_tp"),
         ("mixed_precision", "mixed_precision"),
         ("gradient_accumulation_steps", "gradient_accumulation_steps"),

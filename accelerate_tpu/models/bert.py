@@ -135,6 +135,9 @@ def bert_apply(
     token_type_ids: jax.Array | None = None,   # [b, s] sentence-pair segments
     labels: jax.Array | None = None,           # [b] class index
 ):
+    from ..parallel.pipeline import ensure_no_pipeline_axis
+
+    ensure_no_pipeline_axis("bert")
     c = config
     b, s = input_ids.shape
     if attention_mask is None:

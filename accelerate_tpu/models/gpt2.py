@@ -134,6 +134,9 @@ def gpt2_apply(
     positions: jax.Array | None = None,
 ):
     c = config
+    from ..parallel.pipeline import ensure_no_pipeline_axis
+
+    ensure_no_pipeline_axis("gpt2")
     b, s = input_ids.shape
     if s > c.max_position_embeddings:
         raise ValueError(

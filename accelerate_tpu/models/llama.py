@@ -49,6 +49,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     remat: bool = True
+    #: GPipe microbatch count when the mesh has a pp axis > 1
+    #: (0 = auto: smallest batch divisor >= number of stages)
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -181,6 +184,45 @@ def _constrain(x, spec):
         return x
 
 
+def _pipeline_mesh():
+    from ..parallel.pipeline import active_pipeline_mesh
+
+    return active_pipeline_mesh()
+
+
+def _pipeline_stack(c, layers, x, cos, sin, positions, attention_mask, mesh):
+    """Run the transformer stack as a GPipe pipeline over the pp axis
+    (layer-stacked params split into contiguous stages)."""
+    from ..parallel.pipeline import gpipe
+
+    nstages = dict(mesh.shape)["pp"]
+    if c.num_hidden_layers % nstages != 0:
+        raise ValueError(
+            f"num_hidden_layers={c.num_hidden_layers} must divide evenly "
+            f"into pp={nstages} pipeline stages"
+        )
+
+    has_mask = attention_mask is not None
+
+    def stage_fn(local_layers, x_mb, *ops):
+        positions_mb = ops[0]
+        mask_mb = ops[1] if has_mask else None
+        cos_b, sin_b = ops[-2:]  # broadcast rope tables (shard_map bodies
+        # cannot close over traced values, so they ride the operand list)
+        body = _block(c, cos_b, sin_b, positions_mb, mask_mb)
+        y, _ = jax.lax.scan(body, x_mb, local_layers)
+        return y
+
+    aligned = (positions,) + ((attention_mask,) if has_mask else ())
+    return gpipe(
+        stage_fn, layers, x,
+        mesh=mesh,
+        aligned=aligned,
+        broadcast=(cos, sin),
+        num_microbatches=c.pipeline_microbatches,
+    )
+
+
 def llama_apply(
     config: LlamaConfig,
     params,
@@ -214,6 +256,15 @@ def llama_apply(
         )
     cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
 
+    if (use_cache or kv_cache is not None) and _pipeline_mesh() is not None:
+        # the prefill/decode scans have no GPipe path; running them over
+        # stage-split weights would silently all-gather the full stack onto
+        # every pp group — refuse, like the models without a pipeline path
+        raise NotImplementedError(
+            "KV-cache generation (use_cache/kv_cache) is not implemented "
+            "over a pp>1 mesh; run generation on a mesh with pp=1"
+        )
+
     if kv_cache is not None:
         return _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
 
@@ -241,8 +292,13 @@ def llama_apply(
 
         x, (k_cache, v_cache) = jax.lax.scan(body, x, params["layers"])
     else:
-        body = _block(c, cos, sin, positions, attention_mask)
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        pp_mesh = _pipeline_mesh()
+        if pp_mesh is not None:
+            x = _pipeline_stack(c, params["layers"], x, cos, sin, positions,
+                                attention_mask, pp_mesh)
+        else:
+            body = _block(c, cos, sin, positions, attention_mask)
+            x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
     head = params.get("lm_head")

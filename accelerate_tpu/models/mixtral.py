@@ -195,6 +195,9 @@ def mixtral_apply(
     positions: jax.Array | None = None,
 ):
     c = config
+    from ..parallel.pipeline import ensure_no_pipeline_axis
+
+    ensure_no_pipeline_axis("mixtral")
     b, s = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))

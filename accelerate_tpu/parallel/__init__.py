@@ -1,3 +1,4 @@
+from .pipeline import gpipe, pipeline_microbatches
 from .sharding import (
     infer_param_sharding,
     opt_state_sharding_like,

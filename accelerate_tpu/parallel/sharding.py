@@ -52,26 +52,49 @@ def partition_spec_for(
     rules: list[tuple[str, PartitionSpec]] | None,
 ) -> PartitionSpec:
     """Decide the PartitionSpec for one parameter."""
+    # GPipe stage placement: layer-stacked params (leading [layers] axis,
+    # path under "layers") split their stack over the pp axis so each stage
+    # group holds only its own layers. Applied as an overlay on whatever
+    # rule/policy decides for the other dims.
+    pp_size = dict(mesh.shape).get("pp", 1)
+    stacked = (
+        pp_size > 1
+        and re.search(r"(^|\.)layers(\.|$)", path_str) is not None
+        and len(shape) >= 1
+        and shape[0] % pp_size == 0
+    )
+
+    def overlay(spec: PartitionSpec) -> PartitionSpec:
+        if not stacked:
+            return spec
+        entries = list(tuple(spec)) + [None] * (len(shape) - len(tuple(spec)))
+        if entries[0] is None:
+            entries[0] = "pp"
+        return P(*entries)
+
     if rules:
         for pattern, spec in rules:
             if re.search(pattern, path_str):
-                return _validated(spec, shape, mesh)
+                return overlay(_validated(spec, shape, mesh))
     if plugin is None or not plugin.shards_params:
-        return P()
+        return overlay(P())
     fsdp_size = mesh.shape["fsdp"]
     if fsdp_size <= 1:
-        return P()
+        return overlay(P())
     n_elements = int(np.prod(shape)) if shape else 0
     if n_elements < max(plugin.min_num_params, 2):
-        return P()
-    # shard the largest divisible dim over fsdp
+        return overlay(P())
+    # shard the largest divisible dim over fsdp (dim 0 is reserved for the
+    # stage split when the pp overlay applies)
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for dim in order:
+        if stacked and dim == 0:
+            continue
         if shape[dim] % fsdp_size == 0:
             spec = [None] * len(shape)
             spec[dim] = "fsdp"
-            return P(*spec)
-    return P()
+            return overlay(P(*spec))
+    return overlay(P())
 
 
 def _validated(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:

@@ -215,7 +215,7 @@ class ProjectConfiguration:
 #: Canonical mesh axis names, ordered outermost (DCN-friendly) to innermost
 #: (ICI-friendly). Data parallel replicas tolerate slow links; tensor/expert
 #: parallel collectives must ride ICI — hence dp outermost, tp innermost.
-MESH_AXIS_ORDER = ("dp", "fsdp", "ep", "cp", "tp")
+MESH_AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "cp", "tp")
 
 
 @dataclass
@@ -226,6 +226,7 @@ class MeshPlugin(KwargsHandler):
     topology to torchrun env vars; here the mesh IS the topology.)"""
 
     dp: int = -1
+    pp: int = 1
     fsdp: int = 1
     ep: int = 1
     cp: int = 1
@@ -234,13 +235,13 @@ class MeshPlugin(KwargsHandler):
     allow_split_physical_axes: bool = False
 
     def __post_init__(self):
-        for ax in ("dp", "fsdp", "ep", "cp", "tp"):
+        for ax in MESH_AXIS_ORDER:
             env = os.environ.get(f"ACCELERATE_MESH_{ax.upper()}")
             if env is not None:
                 setattr(self, ax, int(env))
 
     def axis_sizes(self, num_devices: int) -> dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "ep": self.ep, "cp": self.cp, "tp": self.tp}
+        sizes = {ax: getattr(self, ax) for ax in MESH_AXIS_ORDER}
         fixed = 1
         wild = None
         for ax, n in sizes.items():
@@ -442,7 +443,7 @@ class MegatronLMPlugin(KwargsHandler):
     recompute_activations: bool = False
 
     def to_mesh_axes(self) -> dict[str, int]:
-        return {"tp": self.tp_degree}
+        return {"tp": self.tp_degree, "pp": self.pp_degree}
 
 
 # ---------------------------------------------------------------------------

@@ -44,3 +44,6 @@ def reset_state():
     GradientState._reset_state()
     PartialState._reset_state()
     set_attention_context(None)
+    from accelerate_tpu.parallel.pipeline import set_default_microbatches
+
+    set_default_microbatches(0)

@@ -85,6 +85,7 @@ def test_questionnaire_deepspeed_branch():
         "2",        # tp
         "1",        # cp
         "1",        # ep
+        "1",        # pp
         "bf16",     # precision
         "1",        # accumulation
         "no",       # debug
@@ -109,6 +110,7 @@ def test_questionnaire_fsdp_branch_roundtrips(tmp_path):
         "2",                    # fsdp extent
         "FULL_SHARD", "0", "yes", "no",  # fsdp sub-questionnaire
         "1", "2", "1",          # tp, cp, ep
+        "2",                    # pp
         "ulysses",              # cp mode
         "bf16", "2", "yes",     # precision, accum, debug
         "train",                # main fn
@@ -164,14 +166,19 @@ def test_megatron_plugin_lowers_to_mesh_axes():
     assert shape["cp"] == 1
 
 
-def test_megatron_pp_raises():
+def test_megatron_pp_maps_to_pipeline_axis():
+    """pp_degree lowers onto the pp mesh axis (GPipe schedule) the way
+    tp_degree lowers onto tp (reference delegates both to Megatron,
+    utils/dataclasses.py:1836)."""
     from accelerate_tpu.state import AcceleratorState, GradientState
     from accelerate_tpu.utils.dataclasses import MegatronLMPlugin
 
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    with pytest.raises(NotImplementedError, match="prepare_pippy"):
-        Accelerator(megatron_lm_plugin=MegatronLMPlugin(pp_degree=2))
+    acc = Accelerator(megatron_lm_plugin=MegatronLMPlugin(pp_degree=2, tp_degree=2))
+    shape = dict(acc.mesh.shape)
+    assert shape["pp"] == 2
+    assert shape["tp"] == 2
 
 
 def test_ring_with_dp_downgrades_without_timeout_flag(monkeypatch):

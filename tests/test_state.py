@@ -22,13 +22,13 @@ def test_mesh_built_over_8_cpu_devices():
     state = PartialState()
     assert state.num_devices == 8
     assert state.distributed_type == DistributedType.CPU_MESH
-    assert dict(state.mesh.shape) == {"dp": 8, "fsdp": 1, "ep": 1, "cp": 1, "tp": 1}
+    assert dict(state.mesh.shape) == {"dp": 8, "pp": 1, "fsdp": 1, "ep": 1, "cp": 1, "tp": 1}
     assert state.data_parallel_size == 8
 
 
 def test_mesh_plugin_shapes():
     state = PartialState(mesh_plugin=MeshPlugin(dp=-1, fsdp=2, tp=2))
-    assert dict(state.mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "cp": 1, "tp": 2}
+    assert dict(state.mesh.shape) == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "cp": 1, "tp": 2}
 
 
 def test_mesh_plugin_invalid_shape():
