@@ -195,10 +195,7 @@ class Accelerator:
                     "here; size it explicitly (MeshPlugin(cp=...) or "
                     "--mesh_cp) to shard sequence activations"
                 )
-            mesh_plugin = MeshPlugin(
-                tp=getattr(megatron_lm_plugin, "tp_degree", 1),
-                pp=getattr(megatron_lm_plugin, "pp_degree", 1),
-            )
+            mesh_plugin = MeshPlugin(**megatron_lm_plugin.to_mesh_axes())
 
         # kwargs handlers (reference :387-421)
         from .ops.fp8 import FP8RecipeKwargs

@@ -168,13 +168,16 @@ def dispatch_model(
         key = ".".join(_ppart(p) for p in path)
         flat[key] = leaf
 
-    stack_prefix = getattr(model, "stacked_params_prefix", None)
+    from .utils.modeling import stacked_prefixes
+
+    prefixes = stacked_prefixes(getattr(model, "stacked_params_prefix", None))
     devices = jax.local_devices()
     resident_paths, host_paths, disk_paths = [], [], []
     slice_plans: dict[str, list] = {}  # path -> per-layer tiers (straddling stacks)
     unmapped = []
     for key in flat:
-        if stack_prefix and key.startswith(stack_prefix + "."):
+        stack_prefix = next((p for p in prefixes if key.startswith(p + ".")), None)
+        if stack_prefix is not None:
             # per-layer lookup: 'layers.wq' layer i probes 'layers.i.wq' (the
             # expanded granularity auto maps use), falling back to the
             # unexpanded 'layers.wq' entry

@@ -47,6 +47,14 @@ def _zoo():
         z["mixtral-8x7b"] = (MixtralConfig(), lambda c: MixtralForCausalLM.from_config(c))
     except ImportError:
         pass
+    try:
+        from .t5 import T5Config, T5ForConditionalGeneration
+
+        z["t5-small"] = (T5Config.t5_small(), lambda c: T5ForConditionalGeneration.from_config(c))
+        z["t5-base"] = (T5Config.t5_base(), lambda c: T5ForConditionalGeneration.from_config(c))
+        z["t5-11b"] = (T5Config.t5_11b(), lambda c: T5ForConditionalGeneration.from_config(c))
+    except ImportError:
+        pass
     return z
 
 
@@ -101,6 +109,24 @@ def config_from_hf_json(path: str):
             num_local_experts=d.get("num_local_experts", 8),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
         )
+    if mt in ("t5", "mt5"):
+        from .t5 import T5Config
+
+        return T5Config(
+            vocab_size=d.get("vocab_size", 32128),
+            hidden_size=d.get("d_model", 512),
+            d_kv=d.get("d_kv", 64),
+            d_ff=d.get("d_ff", 2048),
+            num_layers=d.get("num_layers", 6),
+            num_decoder_layers=d.get("num_decoder_layers", d.get("num_layers", 6)),
+            num_heads=d.get("num_heads", 8),
+            relative_attention_num_buckets=d.get("relative_attention_num_buckets", 32),
+            relative_attention_max_distance=d.get("relative_attention_max_distance", 128),
+            feed_forward_proj=(
+                "gated-gelu" if "gated" in d.get("feed_forward_proj", "relu") else "relu"
+            ),
+            tie_word_embeddings=d.get("tie_word_embeddings", True),
+        )
     raise ValueError(f"unsupported model_type {mt!r}")
 
 
@@ -120,4 +146,8 @@ def model_factory_for_config(config):
         from .bert import BertForSequenceClassification
 
         return lambda c: BertForSequenceClassification.from_config(c)
+    if name == "T5Config":
+        from .t5 import T5ForConditionalGeneration
+
+        return lambda c: T5ForConditionalGeneration.from_config(c)
     raise ValueError(f"no factory for {name}")

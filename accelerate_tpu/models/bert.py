@@ -103,26 +103,31 @@ def init_bert_params(key: jax.Array, config: BertConfig, dtype=jnp.float32):
     }
 
 
-def _bert_block(config: BertConfig, attention_mask):
+def bert_layer_apply(config: BertConfig, layer, x, attention_mask):
+    """One post-embedding encoder block on UNstacked layer params (shared
+    by the scan body and the streaming/pipeline executors)."""
     c = config
     nh, hd = c.num_attention_heads, c.head_dim
+    b, s, h = x.shape
+    y = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    q = dense(y, layer["wq"]).reshape(b, s, nh, hd)
+    k = dense(y, layer["wk"]).reshape(b, s, nh, hd)
+    v = dense(y, layer["wv"]).reshape(b, s, nh, hd)
+    q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
+    k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
+    attn = attention(q, k, v, segment_mask=attention_mask, causal=False)
+    x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    y = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+    x = x + dense(jax.nn.gelu(dense(y, layer["w_in"])), layer["w_out"])
+    return _constrain(x, P(("dp", "fsdp"), "cp", None))
 
+
+def _bert_block(config: BertConfig, attention_mask):
     def body(x, layer):
-        b, s, h = x.shape
-        y = rms_norm(x, layer["attn_norm"], c.norm_eps)
-        q = dense(y, layer["wq"]).reshape(b, s, nh, hd)
-        k = dense(y, layer["wk"]).reshape(b, s, nh, hd)
-        v = dense(y, layer["wv"]).reshape(b, s, nh, hd)
-        q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
-        k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
-        attn = attention(q, k, v, segment_mask=attention_mask, causal=False)
-        x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
-        x = _constrain(x, P(("dp", "fsdp"), "cp", None))
-        y = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-        x = x + dense(jax.nn.gelu(dense(y, layer["w_in"])), layer["w_out"])
-        return _constrain(x, P(("dp", "fsdp"), "cp", None)), None
+        return bert_layer_apply(config, layer, x, attention_mask), None
 
-    if c.remat:
+    if config.remat:
         body = jax.checkpoint(body, prevent_cse=False)
     return body
 
@@ -169,6 +174,74 @@ def bert_apply(
     return out
 
 
+_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_in", "w_out", "attn_norm", "mlp_norm")
+
+
+def bert_segments(config: BertConfig):
+    """Streaming plan (offload/pipeline executors): embed → L× layer →
+    norm+classifier (mirrors ``gpt2_segments``; the reference's pippy
+    example set includes BERT, ``examples/inference/pippy/bert.py``)."""
+    c = config
+
+    def plan(input_ids=None, attention_mask=None, token_type_ids=None, labels=None, **kw):
+        b, s = input_ids.shape
+
+        def init():
+            return {
+                "ids": jnp.asarray(input_ids),
+                "mask": (
+                    jnp.ones((b, s), jnp.int32) if attention_mask is None
+                    else jnp.asarray(attention_mask)
+                ),
+                "types": (
+                    jnp.zeros((b, s), jnp.int32) if token_type_ids is None
+                    else jnp.asarray(token_type_ids)
+                ),
+            }
+
+        def embed_fn(seg, carry):
+            pos = jnp.arange(s, dtype=jnp.int32)
+            x = (
+                seg["embed_tokens"][carry["ids"]]
+                + seg["embed_positions"][pos][None, :, :]
+                + seg["embed_types"][carry["types"]]
+            )
+            return {**carry, "x": rms_norm(x, seg["emb_norm"], c.norm_eps)}
+
+        def layer_fn(seg, carry):
+            layer = {k: seg[f"layers.{k}"] for k in _LAYER_KEYS}
+            return {**carry, "x": bert_layer_apply(c, layer, carry["x"], carry["mask"])}
+
+        def head_fn(seg, carry):
+            x = rms_norm(carry["x"], seg["norm"], c.norm_eps)
+            logits = x[:, 0, :] @ seg["classifier.w"] + seg["classifier.b"]
+            return {**carry, "logits": logits}
+
+        steps = [
+            ("embed", ["embed_tokens", "embed_positions", "embed_types", "emb_norm"], embed_fn)
+        ]
+        for i in range(c.num_hidden_layers):
+            steps.append(
+                (("layer", i), [(f"layers.{k}", i) for k in _LAYER_KEYS], layer_fn)
+            )
+        steps.append(("head", ["norm", "classifier.w", "classifier.b"], head_fn))
+
+        def finalize(carry):
+            out = ModelOutput(logits=carry["logits"])
+            if labels is not None:
+                logp = jax.nn.log_softmax(carry["logits"].astype(jnp.float32), axis=-1)
+                out["loss"] = -jnp.mean(
+                    jnp.take_along_axis(
+                        logp, jnp.asarray(labels)[:, None].astype(jnp.int32), axis=-1
+                    )
+                )
+            return out
+
+        return {"init": init, "steps": steps, "finalize": finalize}
+
+    return plan
+
+
 class BertForSequenceClassification:
     """Factory mirroring :class:`LlamaForCausalLM`'s interface."""
 
@@ -192,4 +265,7 @@ class BertForSequenceClassification:
             name="BertForSequenceClassification",
         )
         model.config = config
+        model.stacked_params_prefix = "layers"
+        model.segments = bert_segments(config)
+        model.tied_parameters = []
         return model

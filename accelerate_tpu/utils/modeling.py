@@ -65,26 +65,36 @@ def named_module_tensors(
 # ---------------------------------------------------------------------------
 
 
-def flat_param_shapes(model_or_params, expand_stacked: str | None = None) -> dict[str, tuple]:
+def stacked_prefixes(expand_stacked) -> tuple[str, ...]:
+    """Normalise a model's ``stacked_params_prefix`` declaration — a single
+    dot-path prefix, or a tuple of them for multi-stack models (t5 has
+    ``encoder.layers`` and ``decoder.layers``)."""
+    if not expand_stacked:
+        return ()
+    if isinstance(expand_stacked, str):
+        return (expand_stacked,)
+    return tuple(expand_stacked)
+
+
+def flat_param_shapes(model_or_params, expand_stacked=None) -> dict[str, tuple]:
     """``{dot.path: (shape, dtype)}`` for a Model/PreparedModel/params tree.
 
-    ``expand_stacked``: dot-path prefix (e.g. ``"layers"``) whose leaves have
-    a leading layer dim to be expanded into per-layer entries.
+    ``expand_stacked``: dot-path prefix(es) (e.g. ``"layers"``) whose leaves
+    have a leading layer dim to be expanded into per-layer entries.
     """
     import jax
 
+    prefixes = stacked_prefixes(expand_stacked)
     params = getattr(model_or_params, "params", model_or_params)
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         key = ".".join(_part(p) for p in path)
         shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
         dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
-        if expand_stacked and key.startswith(expand_stacked + ".") and len(shape) >= 1:
+        prefix = next((p for p in prefixes if key.startswith(p + ".")), None)
+        if prefix is not None and len(shape) >= 1:
             for i in range(shape[0]):
-                flat[f"{expand_stacked}.{i}.{key[len(expand_stacked) + 1:]}"] = (
-                    shape[1:],
-                    dtype,
-                )
+                flat[f"{prefix}.{i}.{key[len(prefix) + 1:]}"] = (shape[1:], dtype)
         else:
             flat[key] = (shape, dtype)
     return flat

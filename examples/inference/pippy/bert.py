@@ -1,0 +1,37 @@
+"""Pipeline-parallel BERT inference (reference
+``examples/inference/pippy/bert.py``)."""
+
+import argparse
+import time
+
+import numpy as np
+
+from accelerate_tpu import prepare_pippy
+from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--layers", type=int, default=8)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=64)
+    args = parser.parse_args()
+
+    config = BertConfig.tiny(
+        vocab_size=2048, hidden_size=256, layers=args.layers, heads=8, seq=args.seq
+    )
+    model = BertForSequenceClassification.from_config(config, seed=0)
+    ids = np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(args.batch, args.seq)
+    ).astype(np.int32)
+
+    pipelined = prepare_pippy(model, example_kwargs={"input_ids": ids})
+    print(f"stages split at {pipelined.hf_split_points} over {len(pipelined.devices)} devices")
+    t0 = time.perf_counter()
+    out = pipelined(input_ids=ids)
+    np.asarray(out.logits)
+    print(f"logits {out.logits.shape} in {time.perf_counter() - t0:.3f}s (incl. compile)")
+
+
+if __name__ == "__main__":
+    main()

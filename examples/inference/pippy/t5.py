@@ -1,0 +1,40 @@
+"""Pipeline-parallel T5 inference (reference
+``examples/inference/pippy/t5.py``)."""
+
+import argparse
+import time
+
+import numpy as np
+
+from accelerate_tpu import prepare_pippy
+from accelerate_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=64)
+    parser.add_argument("--dec_seq", type=int, default=16)
+    args = parser.parse_args()
+
+    config = T5Config.tiny(vocab_size=2048, hidden_size=256, layers=args.layers, heads=8)
+    model = T5ForConditionalGeneration.from_config(config, seed=0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, size=(args.batch, args.seq)).astype(np.int32)
+    dec_ids = rng.integers(0, config.vocab_size, size=(args.batch, args.dec_seq)).astype(
+        np.int32
+    )
+
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids, "decoder_input_ids": dec_ids}
+    )
+    print(f"stages split at {pipelined.hf_split_points} over {len(pipelined.devices)} devices")
+    t0 = time.perf_counter()
+    out = pipelined(input_ids=ids, decoder_input_ids=dec_ids)
+    np.asarray(out.logits)
+    print(f"logits {out.logits.shape} in {time.perf_counter() - t0:.3f}s (incl. compile)")
+
+
+if __name__ == "__main__":
+    main()

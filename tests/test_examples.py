@@ -198,6 +198,16 @@ def test_pippy_inference_examples():
         "--layers", "4", "--batch", "4", "--seq", "16",
     )
     assert "stages split at" in stdout
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "pippy", "t5.py"),
+        "--layers", "2", "--batch", "4", "--seq", "16", "--dec_seq", "8",
+    )
+    assert "stages split at" in stdout
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "pippy", "bert.py"),
+        "--layers", "4", "--batch", "4", "--seq", "16",
+    )
+    assert "stages split at" in stdout
 
 
 def test_split_inference_example():

@@ -1,0 +1,71 @@
+"""BERT family: classification forward/loss, sharded training, streaming
+offload, pipeline inference (reference exposure: BERT-base is the
+``nlp_example.py`` model and ``examples/inference/pippy/bert.py``)."""
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, MeshPlugin, prepare_pippy
+from accelerate_tpu.big_modeling import cpu_offload
+from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+
+def _tiny(layers=2):
+    config = BertConfig.tiny(layers=layers)
+    model = BertForSequenceClassification.from_config(config, seed=1)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, size=(4, 32)).astype(np.int32)
+    labels = rng.integers(0, config.num_labels, size=(4,)).astype(np.int32)
+    return config, model, ids, labels
+
+
+def test_forward_shapes_and_loss():
+    config, model, ids, labels = _tiny()
+    out = model.apply_fn(model.params, input_ids=ids, labels=labels)
+    assert out.logits.shape == (4, config.num_labels)
+    loss = float(out.loss)
+    assert np.isfinite(loss)
+    assert abs(loss - np.log(config.num_labels)) < 0.5  # random ≈ uniform
+
+
+def test_training_on_sharded_mesh():
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=2, fsdp=2, tp=2))
+    config = BertConfig.tiny(layers=2)
+    model, opt = accelerator.prepare(
+        BertForSequenceClassification.from_config(config, seed=0), optax.adamw(1e-3)
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, size=(8, 32)).astype(np.int32)
+    labels = rng.integers(0, config.num_labels, size=(8,)).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        out = model(input_ids=ids, labels=labels)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(out.loss.item())
+    assert losses[-1] < losses[0]
+
+
+def test_streaming_offload_matches_resident():
+    config, model, ids, _ = _tiny()
+    ref = model.apply_fn(model.params, input_ids=ids).logits
+    out = cpu_offload(model)(input_ids=ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_inference_matches():
+    config, model, ids, _ = _tiny(layers=4)
+    ref = model.apply_fn(model.params, input_ids=ids).logits
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids}, devices=jax.devices()[:2]
+    )
+    out = pipelined(input_ids=ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_zoo_has_bert():
+    from accelerate_tpu.models import MODEL_ZOO
+
+    assert "bert-base" in MODEL_ZOO

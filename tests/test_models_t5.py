@@ -1,0 +1,203 @@
+"""T5 encoder-decoder family: forward/loss semantics, relative-position
+bias, sharded training, streaming offload, pipeline inference, HF name
+conversion (reference exposure: transformers T5 in
+``examples/inference/pippy/t5.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, MeshPlugin, prepare_pippy
+from accelerate_tpu.big_modeling import cpu_offload
+from accelerate_tpu.models.t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    compute_position_bias,
+    convert_hf_t5_state_dict,
+    relative_position_bucket,
+    shift_right,
+)
+
+
+def _tiny(layers=2, **kw):
+    config = T5Config.tiny(layers=layers, **kw)
+    model = T5ForConditionalGeneration.from_config(config, seed=1)
+    rng = np.random.default_rng(0)
+    enc_ids = rng.integers(0, 256, size=(2, 24)).astype(np.int32)
+    dec_ids = rng.integers(0, 256, size=(2, 12)).astype(np.int32)
+    return config, model, enc_ids, dec_ids
+
+
+def test_forward_shapes_and_loss():
+    config, model, enc_ids, dec_ids = _tiny()
+    out = model.apply_fn(model.params, input_ids=enc_ids, labels=dec_ids)
+    assert out["logits"].shape == (2, 12, 256)  # decoder length, not encoder
+    assert out["encoder_last_hidden_state"].shape == (2, 24, 64)
+    loss = float(out["loss"])
+    assert np.isfinite(loss)
+    # random model ≈ uniform over vocab
+    assert abs(loss - np.log(256)) < 1.0
+
+
+def test_shift_right_contract():
+    labels = jnp.asarray([[5, 6, 7, -100]], jnp.int32)
+    shifted = shift_right(labels, decoder_start_token_id=0)
+    np.testing.assert_array_equal(np.asarray(shifted), [[0, 5, 6, 7]])
+
+
+def test_relative_position_bucket_semantics():
+    rel = jnp.asarray([[-3, 0, 3]], jnp.int32)
+    bi = relative_position_bucket(rel, True, 32, 128)
+    uni = relative_position_bucket(rel, False, 32, 128)
+    # bidirectional separates past/future into disjoint bucket halves
+    assert int(bi[0, 0]) != int(bi[0, 2])
+    # causal mode collapses future keys (rel>0 → n=-rel<0 → bucket 0)
+    assert int(uni[0, 2]) == 0 and int(uni[0, 0]) > 0
+    bias = compute_position_bias(jnp.ones((32, 4)), 8, 8, True, 32, 128)
+    assert bias.shape == (1, 4, 8, 8)
+
+
+def test_decoder_is_causal():
+    """Perturbing a later decoder token must not change earlier logits."""
+    config, model, enc_ids, dec_ids = _tiny()
+    out1 = model.apply_fn(model.params, input_ids=enc_ids, decoder_input_ids=dec_ids)
+    dec2 = dec_ids.copy()
+    dec2[:, -1] = (dec2[:, -1] + 1) % 256
+    out2 = model.apply_fn(model.params, input_ids=enc_ids, decoder_input_ids=dec2)
+    np.testing.assert_allclose(
+        np.asarray(out1.logits[:, :-1]), np.asarray(out2.logits[:, :-1]),
+        rtol=1e-5, atol=1e-5,
+    )
+    # ...while the encoder is bidirectional: perturbing ANY encoder token
+    # changes all decoder logits
+    enc2 = enc_ids.copy()
+    enc2[:, 0] = (enc2[:, 0] + 1) % 256
+    out3 = model.apply_fn(model.params, input_ids=enc2, decoder_input_ids=dec_ids)
+    assert np.abs(np.asarray(out3.logits) - np.asarray(out1.logits)).max() > 1e-6
+
+
+def test_encoder_mask_blocks_padding():
+    config, model, enc_ids, dec_ids = _tiny()
+    mask = np.ones_like(enc_ids)
+    mask[:, -8:] = 0
+    out_masked = model.apply_fn(
+        model.params, input_ids=enc_ids, attention_mask=mask, decoder_input_ids=dec_ids
+    )
+    enc2 = enc_ids.copy()
+    enc2[:, -8:] = 17  # garbage in the masked region must not matter
+    out_masked2 = model.apply_fn(
+        model.params, input_ids=enc2, attention_mask=mask, decoder_input_ids=dec_ids
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_masked.logits), np.asarray(out_masked2.logits),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_training_on_sharded_mesh():
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=2, fsdp=2, tp=2))
+    config = T5Config.tiny(layers=2)
+    model, opt = accelerator.prepare(
+        T5ForConditionalGeneration.from_config(config, seed=0), optax.adamw(1e-2)
+    )
+    rng = np.random.default_rng(0)
+    enc_ids = rng.integers(0, 256, size=(8, 16)).astype(np.int32)
+    labels = rng.integers(0, 256, size=(8, 8)).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        out = model(input_ids=enc_ids, labels=labels)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(out.loss.item())
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_matches_replicated():
+    config, model, enc_ids, dec_ids = _tiny()
+    loss_plain = float(
+        model.apply_fn(model.params, input_ids=enc_ids, labels=dec_ids)["loss"]
+    )
+    accelerator = Accelerator(mesh_plugin=MeshPlugin(dp=2, fsdp=2, tp=2))
+    prepared, _ = accelerator.prepare(model, optax.sgd(0.0))
+    out = prepared(input_ids=enc_ids, labels=dec_ids)
+    assert abs(float(out.loss) - loss_plain) < 1e-4
+
+
+def test_streaming_offload_matches_resident():
+    config, model, enc_ids, dec_ids = _tiny()
+    ref = model.apply_fn(
+        model.params, input_ids=enc_ids, decoder_input_ids=dec_ids
+    )["logits"]
+    out = cpu_offload(model)(input_ids=enc_ids, decoder_input_ids=dec_ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_inference_matches():
+    config, model, enc_ids, dec_ids = _tiny(layers=2)
+    ref = model.apply_fn(
+        model.params, input_ids=enc_ids, decoder_input_ids=dec_ids
+    )["logits"]
+    pipelined = prepare_pippy(
+        model,
+        example_kwargs={"input_ids": enc_ids, "decoder_input_ids": dec_ids},
+        devices=jax.devices()[:2],
+    )
+    out = pipelined(input_ids=enc_ids, decoder_input_ids=dec_ids)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_gated_gelu_variant_runs():
+    config, model, enc_ids, dec_ids = _tiny(layers=1)
+    c2 = T5Config.tiny(layers=1)
+    c2.feed_forward_proj = "gated-gelu"
+    c2.tie_word_embeddings = False
+    m2 = T5ForConditionalGeneration.from_config(c2, seed=0)
+    assert "lm_head" in m2.params
+    assert "wi_0" in m2.params["encoder"]["layers"]
+    out = m2.apply_fn(m2.params, input_ids=enc_ids, labels=dec_ids)
+    assert np.isfinite(float(out["loss"]))
+
+
+def test_hf_name_conversion_roundtrip():
+    config, model, enc_ids, dec_ids = _tiny()
+    p = jax.tree.map(np.asarray, model.params)
+    hf = {"shared.weight": p["shared"]}
+    for side in ("encoder", "decoder"):
+        L = config.num_layers if side == "encoder" else config.num_decoder_layers
+        lp = p[side]["layers"]
+        hf[f"{side}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"] = (
+            p[side]["rel_bias"]
+        )
+        hf[f"{side}.final_layer_norm.weight"] = p[side]["final_norm"]
+        ffn_idx = 1 if side == "encoder" else 2
+        for i in range(L):
+            hf[f"{side}.block.{i}.layer.0.layer_norm.weight"] = lp["attn_norm"][i]
+            for n in "qkvo":
+                hf[f"{side}.block.{i}.layer.0.SelfAttention.{n}.weight"] = lp[f"w{n}"][i].T
+            if side == "decoder":
+                hf[f"{side}.block.{i}.layer.1.layer_norm.weight"] = lp["cross_norm"][i]
+                for n in "qkvo":
+                    hf[f"{side}.block.{i}.layer.1.EncDecAttention.{n}.weight"] = (
+                        lp[f"c{n}"][i].T
+                    )
+            hf[f"{side}.block.{i}.layer.{ffn_idx}.layer_norm.weight"] = lp["ffn_norm"][i]
+            hf[f"{side}.block.{i}.layer.{ffn_idx}.DenseReluDense.wi.weight"] = lp["wi"][i].T
+            hf[f"{side}.block.{i}.layer.{ffn_idx}.DenseReluDense.wo.weight"] = (
+                lp["wo_ffn"][i].T
+            )
+
+    converted = convert_hf_t5_state_dict(hf, config)
+    flat_a = jax.tree_util.tree_flatten_with_path(converted)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(p)[0]
+    assert [k for k, _ in flat_a] == [k for k, _ in flat_b]
+    for (ka, a), (_, b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(ka))
+
+
+def test_zoo_and_factories_have_t5():
+    from accelerate_tpu.models import MODEL_ZOO, model_factory_for_config
+
+    assert "t5-small" in MODEL_ZOO and "t5-11b" in MODEL_ZOO
+    assert model_factory_for_config(T5Config.tiny()) is not None
