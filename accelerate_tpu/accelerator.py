@@ -195,7 +195,15 @@ class Accelerator:
                     "here; size it explicitly (MeshPlugin(cp=...) or "
                     "--mesh_cp) to shard sequence activations"
                 )
-            mesh_plugin = MeshPlugin(**megatron_lm_plugin.to_mesh_axes())
+            # duck-typed: upstream-accelerate MegatronLMPlugin objects have
+            # the degree fields but not our to_mesh_axes()
+            if hasattr(megatron_lm_plugin, "to_mesh_axes"):
+                mesh_plugin = MeshPlugin(**megatron_lm_plugin.to_mesh_axes())
+            else:
+                mesh_plugin = MeshPlugin(
+                    tp=getattr(megatron_lm_plugin, "tp_degree", 1),
+                    pp=getattr(megatron_lm_plugin, "pp_degree", 1),
+                )
 
         # kwargs handlers (reference :387-421)
         from .ops.fp8 import FP8RecipeKwargs
@@ -546,6 +554,17 @@ class Accelerator:
         if isinstance(model, PreparedModel):
             return model
         model = _as_model(model)
+        # FSDP activation checkpointing → the model's remat knob (reference
+        # wires torch's checkpoint_wrapper at ``accelerator.py:1523``). Only
+        # upgrades: a model already configured to remat keeps its setting.
+        if (
+            self.fsdp_plugin is not None
+            and getattr(self.fsdp_plugin, "activation_checkpointing", False)
+            and hasattr(model, "config")
+            and hasattr(model.config, "remat")
+            and not model.config.remat
+        ):
+            model.config.remat = True
         rules = model.partition_rules
         sharding = infer_param_sharding(model.params, self.mesh, self.fsdp_plugin, rules)
         params = shard_params(model.params, sharding)

@@ -25,7 +25,7 @@ from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import rms_norm
-from .llama import _constrain
+from .llama import _constrain, remat_wrap
 
 
 @dataclass
@@ -39,7 +39,7 @@ class BertConfig:
     type_vocab_size: int = 2
     num_labels: int = 2
     norm_eps: float = 1e-12
-    remat: bool = False
+    remat: bool | str = False  # False | True | jax.checkpoint_policies name
 
     @property
     def head_dim(self) -> int:
@@ -127,9 +127,7 @@ def _bert_block(config: BertConfig, attention_mask):
     def body(x, layer):
         return bert_layer_apply(config, layer, x, attention_mask), None
 
-    if config.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
-    return body
+    return remat_wrap(body, config.remat)
 
 
 def bert_apply(

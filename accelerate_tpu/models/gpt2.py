@@ -21,7 +21,7 @@ from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import cross_entropy_loss
-from .llama import _constrain
+from .llama import _constrain, remat_wrap
 
 
 @dataclass
@@ -32,7 +32,7 @@ class GPT2Config:
     num_attention_heads: int = 12
     max_position_embeddings: int = 1024
     layer_norm_eps: float = 1e-5
-    remat: bool = False
+    remat: bool | str = False  # False | True | jax.checkpoint_policies name
 
     @property
     def head_dim(self) -> int:
@@ -153,7 +153,7 @@ def gpt2_apply(
     def body(x, layer):
         return gpt2_layer_apply(c, layer, x, attention_mask), None
 
-    body_fn = jax.checkpoint(body, prevent_cse=False) if c.remat else body
+    body_fn = remat_wrap(body, c.remat)
     x, _ = jax.lax.scan(body_fn, x, params["layers"])
 
     x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)

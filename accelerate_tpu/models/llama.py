@@ -48,7 +48,8 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
-    remat: bool = True
+    #: False | True (full recompute) | a jax.checkpoint_policies name
+    remat: bool | str = True
     #: GPipe microbatch count when the mesh has a pp axis > 1
     #: (0 = auto: smallest batch divisor >= number of stages)
     pipeline_microbatches: int = 0
@@ -164,15 +165,36 @@ def llama_layer_apply(
     return x
 
 
+def remat_wrap(body, remat):
+    """Apply the configured rematerialisation to a scan body.
+
+    ``remat`` is False (save everything), True (full recompute), or a
+    ``jax.checkpoint_policies`` name — e.g. ``"dots_saveable"`` keeps
+    matmul outputs resident and recomputes only elementwise work, trading
+    a fraction of full-remat's FLOPs for most of its memory win (the
+    activation_checkpointing knob of the FSDP plugin maps here; reference
+    wires torch's ``checkpoint_wrapper`` at ``accelerator.py:1523``)."""
+    if not remat:
+        return body
+    policy = None
+    if isinstance(remat, str):
+        policy = getattr(jax.checkpoint_policies, remat, None)
+        if policy is None:
+            raise ValueError(
+                f"unknown remat policy {remat!r}: expected a "
+                "jax.checkpoint_policies name, e.g. 'dots_saveable' or "
+                "'dots_with_no_batch_dims_saveable'"
+            )
+    return jax.checkpoint(body, prevent_cse=False, policy=policy)
+
+
 def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
     """One transformer block as a scan body over stacked layer params."""
 
     def body(x, layer):
         return llama_layer_apply(config, layer, x, cos, sin, positions, attention_mask), None
 
-    if config.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
-    return body
+    return remat_wrap(body, config.remat)
 
 
 def _constrain(x, spec):

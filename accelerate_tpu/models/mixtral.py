@@ -33,7 +33,7 @@ from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import apply_rope, cross_entropy_loss, rms_norm, rope_frequencies
-from .llama import _constrain
+from .llama import _constrain, remat_wrap
 
 
 @dataclass
@@ -51,7 +51,7 @@ class MixtralConfig:
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
-    remat: bool = True
+    remat: bool | str = True  # False | True | jax.checkpoint_policies name
 
     @property
     def head_dim(self) -> int:
@@ -211,7 +211,7 @@ def mixtral_apply(
         x, aux = mixtral_layer_apply(c, layer, x, cos, sin, positions, attention_mask)
         return (x, aux_sum + aux), None
 
-    body_fn = jax.checkpoint(body, prevent_cse=False) if c.remat else body
+    body_fn = remat_wrap(body, c.remat)
     (x, aux_total), _ = jax.lax.scan(body_fn, (x, jnp.asarray(0.0, jnp.float32)), params["layers"])
 
     x = rms_norm(x, params["norm"], c.rms_norm_eps)

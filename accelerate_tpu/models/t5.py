@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ..modules import Model, ModelOutput
 from ..ops.fp8 import dense
 from ..ops.layers import cross_entropy_loss, rms_norm
-from .llama import _constrain
+from .llama import _constrain, remat_wrap
 
 
 @dataclass
@@ -53,7 +53,7 @@ class T5Config:
     feed_forward_proj: str = "relu"  # "relu" (v1.0) | "gated-gelu" (v1.1)
     tie_word_embeddings: bool = True
     decoder_start_token_id: int = 0
-    remat: bool = False
+    remat: bool | str = False  # False | True | jax.checkpoint_policies name
 
     @classmethod
     def t5_small(cls):
@@ -291,7 +291,7 @@ def t5_encode(c, params, input_ids, attention_mask):
     def body(x, layer):
         return t5_encoder_layer_apply(c, layer, x, bias, attention_mask), None
 
-    body_fn = jax.checkpoint(body, prevent_cse=False) if c.remat else body
+    body_fn = remat_wrap(body, c.remat)
     x, _ = jax.lax.scan(body_fn, x, params["encoder"]["layers"])
     return rms_norm(x, params["encoder"]["final_norm"], c.layer_norm_epsilon)
 
@@ -314,7 +314,7 @@ def t5_decode(c, params, decoder_input_ids, decoder_attention_mask, enc_out, enc
             None,
         )
 
-    body_fn = jax.checkpoint(body, prevent_cse=False) if c.remat else body
+    body_fn = remat_wrap(body, c.remat)
     x, _ = jax.lax.scan(body_fn, x, params["decoder"]["layers"])
     return rms_norm(x, params["decoder"]["final_norm"], c.layer_norm_epsilon)
 

@@ -216,3 +216,40 @@ def test_ring_with_dp_downgrades_without_timeout_flag(monkeypatch):
         context_parallel_plugin=ContextParallelPlugin(mode="ring"),
     )
     assert get_attention_context().cp_mode == "ring"
+
+
+def test_fsdp_activation_checkpointing_wires_model_remat():
+    """FSDP plugin activation_checkpointing flips the model's remat knob at
+    prepare (reference wires checkpoint_wrapper, accelerator.py:1523)."""
+    import optax
+
+    from accelerate_tpu import FullyShardedDataParallelPlugin, MeshPlugin
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        mesh_plugin=MeshPlugin(dp=4, fsdp=2),
+        fsdp_plugin=FullyShardedDataParallelPlugin(activation_checkpointing=True),
+    )
+    cfg = LlamaConfig.tiny()
+    assert cfg.remat is False
+    model, _ = acc.prepare(LlamaForCausalLM.from_config(cfg, seed=0), optax.sgd(0.1))
+    assert cfg.remat is True
+
+
+def test_megatron_ducktyped_plugin_lowers():
+    """An upstream-accelerate-style plugin object (degree fields, no
+    to_mesh_axes method) still lowers onto the mesh axes."""
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    class ForeignMegatronPlugin:
+        tp_degree = 2
+        pp_degree = 2
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(megatron_lm_plugin=ForeignMegatronPlugin())
+    shape = dict(acc.mesh.shape)
+    assert shape["tp"] == 2 and shape["pp"] == 2

@@ -77,3 +77,26 @@ def test_llama_tiny_matches_replicated_vs_sharded():
     sharded_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
     loss_sharded = prepared(**sharded_batch).loss.item()
     np.testing.assert_allclose(loss_sharded, loss_plain, rtol=2e-5)
+
+
+def test_remat_policy_variants_match_full_remat():
+    """remat accepts a jax.checkpoint_policies name (dots_saveable keeps
+    matmul outputs resident); loss must be identical to remat=True, and an
+    unknown policy name must fail loudly."""
+    import pytest
+
+    config = LlamaConfig.tiny(layers=2, hidden_size=32, heads=2)
+    batch = {k: jnp.asarray(v) for k, v in _batch(b=4, s=16).items()}
+
+    losses = {}
+    for remat in (True, "dots_saveable"):
+        config.remat = remat
+        model = LlamaForCausalLM.from_config(config, seed=7)
+        out = model.apply_fn(model.params, **batch)
+        losses[str(remat)] = float(out.loss)
+    assert abs(losses["True"] - losses["dots_saveable"]) < 1e-6
+
+    config.remat = "not_a_policy"
+    model = LlamaForCausalLM.from_config(config, seed=7)
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        jax.grad(lambda p: model.apply_fn(p, **batch).loss)(model.params)
