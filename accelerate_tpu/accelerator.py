@@ -45,6 +45,7 @@ from .utils.dataclasses import (
     GradientAccumulationPlugin,
     GradScalerKwargs,
     InitProcessGroupKwargs,
+    MegatronLMPlugin,
     MeshPlugin,
     PrecisionType,
     ProfileKwargs,
@@ -245,10 +246,13 @@ class Accelerator:
             validate_pipeline_axes(mesh_shape)
 
             # honour the requested schedule depth (reference field
-            # ``num_micro_batches``, utils/dataclasses.py:1912); the
-            # facade default of 1 means "unset" → auto
+            # ``num_micro_batches``, utils/dataclasses.py:1912). Our plugin
+            # defaults to 0 (= auto) so an explicit 1 is honoured; foreign
+            # duck-typed plugins default to 1, which means "unset" there
             _mb = getattr(megatron_lm_plugin, "num_micro_batches", 0) or 0
-            set_default_microbatches(_mb if _mb > 1 else 0)
+            if not isinstance(megatron_lm_plugin, MegatronLMPlugin):
+                _mb = _mb if _mb > 1 else 0
+            set_default_microbatches(_mb)
         if mesh_shape.get("cp", 1) > 1:
             if context_parallel_plugin is not None:
                 cp_mode = context_parallel_plugin.mode

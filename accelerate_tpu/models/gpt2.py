@@ -253,7 +253,15 @@ def convert_hf_gpt2_state_dict(flat: dict, config: GPT2Config) -> dict:
 class GPT2LMHeadModel:
     @staticmethod
     def from_config(config: GPT2Config, seed: int = 0, dtype=jnp.float32) -> Model:
+        import dataclasses as _dc
+
         from ..big_modeling import is_empty_init
+
+        # private copy: apply_fn closes over it, so per-model knob
+        # changes (e.g. prepare() wiring activation_checkpointing
+        # into remat) cannot leak into other models built from the
+        # same config object
+        config = _dc.replace(config)
 
         if is_empty_init():
             params = jax.eval_shape(

@@ -496,7 +496,15 @@ class LlamaForCausalLM:
 
     @staticmethod
     def from_config(config: LlamaConfig, seed: int = 0, dtype=jnp.float32) -> Model:
+        import dataclasses as _dc
+
         from ..big_modeling import is_empty_init
+
+        # private copy: apply_fn closes over it, so per-model knob
+        # changes (e.g. prepare() wiring activation_checkpointing
+        # into remat) cannot leak into other models built from the
+        # same config object
+        config = _dc.replace(config)
 
         def make_params(key):
             return init_llama_params(key, config, dtype=dtype)

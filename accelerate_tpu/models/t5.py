@@ -85,7 +85,7 @@ T5_PARTITION_RULES = [
     (r"lm_head", P("fsdp", "tp")),
     (r"(encoder|decoder)\.rel_bias", P(None, "tp")),
     (r"layers\.(wq|wk|wv|cq|ck|cv)", P(None, "fsdp", "tp")),
-    (r"layers\.(wo|co)", P(None, "tp", "fsdp")),
+    (r"layers\.(wo|co)$", P(None, "tp", "fsdp")),
     (r"layers\.(wi|wi_0|wi_1)", P(None, "fsdp", "tp")),
     (r"layers\.wo_ffn", P(None, "tp", "fsdp")),
     (r"layers\..*_norm", P()),
@@ -522,7 +522,15 @@ def convert_hf_t5_state_dict(flat: dict, config: T5Config) -> dict:
 class T5ForConditionalGeneration:
     @staticmethod
     def from_config(config: T5Config, seed: int = 0, dtype=jnp.float32) -> Model:
+        import dataclasses as _dc
+
         from ..big_modeling import is_empty_init
+
+        # private copy: apply_fn closes over it, so per-model knob
+        # changes (e.g. prepare() wiring activation_checkpointing
+        # into remat) cannot leak into other models built from the
+        # same config object
+        config = _dc.replace(config)
 
         if is_empty_init():
             params = jax.eval_shape(

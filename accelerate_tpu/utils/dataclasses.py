@@ -438,7 +438,7 @@ class MegatronLMPlugin(KwargsHandler):
 
     tp_degree: int = 1
     pp_degree: int = 1
-    num_micro_batches: int = 1
+    num_micro_batches: int = 0  # 0 = auto (smallest divisor >= stages)
     sequence_parallelism: bool = False
     recompute_activations: bool = False
 

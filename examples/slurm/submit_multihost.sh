@@ -1,0 +1,26 @@
+#!/bin/bash
+# Multi-host TPU training under SLURM (reference analog:
+# examples/slurm/submit_multinode.sh — torchrun rendezvous becomes
+# jax.distributed coordinator discovery). One task per HOST: JAX drives
+# all local chips from a single process.
+
+#SBATCH --job-name=tpu-multihost
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # number of TPU hosts
+#SBATCH --ntasks-per-node=1         # ONE process per host (JAX owns local chips)
+#SBATCH --time=01:59:00
+
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+export LAUNCHER="accelerate-tpu launch \
+    --num_machines $SLURM_NNODES \
+    --machine_rank \$SLURM_PROCID \
+    --coordinator_address $head_node_ip:8476 \
+    --mesh_fsdp 16 \
+    "
+export SCRIPT="examples/complete_nlp_example.py"
+export SCRIPT_ARGS="--mixed_precision bf16"
+
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"

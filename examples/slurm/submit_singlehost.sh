@@ -1,0 +1,16 @@
+#!/bin/bash
+# Single-host TPU training under SLURM (reference analog:
+# examples/slurm/submit_multigpu.sh). No rendezvous needed — one process
+# drives every chip attached to the host.
+
+#SBATCH --job-name=tpu-singlehost
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=01:59:00
+
+accelerate-tpu launch \
+    --mesh_fsdp 4 --mesh_tp 2 \
+    examples/complete_nlp_example.py --mixed_precision bf16

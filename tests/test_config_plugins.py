@@ -235,8 +235,12 @@ def test_fsdp_activation_checkpointing_wires_model_remat():
     )
     cfg = LlamaConfig.tiny()
     assert cfg.remat is False
-    model, _ = acc.prepare(LlamaForCausalLM.from_config(cfg, seed=0), optax.sgd(0.1))
-    assert cfg.remat is True
+    base = LlamaForCausalLM.from_config(cfg, seed=0)
+    acc.prepare(base, optax.sgd(0.1))
+    # the wiring flips the MODEL's private config copy; the caller's object
+    # is untouched (no leak into other models built from the same config)
+    assert base.config.remat is True
+    assert cfg.remat is False
 
 
 def test_megatron_ducktyped_plugin_lowers():

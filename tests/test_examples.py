@@ -121,6 +121,18 @@ def test_local_sgd_example():
     assert "final loss" in stdout
 
 
+def test_megatron_lm_pretraining_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "megatron_lm_pretraining.py"),
+        "--tp", "2", "--pp", "2", "--num_micro_batches", "4", "--num_epochs", "1",
+    )
+    assert "'pp': 2" in stdout and "'tp': 2" in stdout
+    first, last = (
+        float(x) for x in stdout.split("pretraining loss ")[1].split()[0:3:2]
+    )
+    assert last < first  # bigram structure is learnable
+
+
 def test_tracking_example(tmp_path):
     stdout = _run(
         os.path.join(BY_FEATURE, "tracking.py"), "--num_epochs", "1",
