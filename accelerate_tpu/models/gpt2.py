@@ -33,6 +33,8 @@ class GPT2Config:
     max_position_embeddings: int = 1024
     layer_norm_eps: float = 1e-5
     remat: bool | str = False  # False | True | jax.checkpoint_policies name
+    #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -134,9 +136,6 @@ def gpt2_apply(
     positions: jax.Array | None = None,
 ):
     c = config
-    from ..parallel.pipeline import ensure_no_pipeline_axis
-
-    ensure_no_pipeline_axis("gpt2")
     b, s = input_ids.shape
     if s > c.max_position_embeddings:
         raise ValueError(
@@ -150,11 +149,35 @@ def gpt2_apply(
     x = params["wte"][input_ids] + params["wpe"][positions]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
-    def body(x, layer):
-        return gpt2_layer_apply(c, layer, x, attention_mask), None
+    from ..parallel.pipeline import active_pipeline_mesh, gpipe
 
-    body_fn = remat_wrap(body, c.remat)
-    x, _ = jax.lax.scan(body_fn, x, params["layers"])
+    pp_mesh = active_pipeline_mesh()
+    if pp_mesh is not None:
+        # GPipe over the pp axis: positions are already folded into x at
+        # the embedding, so only the mask rides the microbatch schedule
+        has_mask = attention_mask is not None
+
+        def stage_fn(local_layers, x_mb, *ops):
+            mask_mb = ops[0] if has_mask else None
+
+            def body_mb(h, layer):
+                return gpt2_layer_apply(c, layer, h, mask_mb), None
+
+            y, _ = jax.lax.scan(remat_wrap(body_mb, c.remat), x_mb, local_layers)
+            return y
+
+        x = gpipe(
+            stage_fn, params["layers"], x,
+            mesh=pp_mesh,
+            aligned=(attention_mask,) if has_mask else (),
+            num_microbatches=c.pipeline_microbatches,
+        )
+    else:
+        def body(x, layer):
+            return gpt2_layer_apply(c, layer, x, attention_mask), None
+
+        body_fn = remat_wrap(body, c.remat)
+        x, _ = jax.lax.scan(body_fn, x, params["layers"])
 
     x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)
     logits = dense(x, params["wte"].T)  # tied head

@@ -217,13 +217,6 @@ def _pipeline_stack(c, layers, x, cos, sin, positions, attention_mask, mesh):
     (layer-stacked params split into contiguous stages)."""
     from ..parallel.pipeline import gpipe
 
-    nstages = dict(mesh.shape)["pp"]
-    if c.num_hidden_layers % nstages != 0:
-        raise ValueError(
-            f"num_hidden_layers={c.num_hidden_layers} must divide evenly "
-            f"into pp={nstages} pipeline stages"
-        )
-
     has_mask = attention_mask is not None
 
     def stage_fn(local_layers, x_mb, *ops):

@@ -77,8 +77,8 @@ def ensure_no_pipeline_axis(model_name: str) -> None:
     if active_pipeline_mesh() is not None:
         raise NotImplementedError(
             f"pipeline-parallel execution is not implemented for "
-            f"{model_name}; use a mesh with pp=1 (llama implements the "
-            f"GPipe path)"
+            f"{model_name}; use a mesh with pp=1 (llama and gpt2 implement "
+            f"the GPipe path)"
         )
 
 
@@ -145,6 +145,13 @@ def gpipe(
     nstages = dict(mesh.shape).get(axis, 1)
     if nstages <= 1:
         return stage_fn(stage_params, x, *aligned, *broadcast)
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] % nstages != 0:
+            raise ValueError(
+                f"stacked layer axis of length {leaf.shape[0]} must divide "
+                f"evenly into {axis}={nstages} pipeline stages"
+            )
+        break  # all leaves share the [layers] leading axis
     b = x.shape[0]
     m = pipeline_microbatches(b, num_microbatches, nstages)
     mb = b // m

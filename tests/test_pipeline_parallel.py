@@ -79,15 +79,44 @@ def test_accelerator_rejects_pp_with_cp_at_construction():
 def test_unpipelined_models_reject_pp_axis():
     """Models without a GPipe path must refuse a pp>1 mesh instead of
     silently training un-pipelined with stage-split weights."""
-    from accelerate_tpu.models.gpt2 import GPT2Config, gpt2_apply, init_gpt2_params
+    from accelerate_tpu.models.mixtral import (
+        MixtralConfig,
+        init_mixtral_params,
+        mixtral_apply,
+    )
 
-    c = GPT2Config.tiny()
-    params = init_gpt2_params(jax.random.PRNGKey(0), c)
+    c = MixtralConfig.tiny(vocab_size=256, hidden_size=32, layers=2, heads=2, experts=2)
+    params = init_mixtral_params(jax.random.PRNGKey(0), c)
     ids = _batch(b=8, s=32)
     mesh = build_mesh(MeshPlugin(dp=4, pp=2))
     with attention_context(mesh=mesh), jax.set_mesh(mesh):
         with pytest.raises(NotImplementedError, match="pipeline-parallel"):
-            gpt2_apply(c, params, ids, labels=ids)
+            mixtral_apply(c, params, ids, labels=ids)
+
+
+def test_gpt2_pipeline_loss_and_grads_match_dense():
+    """GPT-2's GPipe path (mask-only aligned operand; positions folded into
+    the embedding) matches the dense computation."""
+    from accelerate_tpu.models.gpt2 import GPT2Config, gpt2_apply, init_gpt2_params
+
+    c = GPT2Config.tiny(layers=4, hidden_size=32, heads=2)
+    params = init_gpt2_params(jax.random.PRNGKey(0), c)
+    ids = _batch(b=8, s=32)
+    mask = jnp.ones_like(ids)
+
+    def loss_fn(p):
+        return gpt2_apply(c, p, ids, attention_mask=mask, labels=ids)["loss"]
+
+    loss_d, grads_d = jax.value_and_grad(loss_fn)(params)
+    mesh = build_mesh(MeshPlugin(dp=1, pp=4, fsdp=2))
+    with attention_context(mesh=mesh), jax.set_mesh(mesh):
+        loss_p, grads_p = jax.jit(jax.value_and_grad(loss_fn))(params)
+        loss_p = float(loss_p)
+    assert abs(loss_p - float(loss_d)) < 1e-4
+    max_err = jax.tree.reduce(
+        max, jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), grads_d, grads_p)
+    )
+    assert max_err < 1e-4
 
 
 # ---------------------------------------------------------------------------
