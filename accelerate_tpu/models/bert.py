@@ -40,6 +40,8 @@ class BertConfig:
     num_labels: int = 2
     norm_eps: float = 1e-12
     remat: bool | str = False  # False | True | jax.checkpoint_policies name
+    #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -138,9 +140,6 @@ def bert_apply(
     token_type_ids: jax.Array | None = None,   # [b, s] sentence-pair segments
     labels: jax.Array | None = None,           # [b] class index
 ):
-    from ..parallel.pipeline import ensure_no_pipeline_axis
-
-    ensure_no_pipeline_axis("bert")
     c = config
     b, s = input_ids.shape
     if attention_mask is None:
@@ -157,7 +156,20 @@ def bert_apply(
     x = rms_norm(x, params["emb_norm"], c.norm_eps)
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
-    x, _ = jax.lax.scan(_bert_block(c, attention_mask), x, params["layers"])
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
+
+    pp_mesh = active_pipeline_mesh()
+    if pp_mesh is not None:
+        x = pipeline_layer_stack(
+            lambda layer, h, pos_mb, mask_mb: bert_layer_apply(c, layer, h, mask_mb),
+            params["layers"], x,
+            mesh=pp_mesh,
+            remat=c.remat,
+            mask=attention_mask,
+            num_microbatches=c.pipeline_microbatches,
+        )
+    else:
+        x, _ = jax.lax.scan(_bert_block(c, attention_mask), x, params["layers"])
     x = rms_norm(x, params["norm"], c.norm_eps)
 
     pooled = x[:, 0, :]  # [CLS]

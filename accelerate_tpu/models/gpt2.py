@@ -149,27 +149,18 @@ def gpt2_apply(
     x = params["wte"][input_ids] + params["wpe"][positions]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
-    from ..parallel.pipeline import active_pipeline_mesh, gpipe
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
     pp_mesh = active_pipeline_mesh()
     if pp_mesh is not None:
         # GPipe over the pp axis: positions are already folded into x at
         # the embedding, so only the mask rides the microbatch schedule
-        has_mask = attention_mask is not None
-
-        def stage_fn(local_layers, x_mb, *ops):
-            mask_mb = ops[0] if has_mask else None
-
-            def body_mb(h, layer):
-                return gpt2_layer_apply(c, layer, h, mask_mb), None
-
-            y, _ = jax.lax.scan(remat_wrap(body_mb, c.remat), x_mb, local_layers)
-            return y
-
-        x = gpipe(
-            stage_fn, params["layers"], x,
+        x = pipeline_layer_stack(
+            lambda layer, h, pos_mb, mask_mb: gpt2_layer_apply(c, layer, h, mask_mb),
+            params["layers"], x,
             mesh=pp_mesh,
-            aligned=(attention_mask,) if has_mask else (),
+            remat=c.remat,
+            mask=attention_mask,
             num_microbatches=c.pipeline_microbatches,
         )
     else:

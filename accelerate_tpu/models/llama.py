@@ -165,27 +165,7 @@ def llama_layer_apply(
     return x
 
 
-def remat_wrap(body, remat):
-    """Apply the configured rematerialisation to a scan body.
-
-    ``remat`` is False (save everything), True (full recompute), or a
-    ``jax.checkpoint_policies`` name — e.g. ``"dots_saveable"`` keeps
-    matmul outputs resident and recomputes only elementwise work, trading
-    a fraction of full-remat's FLOPs for most of its memory win (the
-    activation_checkpointing knob of the FSDP plugin maps here; reference
-    wires torch's ``checkpoint_wrapper`` at ``accelerator.py:1523``)."""
-    if not remat:
-        return body
-    policy = None
-    if isinstance(remat, str):
-        policy = getattr(jax.checkpoint_policies, remat, None)
-        if policy is None:
-            raise ValueError(
-                f"unknown remat policy {remat!r}: expected a "
-                "jax.checkpoint_policies name, e.g. 'dots_saveable' or "
-                "'dots_with_no_batch_dims_saveable'"
-            )
-    return jax.checkpoint(body, prevent_cse=False, policy=policy)
+from ..parallel.pipeline import remat_wrap  # noqa: E402 — shared by all model families
 
 
 def _block(config: LlamaConfig, cos, sin, positions, attention_mask):
@@ -215,25 +195,18 @@ def _pipeline_mesh():
 def _pipeline_stack(c, layers, x, cos, sin, positions, attention_mask, mesh):
     """Run the transformer stack as a GPipe pipeline over the pp axis
     (layer-stacked params split into contiguous stages)."""
-    from ..parallel.pipeline import gpipe
+    from ..parallel.pipeline import pipeline_layer_stack
 
-    has_mask = attention_mask is not None
-
-    def stage_fn(local_layers, x_mb, *ops):
-        positions_mb = ops[0]
-        mask_mb = ops[1] if has_mask else None
-        cos_b, sin_b = ops[-2:]  # broadcast rope tables (shard_map bodies
-        # cannot close over traced values, so they ride the operand list)
-        body = _block(c, cos_b, sin_b, positions_mb, mask_mb)
-        y, _ = jax.lax.scan(body, x_mb, local_layers)
-        return y
-
-    aligned = (positions,) + ((attention_mask,) if has_mask else ())
-    return gpipe(
-        stage_fn, layers, x,
+    return pipeline_layer_stack(
+        lambda layer, h, pos_mb, mask_mb, cos_b, sin_b: llama_layer_apply(
+            c, layer, h, cos_b, sin_b, pos_mb, mask_mb
+        ),
+        layers, x,
         mesh=mesh,
-        aligned=aligned,
-        broadcast=(cos, sin),
+        remat=c.remat,
+        positions=positions,
+        mask=attention_mask,
+        rope=(cos, sin),
         num_microbatches=c.pipeline_microbatches,
     )
 

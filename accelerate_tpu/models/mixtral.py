@@ -52,6 +52,8 @@ class MixtralConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     remat: bool | str = True  # False | True | jax.checkpoint_policies name
+    #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -195,9 +197,6 @@ def mixtral_apply(
     positions: jax.Array | None = None,
 ):
     c = config
-    from ..parallel.pipeline import ensure_no_pipeline_axis
-
-    ensure_no_pipeline_axis("mixtral")
     b, s = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -206,13 +205,36 @@ def mixtral_apply(
     x = params["embed_tokens"][input_ids]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
-    def body(carry, layer):
-        x, aux_sum = carry
-        x, aux = mixtral_layer_apply(c, layer, x, cos, sin, positions, attention_mask)
-        return (x, aux_sum + aux), None
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
-    body_fn = remat_wrap(body, c.remat)
-    (x, aux_total), _ = jax.lax.scan(body_fn, (x, jnp.asarray(0.0, jnp.float32)), params["layers"])
+    pp_mesh = active_pipeline_mesh()
+    if pp_mesh is not None:
+        # GPipe with the aux accumulator: routing/capacity statistics are
+        # per-microbatch (standard MoE x pipeline semantics), so aux_loss
+        # is the microbatch mean rather than the whole-batch statistic
+        x, aux_total = pipeline_layer_stack(
+            lambda layer, h, pos_mb, mask_mb, cos_b, sin_b: mixtral_layer_apply(
+                c, layer, h, cos_b, sin_b, pos_mb, mask_mb
+            ),
+            params["layers"], x,
+            mesh=pp_mesh,
+            remat=c.remat,
+            positions=positions,
+            mask=attention_mask,
+            rope=(cos, sin),
+            num_microbatches=c.pipeline_microbatches,
+            with_aux=True,
+        )
+    else:
+        def body(carry, layer):
+            x, aux_sum = carry
+            x, aux = mixtral_layer_apply(c, layer, x, cos, sin, positions, attention_mask)
+            return (x, aux_sum + aux), None
+
+        body_fn = remat_wrap(body, c.remat)
+        (x, aux_total), _ = jax.lax.scan(
+            body_fn, (x, jnp.asarray(0.0, jnp.float32)), params["layers"]
+        )
 
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
     logits = dense(x, params["lm_head"])

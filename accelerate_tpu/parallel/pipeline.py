@@ -46,6 +46,29 @@ def set_default_microbatches(n: int) -> None:
     _default_num_microbatches = int(n)
 
 
+def remat_wrap(body, remat):
+    """Apply the configured rematerialisation to a scan body.
+
+    ``remat`` is False (save everything), True (full recompute), or a
+    ``jax.checkpoint_policies`` name — e.g. ``"dots_saveable"`` keeps
+    matmul outputs resident and recomputes only elementwise work, trading
+    a fraction of full-remat's FLOPs for most of its memory win (the
+    activation_checkpointing knob of the FSDP plugin maps here; reference
+    wires torch's ``checkpoint_wrapper`` at ``accelerator.py:1523``)."""
+    if not remat:
+        return body
+    policy = None
+    if isinstance(remat, str):
+        policy = getattr(jax.checkpoint_policies, remat, None)
+        if policy is None:
+            raise ValueError(
+                f"unknown remat policy {remat!r}: expected a "
+                "jax.checkpoint_policies name, e.g. 'dots_saveable' or "
+                "'dots_with_no_batch_dims_saveable'"
+            )
+    return jax.checkpoint(body, prevent_cse=False, policy=policy)
+
+
 def validate_pipeline_axes(mesh_shape: dict) -> None:
     """Single owner of the pp/cp composition rule (used both at
     ``Accelerator`` construction and at trace time)."""
@@ -77,8 +100,8 @@ def ensure_no_pipeline_axis(model_name: str) -> None:
     if active_pipeline_mesh() is not None:
         raise NotImplementedError(
             f"pipeline-parallel execution is not implemented for "
-            f"{model_name}; use a mesh with pp=1 (llama and gpt2 implement "
-            f"the GPipe path)"
+            f"{model_name}; use a mesh with pp=1 (llama/gpt2/bert/mixtral "
+            f"implement the GPipe path)"
         )
 
 
@@ -108,6 +131,67 @@ def pipeline_microbatches(batch: int, num_microbatches: int, num_stages: int) ->
     return batch
 
 
+def pipeline_layer_stack(
+    layer_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    remat=False,
+    positions: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    rope: tuple = (),
+    num_microbatches: int = 0,
+    with_aux: bool = False,
+):
+    """Run a transformer layer stack as a GPipe pipeline — the one owner of
+    the operand convention every model family shares.
+
+    ``layer_fn(layer, x_mb, positions_mb, mask_mb, *rope) -> y_mb`` (or
+    ``(y_mb, aux_scalar)`` with ``with_aux``) applies ONE unstacked layer.
+    ``positions``/``mask`` are per-example ``[batch, ...]`` operands that
+    ride the microbatch schedule (either may be None); ``rope`` tables are
+    broadcast to every stage call. The scan over each stage's local layers
+    (with ``remat`` applied per block) is built here so models don't
+    duplicate the aligned/broadcast packing or the aux carry.
+    """
+    aligned = tuple(a for a in (positions, mask) if a is not None)
+    has_pos = positions is not None
+    has_mask = mask is not None
+
+    def stage_fn(local_layers, x_mb, *ops):
+        pos_mb = ops[0] if has_pos else None
+        mask_mb = ops[int(has_pos)] if has_mask else None
+        rope_ops = ops[len(aligned):]
+        if with_aux:
+            def body(carry, layer):
+                h, aux_sum = carry
+                h, aux = layer_fn(layer, h, pos_mb, mask_mb, *rope_ops)
+                return (h, aux_sum + aux), None
+
+            (y, aux), _ = jax.lax.scan(
+                remat_wrap(body, remat),
+                (x_mb, jnp.asarray(0.0, jnp.float32)),
+                local_layers,
+            )
+            return y, aux
+
+        def body(h, layer):
+            return layer_fn(layer, h, pos_mb, mask_mb, *rope_ops), None
+
+        y, _ = jax.lax.scan(remat_wrap(body, remat), x_mb, local_layers)
+        return y
+
+    return gpipe(
+        stage_fn, stage_params, x,
+        mesh=mesh,
+        aligned=aligned,
+        broadcast=rope,
+        num_microbatches=num_microbatches,
+        with_aux=with_aux,
+    )
+
+
 def gpipe(
     stage_fn: Callable,
     stage_params,
@@ -118,7 +202,8 @@ def gpipe(
     broadcast: tuple = (),
     num_microbatches: int = 0,
     axis: str = "pp",
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run ``stage_fn`` as a GPipe pipeline over ``mesh`` axis ``axis``.
 
     Args:
@@ -138,9 +223,17 @@ def gpipe(
         tables, scalars).
       num_microbatches: GPipe microbatch count (0 = auto, see
         :func:`pipeline_microbatches`).
+      with_aux: ``stage_fn`` additionally returns a f32 scalar per call
+        (e.g. an MoE load-balancing statistic); gpipe returns
+        ``(outputs, aux)`` where aux is the mean over microbatches of the
+        per-stage sums, psum'd over the pipeline — i.e. the same
+        "sum over layers, averaged over the batch it was computed on"
+        contract the dense scan has, computed per microbatch (standard
+        MoE×GPipe semantics: routing statistics are per-microbatch).
 
     Returns ``[batch, ...]`` activations out of the last stage, replicated
-    over ``axis`` (other-axis sharding untouched).
+    over ``axis`` (other-axis sharding untouched); with ``with_aux``,
+    ``(outputs, aux_scalar)``.
     """
     nstages = dict(mesh.shape).get(axis, 1)
     if nstages <= 1:
@@ -181,25 +274,29 @@ def gpipe(
         stage = jax.lax.axis_index(axis)
         state0 = jnp.zeros_like(x_mb[0])
         outputs0 = jnp.zeros_like(x_mb)
+        aux0 = jnp.asarray(0.0, jnp.float32)
 
         def tick(carry, t):
-            state_in, outputs = carry
+            state_in, outputs, aux_acc = carry
             inject = x_mb[jnp.clip(t, 0, m - 1)]
             state_in = jnp.where(stage == 0, inject, state_in)
             # microbatch id this stage is processing at tick t (clipped:
             # out-of-range ticks compute on garbage whose output is masked)
             mb_idx = jnp.clip(t - stage, 0, m - 1)
+            valid = (t - stage >= 0) & (t - stage < m)
             aligned_t = tuple(
                 jax.lax.dynamic_index_in_dim(a, mb_idx, axis=0, keepdims=False)
                 for a in aligned_ops
             )
-            if cpu_widen:
-                y = stage_fn(
-                    local_params, state_in.astype(compute_dtype), *aligned_t,
-                    *broadcast_ops,
-                ).astype(jnp.float32)
+            state_arg = state_in.astype(compute_dtype) if cpu_widen else state_in
+            res = stage_fn(local_params, state_arg, *aligned_t, *broadcast_ops)
+            if with_aux:
+                y, aux = res
+                aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
             else:
-                y = stage_fn(local_params, state_in, *aligned_t, *broadcast_ops)
+                y = res
+            if cpu_widen:
+                y = y.astype(jnp.float32)
             out_idx = t - (nstages - 1)
             emit = (stage == nstages - 1) & (out_idx >= 0)
             idx = jnp.clip(out_idx, 0, m - 1)
@@ -210,10 +307,10 @@ def gpipe(
             # hand activation to the next stage; stage 0 receives zeros
             # (no wraparound edge) and overwrites them with its injection
             state_out = jax.lax.ppermute(y, axis, fwd_perm)
-            return (state_out, outputs), None
+            return (state_out, outputs, aux_acc), None
 
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state0, outputs0), jnp.arange(m + nstages - 1)
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (state0, outputs0, aux0), jnp.arange(m + nstages - 1)
         )
         # Replicate the last stage's outputs to every stage so downstream
         # (final norm / lm head / loss) runs replicated over pp. Done as a
@@ -227,15 +324,24 @@ def gpipe(
         for _ in range(nstages - 1):
             incoming = jax.lax.ppermute(outputs, axis, back_perm)
             outputs = jnp.where(stage == nstages - 1, outputs, incoming)
+        if with_aux:
+            # total over stages (each stage summed its own layers' aux over
+            # its m valid ticks), averaged over microbatches; stays f32 so
+            # the psum never enters XLA:CPU's bf16 promotion pass
+            aux_total = jax.lax.psum(aux_acc, axis) / m
+            return outputs, aux_total
         return outputs
 
     n_rest = len(aligned_mb) + len(broadcast)
-    y_mb = jax.shard_map(
+    out_specs = (P(), P()) if with_aux else P()
+    res = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P()) + (P(),) * n_rest,
-        out_specs=P(),
+        out_specs=out_specs,
         axis_names={axis},
         check_vma=False,
     )(stage_params, x_mb, *aligned_mb, *broadcast)
-    return y_mb.reshape(b, *x.shape[1:]).astype(compute_dtype)
+    y_mb, aux = res if with_aux else (res, None)
+    y = y_mb.reshape(b, *x.shape[1:]).astype(compute_dtype)
+    return (y, aux) if with_aux else y

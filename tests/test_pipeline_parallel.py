@@ -77,21 +77,69 @@ def test_accelerator_rejects_pp_with_cp_at_construction():
 
 
 def test_unpipelined_models_reject_pp_axis():
-    """Models without a GPipe path must refuse a pp>1 mesh instead of
-    silently training un-pipelined with stage-split weights."""
+    """Models without a GPipe path (t5: dual encoder/decoder stacks) must
+    refuse a pp>1 mesh instead of silently training un-pipelined with
+    stage-split weights."""
+    from accelerate_tpu.models.t5 import T5Config, init_t5_params, t5_apply
+
+    c = T5Config.tiny(layers=2, hidden_size=32, heads=2)
+    params = init_t5_params(jax.random.PRNGKey(0), c)
+    ids = _batch(b=8, s=32)
+    mesh = build_mesh(MeshPlugin(dp=4, pp=2))
+    with attention_context(mesh=mesh), jax.set_mesh(mesh):
+        with pytest.raises(NotImplementedError, match="pipeline-parallel"):
+            t5_apply(c, params, ids, labels=_batch(b=8, s=16, seed=1))
+
+
+def test_mixtral_pipeline_matches_dense_lm_loss():
+    """MoE x GPipe: per-token routing means the pipelined lm loss is exact
+    when capacity drops nothing; aux is the per-microbatch statistic."""
     from accelerate_tpu.models.mixtral import (
         MixtralConfig,
         init_mixtral_params,
         mixtral_apply,
     )
 
-    c = MixtralConfig.tiny(vocab_size=256, hidden_size=32, layers=2, heads=2, experts=2)
+    c = MixtralConfig.tiny(vocab_size=256, hidden_size=32, layers=4, heads=2, experts=2, seq=64)
+    c.capacity_factor = 8.0  # no token drops at any microbatch size
     params = init_mixtral_params(jax.random.PRNGKey(0), c)
     ids = _batch(b=8, s=32)
-    mesh = build_mesh(MeshPlugin(dp=4, pp=2))
+
+    out_d = mixtral_apply(c, params, ids, labels=ids)
+    mesh = build_mesh(MeshPlugin(dp=1, pp=2, fsdp=2, ep=2))
     with attention_context(mesh=mesh), jax.set_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="pipeline-parallel"):
-            mixtral_apply(c, params, ids, labels=ids)
+        out_p = jax.jit(lambda p: mixtral_apply(c, p, ids, labels=ids))(params)
+        lm_p, aux_p = float(out_p["lm_loss"]), float(out_p["aux_loss"])
+        grads = jax.jit(
+            jax.grad(lambda p: mixtral_apply(c, p, ids, labels=ids)["loss"])
+        )(params)
+        finite = all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads))
+    assert abs(lm_p - float(out_d["lm_loss"])) < 1e-4
+    assert abs(aux_p - float(out_d["aux_loss"])) < 0.1
+    assert finite
+
+
+def test_bert_pipeline_matches_dense():
+    from accelerate_tpu.models.bert import BertConfig, bert_apply, init_bert_params
+
+    c = BertConfig.tiny(layers=4, hidden_size=32, heads=2)
+    params = init_bert_params(jax.random.PRNGKey(0), c)
+    ids = _batch(b=8, s=32, vocab=512)
+    labels = jnp.asarray(np.arange(8) % c.num_labels, jnp.int32)
+
+    def loss_fn(p):
+        return bert_apply(c, p, ids, labels=labels)["loss"]
+
+    loss_d, grads_d = jax.value_and_grad(loss_fn)(params)
+    mesh = build_mesh(MeshPlugin(dp=1, pp=2, fsdp=2, tp=2))
+    with attention_context(mesh=mesh), jax.set_mesh(mesh):
+        loss_p, grads_p = jax.jit(jax.value_and_grad(loss_fn))(params)
+        loss_p = float(loss_p)
+    assert abs(loss_p - float(loss_d)) < 1e-4
+    max_err = jax.tree.reduce(
+        max, jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), grads_d, grads_p)
+    )
+    assert max_err < 1e-4
 
 
 def test_gpt2_pipeline_loss_and_grads_match_dense():
