@@ -25,7 +25,8 @@ from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import rms_norm
-from .llama import _constrain, remat_wrap
+from ..parallel.pipeline import remat_wrap
+from .llama import _constrain
 
 
 @dataclass

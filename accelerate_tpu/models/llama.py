@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
+from ..parallel.pipeline import remat_wrap
 from ..ops.fp8 import dense
 from ..ops.layers import (
     apply_rope,
@@ -163,9 +164,6 @@ def llama_layer_apply(
     if return_kv:
         return x, (k, v)
     return x
-
-
-from ..parallel.pipeline import remat_wrap  # noqa: E402 — shared by all model families
 
 
 def _block(config: LlamaConfig, cos, sin, positions, attention_mask):

@@ -33,7 +33,8 @@ from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import apply_rope, cross_entropy_loss, rms_norm, rope_frequencies
-from .llama import _constrain, remat_wrap
+from ..parallel.pipeline import remat_wrap
+from .llama import _constrain
 
 
 @dataclass

@@ -35,7 +35,8 @@ from jax.sharding import PartitionSpec as P
 from ..modules import Model, ModelOutput
 from ..ops.fp8 import dense
 from ..ops.layers import cross_entropy_loss, rms_norm
-from .llama import _constrain, remat_wrap
+from ..parallel.pipeline import remat_wrap
+from .llama import _constrain
 
 
 @dataclass
@@ -54,6 +55,8 @@ class T5Config:
     tie_word_embeddings: bool = True
     decoder_start_token_id: int = 0
     remat: bool | str = False  # False | True | jax.checkpoint_policies name
+    #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
+    pipeline_microbatches: int = 0
 
     @classmethod
     def t5_small(cls):
@@ -288,11 +291,27 @@ def t5_encode(c, params, input_ids, attention_mask):
         c.relative_attention_num_buckets, c.relative_attention_max_distance,
     )
 
-    def body(x, layer):
-        return t5_encoder_layer_apply(c, layer, x, bias, attention_mask), None
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
-    body_fn = remat_wrap(body, c.remat)
-    x, _ = jax.lax.scan(body_fn, x, params["encoder"]["layers"])
+    pp_mesh = active_pipeline_mesh()
+    if pp_mesh is not None:
+        x = pipeline_layer_stack(
+            lambda layer, h, pos_mb, mask_mb, bias_b: t5_encoder_layer_apply(
+                c, layer, h, bias_b, mask_mb
+            ),
+            params["encoder"]["layers"], x,
+            mesh=pp_mesh,
+            remat=c.remat,
+            mask=attention_mask,
+            rope=(bias,),
+            num_microbatches=c.pipeline_microbatches,
+        )
+    else:
+        def body(x, layer):
+            return t5_encoder_layer_apply(c, layer, x, bias, attention_mask), None
+
+        body_fn = remat_wrap(body, c.remat)
+        x, _ = jax.lax.scan(body_fn, x, params["encoder"]["layers"])
     return rms_norm(x, params["encoder"]["final_norm"], c.layer_norm_epsilon)
 
 
@@ -308,14 +327,41 @@ def t5_decode(c, params, decoder_input_ids, decoder_attention_mask, enc_out, enc
         s,
     )
 
-    def body(x, layer):
-        return (
-            t5_decoder_layer_apply(c, layer, x, bias, decoder_attention_mask, enc_out, enc_mask),
-            None,
-        )
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
-    body_fn = remat_wrap(body, c.remat)
-    x, _ = jax.lax.scan(body_fn, x, params["decoder"]["layers"])
+    pp_mesh = active_pipeline_mesh()
+    if pp_mesh is not None:
+        # enc_out (and its mask) are batch-aligned: each microbatch's rows
+        # cross-attend their own encoder output slice
+        has_enc_mask = enc_mask is not None
+
+        def dec_layer_fn(layer, h, pos_mb, mask_mb, *ops):
+            enc_out_mb = ops[0]
+            enc_mask_mb = ops[1] if has_enc_mask else None
+            bias_b = ops[-1]
+            return t5_decoder_layer_apply(
+                c, layer, h, bias_b, mask_mb, enc_out_mb, enc_mask_mb
+            )
+
+        x = pipeline_layer_stack(
+            dec_layer_fn,
+            params["decoder"]["layers"], x,
+            mesh=pp_mesh,
+            remat=c.remat,
+            mask=decoder_attention_mask,
+            extra_aligned=(enc_out,) + ((enc_mask,) if has_enc_mask else ()),
+            rope=(bias,),
+            num_microbatches=c.pipeline_microbatches,
+        )
+    else:
+        def body(x, layer):
+            return (
+                t5_decoder_layer_apply(c, layer, x, bias, decoder_attention_mask, enc_out, enc_mask),
+                None,
+            )
+
+        body_fn = remat_wrap(body, c.remat)
+        x, _ = jax.lax.scan(body_fn, x, params["decoder"]["layers"])
     return rms_norm(x, params["decoder"]["final_norm"], c.layer_norm_epsilon)
 
 
@@ -331,9 +377,6 @@ def t5_apply(
     """Seq2seq forward. If ``labels`` is given without ``decoder_input_ids``
     the decoder inputs are the shifted-right labels (HF contract), and the
     loss is UNshifted CE — decoder position t predicts label t."""
-    from ..parallel.pipeline import ensure_no_pipeline_axis
-
-    ensure_no_pipeline_axis("t5")
     c = config
     if decoder_input_ids is None:
         if labels is None:

@@ -100,8 +100,8 @@ def ensure_no_pipeline_axis(model_name: str) -> None:
     if active_pipeline_mesh() is not None:
         raise NotImplementedError(
             f"pipeline-parallel execution is not implemented for "
-            f"{model_name}; use a mesh with pp=1 (llama/gpt2/bert/mixtral "
-            f"implement the GPipe path)"
+            f"{model_name}; use a mesh with pp=1 (every built-in family "
+            f"implements the GPipe path via parallel.pipeline_layer_stack)"
         )
 
 
@@ -140,6 +140,7 @@ def pipeline_layer_stack(
     remat=False,
     positions: jax.Array | None = None,
     mask: jax.Array | None = None,
+    extra_aligned: tuple = (),
     rope: tuple = (),
     num_microbatches: int = 0,
     with_aux: bool = False,
@@ -147,26 +148,29 @@ def pipeline_layer_stack(
     """Run a transformer layer stack as a GPipe pipeline — the one owner of
     the operand convention every model family shares.
 
-    ``layer_fn(layer, x_mb, positions_mb, mask_mb, *rope) -> y_mb`` (or
-    ``(y_mb, aux_scalar)`` with ``with_aux``) applies ONE unstacked layer.
-    ``positions``/``mask`` are per-example ``[batch, ...]`` operands that
-    ride the microbatch schedule (either may be None); ``rope`` tables are
-    broadcast to every stage call. The scan over each stage's local layers
-    (with ``remat`` applied per block) is built here so models don't
-    duplicate the aligned/broadcast packing or the aux carry.
+    ``layer_fn(layer, x_mb, positions_mb, mask_mb, *extra_mb, *rope) ->
+    y_mb`` (or ``(y_mb, aux_scalar)`` with ``with_aux``) applies ONE
+    unstacked layer. ``positions``/``mask`` are per-example ``[batch, ...]``
+    operands that ride the microbatch schedule (either may be None), as do
+    ``extra_aligned`` operands (e.g. t5's encoder output for
+    cross-attention); ``rope`` tables are broadcast to every stage call.
+    The scan over each stage's local layers (with ``remat`` applied per
+    block) is built here so models don't duplicate the aligned/broadcast
+    packing or the aux carry.
     """
-    aligned = tuple(a for a in (positions, mask) if a is not None)
+    aligned = tuple(a for a in (positions, mask) if a is not None) + tuple(extra_aligned)
     has_pos = positions is not None
     has_mask = mask is not None
 
     def stage_fn(local_layers, x_mb, *ops):
         pos_mb = ops[0] if has_pos else None
         mask_mb = ops[int(has_pos)] if has_mask else None
+        extra_mb = ops[int(has_pos) + int(has_mask) : len(aligned)]
         rope_ops = ops[len(aligned):]
         if with_aux:
             def body(carry, layer):
                 h, aux_sum = carry
-                h, aux = layer_fn(layer, h, pos_mb, mask_mb, *rope_ops)
+                h, aux = layer_fn(layer, h, pos_mb, mask_mb, *extra_mb, *rope_ops)
                 return (h, aux_sum + aux), None
 
             (y, aux), _ = jax.lax.scan(
@@ -177,7 +181,7 @@ def pipeline_layer_stack(
             return y, aux
 
         def body(h, layer):
-            return layer_fn(layer, h, pos_mb, mask_mb, *rope_ops), None
+            return layer_fn(layer, h, pos_mb, mask_mb, *extra_mb, *rope_ops), None
 
         y, _ = jax.lax.scan(remat_wrap(body, remat), x_mb, local_layers)
         return y
@@ -256,12 +260,26 @@ def gpipe(
     # shard_map boundary (and the inter-stage ppermute traffic) f32 on the
     # CPU backend; stage compute still runs in the original dtype. On TPU
     # the pass doesn't run and bf16 rides the ICI links natively.
-    cpu_widen = (
-        jax.devices()[0].platform == "cpu" and x.dtype in (jnp.bfloat16, jnp.float16)
+    _narrow = (jnp.bfloat16, jnp.float16)
+    cpu_widen = jax.devices()[0].platform == "cpu" and (
+        x.dtype in _narrow
+        or any(a.dtype in _narrow for a in aligned)
+        or any(b.dtype in _narrow for b in broadcast)
     )
     compute_dtype = x.dtype
+    # original dtypes of the other operands — differentiable bf16 operands
+    # (t5's rel-bias tables, encoder output) must also cross the boundary
+    # in f32 or their cotangent psums hit the same XLA:CPU crash
+    aligned_dtypes = tuple(a.dtype for a in aligned)
+    broadcast_dtypes = tuple(b.dtype for b in broadcast)
+
+    def _widen(v):
+        return v.astype(jnp.float32) if v.dtype in _narrow else v
+
     if cpu_widen:
         x = x.astype(jnp.float32)
+        aligned = tuple(_widen(a) for a in aligned)
+        broadcast = tuple(_widen(b) for b in broadcast)
 
     x_mb = x.reshape(m, mb, *x.shape[1:])
     aligned_mb = tuple(a.reshape(m, mb, *a.shape[1:]) for a in aligned)
@@ -288,8 +306,20 @@ def gpipe(
                 jax.lax.dynamic_index_in_dim(a, mb_idx, axis=0, keepdims=False)
                 for a in aligned_ops
             )
-            state_arg = state_in.astype(compute_dtype) if cpu_widen else state_in
-            res = stage_fn(local_params, state_arg, *aligned_t, *broadcast_ops)
+            if cpu_widen:
+                # stage compute still runs at the original dtypes; only the
+                # boundary crossing (and its transpose psums) is f32
+                state_arg = state_in.astype(compute_dtype)
+                aligned_t = tuple(
+                    a.astype(d) for a, d in zip(aligned_t, aligned_dtypes)
+                )
+                broadcast_args = tuple(
+                    b.astype(d) for b, d in zip(broadcast_ops, broadcast_dtypes)
+                )
+            else:
+                state_arg = state_in
+                broadcast_args = broadcast_ops
+            res = stage_fn(local_params, state_arg, *aligned_t, *broadcast_args)
             if with_aux:
                 y, aux = res
                 aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)

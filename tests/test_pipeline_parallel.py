@@ -76,19 +76,69 @@ def test_accelerator_rejects_pp_with_cp_at_construction():
         Accelerator(mesh_plugin=MeshPlugin(dp=2, pp=2, cp=2))
 
 
-def test_unpipelined_models_reject_pp_axis():
-    """Models without a GPipe path (t5: dual encoder/decoder stacks) must
-    refuse a pp>1 mesh instead of silently training un-pipelined with
-    stage-split weights."""
+def test_ensure_no_pipeline_axis_guard():
+    """The guard user models without a GPipe path call: refuses a pp>1
+    mesh instead of silently training un-pipelined with stage-split
+    weights (every built-in family now implements the path)."""
+    from accelerate_tpu.parallel.pipeline import ensure_no_pipeline_axis
+
+    ensure_no_pipeline_axis("custom")  # no mesh context: fine
+    mesh = build_mesh(MeshPlugin(dp=4, pp=2))
+    with attention_context(mesh=mesh):
+        with pytest.raises(NotImplementedError, match="pipeline-parallel"):
+            ensure_no_pipeline_axis("custom")
+
+
+def test_t5_pipeline_bf16_operands_survive_cpu_backend():
+    """bf16 params make the rel-bias tables and encoder output bf16; their
+    boundary crossings must be widened on XLA:CPU or the backward-transpose
+    psums abort the process (AllReducePromotion copy-opcode check failure)."""
     from accelerate_tpu.models.t5 import T5Config, init_t5_params, t5_apply
 
-    c = T5Config.tiny(layers=2, hidden_size=32, heads=2)
-    params = init_t5_params(jax.random.PRNGKey(0), c)
-    ids = _batch(b=8, s=32)
-    mesh = build_mesh(MeshPlugin(dp=4, pp=2))
+    c = T5Config.tiny(layers=4, hidden_size=32, heads=2)
+    params = init_t5_params(jax.random.PRNGKey(0), c, dtype=jnp.bfloat16)
+    enc = _batch(b=8, s=24)
+    dec = _batch(b=8, s=12, seed=1)
+
+    def loss_fn(p):
+        return t5_apply(c, p, enc, labels=dec)["loss"].astype(jnp.float32)
+
+    mesh = build_mesh(MeshPlugin(dp=1, pp=4, fsdp=2))
     with attention_context(mesh=mesh), jax.set_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="pipeline-parallel"):
-            t5_apply(c, params, ids, labels=_batch(b=8, s=16, seed=1))
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+        loss = float(loss)
+    assert np.isfinite(loss)
+    assert all(
+        bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+        for g in jax.tree.leaves(grads)
+    )
+
+
+def test_t5_pipeline_matches_dense():
+    """Both t5 stacks pipeline (decoder cross-attends its microbatch's
+    slice of the encoder output via the extra_aligned operand); a padded
+    encoder mask must survive the schedule."""
+    from accelerate_tpu.models.t5 import T5Config, init_t5_params, t5_apply
+
+    c = T5Config.tiny(layers=4, hidden_size=32, heads=2)
+    params = init_t5_params(jax.random.PRNGKey(0), c)
+    enc = _batch(b=8, s=24)
+    dec = _batch(b=8, s=12, seed=1)
+    mask = jnp.asarray(np.tile([1] * 16 + [0] * 8, (8, 1)), jnp.int32)
+
+    def loss_fn(p):
+        return t5_apply(c, p, enc, attention_mask=mask, labels=dec)["loss"]
+
+    loss_d, grads_d = jax.value_and_grad(loss_fn)(params)
+    mesh = build_mesh(MeshPlugin(dp=1, pp=4, fsdp=2))
+    with attention_context(mesh=mesh), jax.set_mesh(mesh):
+        loss_p, grads_p = jax.jit(jax.value_and_grad(loss_fn))(params)
+        loss_p = float(loss_p)
+    assert abs(loss_p - float(loss_d)) < 1e-4
+    max_err = jax.tree.reduce(
+        max, jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), grads_d, grads_p)
+    )
+    assert max_err < 1e-4
 
 
 def test_mixtral_pipeline_matches_dense_lm_loss():
