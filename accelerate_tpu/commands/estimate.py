@@ -84,11 +84,9 @@ def _param_count(shapes: dict[str, tuple]) -> tuple[int, int]:
 
 
 def _human(n_bytes: float) -> str:
-    for unit in ("B", "KB", "MB", "GB", "TB"):
-        if abs(n_bytes) < 1024:
-            return f"{n_bytes:.2f} {unit}"
-        n_bytes /= 1024
-    return f"{n_bytes:.2f} PB"
+    from ..utils import convert_bytes
+
+    return convert_bytes(n_bytes)
 
 
 def estimate_command(args) -> int:

@@ -1,0 +1,24 @@
+"""Main-process-only progress bars (reference ``utils/tqdm.py``)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """``tqdm.auto.tqdm`` that renders only on process 0 by default, so a
+    multi-host launch doesn't print N interleaved bars (reference
+    ``utils/tqdm.py``)."""
+    if not is_tqdm_available():
+        raise ImportError(
+            "accelerate_tpu.utils.tqdm requires the tqdm package: pip install tqdm"
+        )
+    from tqdm import auto
+
+    if main_process_only:
+        from ..state import PartialState
+
+        kwargs["disable"] = kwargs.get("disable", False) or (
+            PartialState().process_index != 0
+        )
+    return auto.tqdm(*args, **kwargs)
