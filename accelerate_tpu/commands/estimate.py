@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 
+from ..utils.other import convert_bytes
+
 _DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
 
 
@@ -83,10 +85,7 @@ def _param_count(shapes: dict[str, tuple]) -> tuple[int, int]:
     return total, largest
 
 
-def _human(n_bytes: float) -> str:
-    from ..utils import convert_bytes
-
-    return convert_bytes(n_bytes)
+_human = convert_bytes
 
 
 def estimate_command(args) -> int:

@@ -46,11 +46,5 @@ from .imports import (
 )
 from .random import set_seed, synchronize_rng_states
 
-
-def convert_bytes(size: float) -> str:
-    """Human-readable byte size (reference ``utils/other.py:306``)."""
-    for unit in ("bytes", "KB", "MB", "GB", "TB"):
-        if abs(size) < 1024.0:
-            return f"{round(size, 2)} {unit}"
-        size /= 1024.0
-    return f"{round(size, 2)} PB"
+from .other import convert_bytes
+from .tqdm import tqdm

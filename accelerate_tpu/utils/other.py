@@ -1,0 +1,12 @@
+"""Small shared utilities (reference ``utils/other.py``)."""
+
+from __future__ import annotations
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte size (reference ``utils/other.py:306``)."""
+    for unit in ("bytes", "KB", "MB", "GB", "TB"):
+        if abs(size) < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"
