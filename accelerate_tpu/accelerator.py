@@ -494,6 +494,39 @@ class Accelerator:
         if device_placement is None:
             device_placement = [None] * len(args)
 
+        # ds-config-driven placeholders → real optax objects (reference
+        # utils/deepspeed.py:229-290; engine-built at accelerator.py:1651+)
+        from .utils.deepspeed import (
+            DummyOptim,
+            DummyScheduler,
+            optimizer_from_ds_config,
+            scheduler_from_ds_config,
+        )
+
+        ds_cfg = getattr(self.deepspeed_plugin, "deepspeed_config", None)
+        if any(isinstance(a, (DummyOptim, DummyScheduler)) for a in args):
+            if self.deepspeed_plugin is None:
+                raise ValueError(
+                    "DummyOptim/DummyScheduler require a DeepSpeedPlugin "
+                    "(usually with a config file defining the "
+                    "optimizer/scheduler sections)"
+                )
+            # resolve the optimizer lr first: an "auto" warmup_max_lr in the
+            # scheduler section fills from it (reference semantics)
+            opt_lr = None
+            for a in args:
+                if isinstance(a, DummyOptim):
+                    opt_params = dict((ds_cfg or {}).get("optimizer", {}).get("params", {}))
+                    raw_lr = opt_params.get("lr")
+                    opt_lr = a.lr if raw_lr in (None, "auto") else float(raw_lr)
+            args = tuple(
+                optimizer_from_ds_config(ds_cfg, a) if isinstance(a, DummyOptim)
+                else scheduler_from_ds_config(ds_cfg, a, optimizer_lr=opt_lr)
+                if isinstance(a, DummyScheduler)
+                else a
+                for a in args
+            )
+
         # pass 1: everything except schedulers (they need bound optimizers)
         prepared = []
         for obj, dp in zip(args, device_placement):

@@ -46,5 +46,6 @@ from .imports import (
 )
 from .random import set_seed, synchronize_rng_states
 
+from .deepspeed import DummyOptim, DummyScheduler
 from .other import convert_bytes
 from .tqdm import tqdm

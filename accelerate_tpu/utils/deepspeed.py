@@ -1,0 +1,138 @@
+"""DeepSpeed config-file optimizer/scheduler contract.
+
+Reference users whose training is driven by a ds-config JSON pass
+``DummyOptim``/``DummyScheduler`` placeholders to ``prepare()`` and the
+engine builds the real ones from the config (reference
+``utils/deepspeed.py:229-290``, consumed at ``accelerator.py:1651-1891``).
+Here the same placeholders lower to optax: the config's ``optimizer``
+section becomes an ``optax.inject_hyperparams`` transformation and the
+``scheduler`` section an optax schedule fn, with ``"auto"`` values filled
+from the placeholder's arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DummyOptim:
+    """Placeholder for a config-file-defined optimizer (reference
+    ``utils/deepspeed.py:229``). ``lr``/``weight_decay`` fill the config's
+    ``"auto"`` values; ``params`` is accepted for signature parity and
+    ignored (params come from the prepared model)."""
+
+    def __init__(self, params=None, lr: float = 1e-3, weight_decay: float = 0.0, **kwargs):
+        self.params = params
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.kwargs = kwargs
+
+
+class DummyScheduler:
+    """Placeholder for a config-file-defined LR scheduler (reference
+    ``utils/deepspeed.py:262``)."""
+
+    def __init__(
+        self,
+        optimizer: Any = None,
+        total_num_steps: int | None = None,
+        warmup_num_steps: int = 0,
+        lr_scheduler_callable=None,
+        **kwargs,
+    ):
+        self.optimizer = optimizer
+        self.total_num_steps = total_num_steps
+        self.warmup_num_steps = warmup_num_steps
+        self.lr_scheduler_callable = lr_scheduler_callable
+        self.kwargs = kwargs
+
+
+def _resolved(value, fallback):
+    return fallback if value in (None, "auto") else value
+
+
+def optimizer_from_ds_config(ds_config: dict, dummy: DummyOptim):
+    """Build the optax transformation the config's ``optimizer`` section
+    describes (reference builds a real DS optimizer; same ``"auto"``
+    semantics)."""
+    import optax
+
+    section = (ds_config or {}).get("optimizer", {})
+    params = dict(section.get("params", {}))
+    lr = float(_resolved(params.get("lr"), dummy.lr))
+    weight_decay = float(_resolved(params.get("weight_decay"), dummy.weight_decay))
+    betas = params.get("betas", (0.9, 0.999))
+    eps = float(_resolved(params.get("eps"), 1e-8))
+    otype = str(section.get("type", "AdamW")).lower()
+    if otype in ("adamw", "adam"):
+        factory = optax.inject_hyperparams(optax.adamw)
+        return factory(
+            learning_rate=lr, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+            weight_decay=weight_decay if otype == "adamw" else 0.0,
+        )
+    if otype == "sgd":
+        momentum = float(_resolved(params.get("momentum"), 0.0))
+        factory = optax.inject_hyperparams(optax.sgd)
+        return factory(learning_rate=lr, momentum=momentum or None)
+    raise ValueError(
+        f"unsupported ds-config optimizer type {section.get('type')!r}: "
+        "expected AdamW, Adam, or SGD"
+    )
+
+
+def scheduler_from_ds_config(
+    ds_config: dict, dummy: DummyScheduler, optimizer_lr: float | None = None
+):
+    """Build the optax schedule fn the config's ``scheduler`` section
+    describes. WarmupLR = linear min→max over warmup; WarmupDecayLR adds a
+    linear decay to 0 over ``total_num_steps``. An ``"auto"``/missing
+    ``warmup_max_lr`` resolves to the OPTIMIZER's resolved lr (the
+    reference fills it the same way), never a hardcoded constant.
+    ``lr_scheduler_callable`` wins if the user supplied one (reference
+    ``DummyScheduler`` field)."""
+    import optax
+
+    if dummy.lr_scheduler_callable is not None:
+        fn = dummy.lr_scheduler_callable
+
+        def schedule(step):  # plain fn with a step-like param so prepare()
+            return fn(step)  # recognises it as a scheduler
+
+        return schedule
+
+    section = (ds_config or {}).get("scheduler", {})
+    params = dict(section.get("params", {}))
+    max_lr = float(_resolved(params.get("warmup_max_lr"), optimizer_lr or 1e-3))
+    min_lr = float(_resolved(params.get("warmup_min_lr"), 0.0))
+    warmup = int(_resolved(params.get("warmup_num_steps"), dummy.warmup_num_steps or 0))
+    total = int(
+        _resolved(params.get("total_num_steps"), dummy.total_num_steps or 0)
+    )
+    if not section:
+        # no scheduler section: honour the placeholder's own fields —
+        # decay over total_num_steps when given, else hold the optimizer lr
+        if total > 0:
+            section_type = "warmupdecaylr"
+        else:
+            return lambda step: max_lr
+    else:
+        section_type = str(section.get("type", "WarmupLR")).lower()
+    if section_type == "warmuplr":
+        return optax.linear_schedule(min_lr, max_lr, max(warmup, 1))
+    if section_type == "warmupdecaylr":
+        if total <= 0:
+            raise ValueError(
+                "WarmupDecayLR needs total_num_steps (in the ds-config or on "
+                "DummyScheduler(total_num_steps=...))"
+            )
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(min_lr, max_lr, max(warmup, 1)),
+                optax.linear_schedule(max_lr, 0.0, max(total - warmup, 1)),
+            ],
+            boundaries=[warmup],
+        )
+    raise ValueError(
+        f"unsupported ds-config scheduler type {section.get('type')!r}: "
+        "expected WarmupLR or WarmupDecayLR"
+    )

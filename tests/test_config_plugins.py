@@ -257,3 +257,69 @@ def test_megatron_ducktyped_plugin_lowers():
     acc = Accelerator(megatron_lm_plugin=ForeignMegatronPlugin())
     shape = dict(acc.mesh.shape)
     assert shape["tp"] == 2 and shape["pp"] == 2
+
+
+def test_dummy_optim_and_scheduler_from_ds_config(tmp_path):
+    """Reference contract: a ds-config file owns optimizer/scheduler; the
+    user passes DummyOptim/DummyScheduler to prepare() and gets real ones
+    built from the config with "auto" values filled
+    (reference utils/deepspeed.py:229-290)."""
+    import json as _json
+
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import DummyOptim, DummyScheduler
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "AdamW", "params": {"lr": "auto", "weight_decay": 0.01}},
+        "scheduler": {
+            "type": "WarmupDecayLR",
+            "params": {
+                "warmup_min_lr": 0.0, "warmup_max_lr": "auto",
+                "warmup_num_steps": 4, "total_num_steps": 16,
+            },
+        },
+    }
+    path = tmp_path / "ds.json"
+    path.write_text(_json.dumps(cfg))
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=str(path)))
+    model = RegressionModel()
+    optimizer = DummyOptim(lr=0.05)
+    scheduler = DummyScheduler(total_num_steps=16)
+    model, opt, sched = acc.prepare(model, optimizer, scheduler)
+
+    x = np.random.default_rng(0).normal(size=(16, 1)).astype("float32")
+    y = 2.0 * x + 1.0
+    losses = []
+    for _ in range(8):
+        out = model(x=x)
+        loss = ((out.prediction - y) ** 2).mean()
+        acc.backward(loss)
+        opt.step()
+        sched.step()
+        opt.zero_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # the schedule is live AND "auto" warmup_max_lr filled from the
+    # optimizer lr: after 8 steps of WarmupDecayLR(warmup=4, total=16,
+    # max=0.05) the lr is 0.05 * (1 - 4/12)
+    lr = float(opt.param_groups[0]["learning_rate"])
+    assert abs(lr - 0.05 * (1 - 4 / 12)) < 1e-6
+
+
+def test_dummy_optim_without_ds_plugin_raises():
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import DummyOptim
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator()
+    with pytest.raises(ValueError, match="DummyOptim"):
+        acc.prepare(RegressionModel(), DummyOptim())
