@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
-from ..ops.layers import cross_entropy_loss
+from ..ops.layers import cached_attention, cross_entropy_loss
 from ..parallel.pipeline import remat_wrap
 from .llama import _constrain
 
@@ -109,9 +109,10 @@ def init_gpt2_params(key: jax.Array, config: GPT2Config, dtype=jnp.float32):
     }
 
 
-def gpt2_layer_apply(config: GPT2Config, layer, x, attention_mask):
+def gpt2_layer_apply(config: GPT2Config, layer, x, attention_mask, return_kv: bool = False):
     """One pre-LN block on UNstacked layer params (shared by the scan body
-    and the streaming executor)."""
+    and the streaming executor). ``return_kv`` additionally returns this
+    block's (K, V) so prefill caches reuse them."""
     c = config
     nh, hd = c.num_attention_heads, c.head_dim
     b, s, h = x.shape
@@ -125,7 +126,10 @@ def gpt2_layer_apply(config: GPT2Config, layer, x, attention_mask):
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
     y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
     x = x + dense(jax.nn.gelu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]) + layer["b_out"]
-    return _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    if return_kv:
+        return x, (k, v)
+    return x
 
 
 def gpt2_apply(
@@ -135,6 +139,10 @@ def gpt2_apply(
     attention_mask: jax.Array | None = None,
     labels: jax.Array | None = None,
     positions: jax.Array | None = None,
+    use_cache: bool = False,
+    kv_cache=None,  # {"k","v"}: [L, b, max_cache, nh, hd] (decode step)
+    cache_index: jax.Array | None = None,  # [b] per-row write position
+    max_cache_len: int | None = None,
 ):
     c = config
     b, s = input_ids.shape
@@ -144,16 +152,45 @@ def gpt2_apply(
             f"{c.max_position_embeddings}: the position-embedding lookup "
             "would silently clamp, producing wrong logits"
         )
+    from ..parallel.pipeline import active_pipeline_mesh as _apm
+
+    if (use_cache or kv_cache is not None) and _apm() is not None:
+        raise NotImplementedError(
+            "KV-cache generation (use_cache/kv_cache) is not implemented "
+            "over a pp>1 mesh; run generation on a mesh with pp=1"
+        )
+    if kv_cache is not None:
+        return _gpt2_decode_step(c, params, input_ids, kv_cache, cache_index)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
     x = params["wte"][input_ids] + params["wpe"][positions]
     x = _constrain(x, P(("dp", "fsdp"), "cp", None))
 
+    caches = None
+    if use_cache:
+        max_cache = int(max_cache_len or c.max_position_embeddings)
+        if not (s <= max_cache <= c.max_position_embeddings):
+            raise ValueError(
+                f"max_cache_len {max_cache} must be in [{s} (prompt length), "
+                f"{c.max_position_embeddings} (max_position_embeddings)]"
+            )
+
+        def cache_body(x, layer):
+            pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+            out, (k, v) = gpt2_layer_apply(
+                c, layer, x, attention_mask, return_kv=True
+            )
+            return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        x, caches = jax.lax.scan(cache_body, x, params["layers"])
+
     from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
     pp_mesh = active_pipeline_mesh()
-    if pp_mesh is not None:
+    if caches is not None:
+        pass  # stack already applied by the cache-collecting scan
+    elif pp_mesh is not None:
         # GPipe over the pp axis: positions are already folded into x at
         # the embedding, so only the mask rides the microbatch schedule
         x = pipeline_layer_stack(
@@ -176,9 +213,45 @@ def gpt2_apply(
     logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
 
     out = ModelOutput(logits=logits)
+    if caches is not None:
+        out["kv_cache"] = {"k": caches[0], "v": caches[1]}
     if labels is not None:
         out["loss"] = cross_entropy_loss(logits[:, :-1, :], labels[:, 1:])
     return out
+
+
+def _gpt2_decode_step(c, params, input_ids, kv_cache, cache_index):
+    """One cached decode step: s == 1 token per row appended at
+    ``cache_index[b]``; attention is q(1) vs the cache prefix (mirrors
+    ``_llama_decode_step`` with learned positions and fused QKV)."""
+    b, s = input_ids.shape
+    nh, hd = c.num_attention_heads, c.head_dim
+    rows = jnp.arange(b)
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
+
+    x = params["wte"][input_ids] + params["wpe"][idx[:, None]]
+
+    def body(x, xs):
+        layer, k_cache_l, v_cache_l = xs
+        y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+        qkv = dense(y, layer["w_qkv"]) + layer["b_qkv"]
+        q, k, v = (z.reshape(b, s, nh, hd) for z in jnp.split(qkv, 3, axis=-1))
+        k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
+        v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
+        attn = cached_attention(q, k_cache_l, v_cache_l, idx)
+        x = x + dense(attn.reshape(b, s, nh * hd), layer["w_proj"]) + layer["b_proj"]
+        y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+        x = x + dense(
+            jax.nn.gelu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
+        ) + layer["b_out"]
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)
+    logits = dense(x, params["wte"].T)
+    return ModelOutput(logits=logits, kv_cache={"k": k_cache, "v": v_cache})
 
 
 _LAYER_KEYS = (
@@ -294,6 +367,7 @@ class GPT2LMHeadModel:
             name="GPT2LMHeadModel",
         )
         model.config = config
+        model.supports_kv_cache = True
         model.stacked_params_prefix = "layers"
         model.segments = gpt2_segments(config)
         model.tied_parameters = []

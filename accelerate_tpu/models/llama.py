@@ -31,6 +31,7 @@ from ..parallel.pipeline import remat_wrap
 from ..ops.fp8 import dense
 from ..ops.layers import (
     apply_rope,
+    cached_attention,
     cross_entropy_loss,
     rms_norm,
     rope_frequencies,
@@ -262,11 +263,11 @@ def llama_apply(
 
     if use_cache:
         max_cache = int(max_cache_len or c.max_position_embeddings)
-        if max_cache > c.max_position_embeddings:
+        if not (s <= max_cache <= c.max_position_embeddings):
             raise ValueError(
-                f"max_cache_len {max_cache} exceeds max_position_embeddings "
-                f"{c.max_position_embeddings}: RoPE tables would silently "
-                "clamp — raise max_position_embeddings on the config"
+                f"max_cache_len {max_cache} must be in [{s} (prompt length), "
+                f"{c.max_position_embeddings} (max_position_embeddings)] — "
+                "above it RoPE tables would silently clamp"
             )
 
         def body(x, layer):
@@ -309,7 +310,6 @@ def _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
     ``cache_index[b]``; attention is q(1) against the cache prefix."""
     b, s = input_ids.shape
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
-    max_cache = kv_cache["k"].shape[2]
     rows = jnp.arange(b)
     idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
     positions = idx[:, None]  # [b, 1]
@@ -324,17 +324,7 @@ def _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
         v = dense(y, layer["wv"]).reshape(b, s, nkv, hd)
         k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
         v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
-        # GQA repeat + mask to each row's valid prefix (≤ its own position)
-        rep = nh // nkv
-        kk = jnp.repeat(k_cache_l, rep, axis=2) if rep > 1 else k_cache_l
-        vv = jnp.repeat(v_cache_l, rep, axis=2) if rep > 1 else v_cache_l
-        valid = (jnp.arange(max_cache)[None, :] <= idx[:, None])  # [b, max]
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32)
-        ) / jnp.sqrt(float(hd))
-        scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32)).astype(x.dtype)
+        attn = cached_attention(q, k_cache_l, v_cache_l, idx)
         x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
         y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
         gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])

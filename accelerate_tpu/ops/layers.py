@@ -92,3 +92,30 @@ def cross_entropy_loss(
     gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * valid
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def cached_attention(q, k_cache, v_cache, idx):
+    """Single-token attention against a KV cache with per-row valid prefix.
+
+    q: ``[b, 1, nh, hd]`` (the token being decoded); caches
+    ``[b, max_cache, n_kv, hd]`` already containing this step's K/V at
+    ``idx[b]``; rows attend only positions ``<= idx[b]``. GQA handled by
+    repeating KV heads. f32 scores/softmax. Shared by every model family's
+    decode step (no per-model drift in the masking or dtype policy).
+    """
+    b, s, nh, hd = q.shape
+    n_kv = k_cache.shape[2]
+    if n_kv != nh:
+        rep = nh // n_kv
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    max_cache = k_cache.shape[1]
+    valid = jnp.arange(max_cache)[None, :] <= idx[:, None]  # [b, max]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) / np.sqrt(float(hd))
+    scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
+    ).astype(q.dtype)

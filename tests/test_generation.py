@@ -195,3 +195,25 @@ def test_cached_generation_respects_autocast_island():
     assert None in prepared._cached_generation_apply
     # both decode sane token streams (values may differ by precision)
     assert full_precision.shape == bf16.shape == (1, 8)
+
+
+def test_gpt2_cached_generation_matches_full_forward():
+    """GPT-2's KV-cache prefill/decode (learned positions, fused QKV) must
+    match O(n^2) re-forwards token-for-token, incl. ragged prompts."""
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny(layers=2, hidden_size=64, heads=4, seq=64)
+    model = GPT2LMHeadModel.from_config(cfg, seed=1)
+    assert model.supports_kv_cache
+    wrapped = _as_callable(model)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 256, size=(2, 6)).astype(np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=5)
+    cached = generate(model, ids, max_new_tokens=5, use_cache=True)
+    np.testing.assert_array_equal(cached, ref)
+
+    mask = np.asarray([[1] * 6, [1, 1, 1, 0, 0, 0]], np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=4, attention_mask=mask)
+    cached = generate(model, ids, max_new_tokens=4, attention_mask=mask, use_cache=True)
+    np.testing.assert_array_equal(cached[0], ref[0])
+    np.testing.assert_array_equal(cached[1, :7], ref[1, :7])
