@@ -134,7 +134,17 @@ def generate(
     declares ``supports_kv_cache``; other models silently use the
     full-forward path, which is equally correct — and for offload-streamed
     models equally fast, since weight movement dominates there anyway.
+
+    Encoder-decoder models (``model.is_encoder_decoder``, e.g. t5) decode
+    into growing ``decoder_input_ids`` against the fixed encoder prompt
+    (the reference gets this from transformers' seq2seq ``generate``);
+    the returned ids are the DECODER sequence including the start token.
     """
+    if _is_encoder_decoder(model):
+        return _generate_seq2seq(
+            model, input_ids, max_new_tokens, do_sample, temperature,
+            eos_token_id, seed, attention_mask,
+        )
     if use_cache:
         backend = _cache_backend(model)
         if backend is not None:
@@ -174,6 +184,107 @@ def generate(
         if eos_token_id is not None and finished.all():
             break
     return buf[:, : int(lengths.max())]
+
+
+def _is_encoder_decoder(model) -> bool:
+    """The flag lives on the raw :class:`Model`; prepared/dispatched
+    wrappers hold it at ``_model`` (same unwrapping ``_cache_backend``
+    does for ``supports_kv_cache``)."""
+    return bool(
+        getattr(model, "is_encoder_decoder", False)
+        or getattr(getattr(model, "_model", None), "is_encoder_decoder", False)
+    )
+
+
+def _generate_seq2seq(
+    model, input_ids, max_new_tokens, do_sample, temperature,
+    eos_token_id, seed, attention_mask,
+):
+    """Greedy/sampled seq2seq decoding: the encoder prompt is fixed, tokens
+    fill a fixed-size ``decoder_input_ids`` buffer (starting from the
+    config's ``decoder_start_token_id``). Decoder self-attention is causal
+    and cross-attention is per-position, so the not-yet-written buffer
+    tail cannot influence the position being read — one compiled shape
+    serves every step. For raw Models the encoder runs ONCE (its output is
+    re-fed via ``encoder_outputs``) and the per-step decoder forward is
+    jitted; wrapper models (prepared/dispatched) run their own
+    compiled/streamed full forward per step."""
+    config = getattr(model, "config", None) or getattr(
+        getattr(model, "_model", None), "config", None
+    )
+    start_id = int(getattr(config, "decoder_start_token_id", 0) or 0)
+    ids = np.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b = ids.shape[0]
+    mask = (
+        np.asarray(attention_mask, np.int32)
+        if attention_mask is not None
+        else np.ones_like(ids, np.int32)
+    )
+    total = 1 + max_new_tokens
+
+    apply = model.apply_fn if hasattr(model, "apply_fn") else None
+    params = getattr(model, "params", None)
+
+    enc_out = None
+    step_fn = None
+    if apply is not None and params is not None:
+        cache = getattr(apply, "_generation_jit_cache", None)
+        if cache is None:
+            cache = {}
+            try:
+                apply._generation_jit_cache = cache
+            except AttributeError:
+                pass
+        entry = cache.get(("seq2seq", total))
+        if entry is None:
+            encode = jax.jit(
+                lambda p, i, m: apply(
+                    p, input_ids=i, attention_mask=m,
+                    decoder_input_ids=jnp.zeros((i.shape[0], 1), jnp.int32),
+                )["encoder_last_hidden_state"]
+            )
+            decode = jax.jit(
+                lambda p, i, m, e, d: _logits_of(
+                    apply(
+                        p, input_ids=i, attention_mask=m, encoder_outputs=e,
+                        decoder_input_ids=d,
+                    )
+                )
+            )
+            entry = (encode, decode)
+            cache[("seq2seq", total)] = entry
+        encode, decode = entry
+        enc_out = encode(params, jnp.asarray(ids), jnp.asarray(mask))
+
+        def step_fn(dec):
+            return decode(params, jnp.asarray(ids), jnp.asarray(mask), enc_out, dec)
+
+    else:
+
+        def step_fn(dec):
+            return _logits_of(
+                model(
+                    input_ids=jnp.asarray(ids), attention_mask=jnp.asarray(mask),
+                    decoder_input_ids=dec,
+                )
+            )
+
+    dec = np.full((b, total), start_id, np.int32)
+    key = jax.random.PRNGKey(seed)
+    finished = np.zeros((b,), bool)
+    n_written = 0
+    for t in range(max_new_tokens):
+        logits = np.asarray(jax.device_get(step_fn(jnp.asarray(dec))))[:, t, :]
+        next_tok, key, finished = _pick_next(
+            logits, do_sample, temperature, key, finished, eos_token_id
+        )
+        dec[:, t + 1] = next_tok
+        n_written = t + 1
+        if eos_token_id is not None and finished.all():
+            break
+    return jnp.asarray(dec[:, : 1 + n_written])
 
 
 def _generate_cached(

@@ -152,9 +152,10 @@ def gpt2_apply(
             f"{c.max_position_embeddings}: the position-embedding lookup "
             "would silently clamp, producing wrong logits"
         )
-    from ..parallel.pipeline import active_pipeline_mesh as _apm
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
-    if (use_cache or kv_cache is not None) and _apm() is not None:
+    pp_mesh = active_pipeline_mesh()
+    if (use_cache or kv_cache is not None) and pp_mesh is not None:
         raise NotImplementedError(
             "KV-cache generation (use_cache/kv_cache) is not implemented "
             "over a pp>1 mesh; run generation on a mesh with pp=1"
@@ -184,12 +185,6 @@ def gpt2_apply(
             return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
         x, caches = jax.lax.scan(cache_body, x, params["layers"])
-
-    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
-
-    pp_mesh = active_pipeline_mesh()
-    if caches is not None:
-        pass  # stack already applied by the cache-collecting scan
     elif pp_mesh is not None:
         # GPipe over the pp axis: positions are already folded into x at
         # the embedding, so only the mask rides the microbatch schedule

@@ -373,17 +373,23 @@ def t5_apply(
     decoder_input_ids: jax.Array | None = None,  # [b, s_dec]
     decoder_attention_mask: jax.Array | None = None,
     labels: jax.Array | None = None,  # [b, s_dec]; -100 ignored
+    encoder_outputs: jax.Array | None = None,  # [b, s_enc, h] reuse (generation)
 ):
     """Seq2seq forward. If ``labels`` is given without ``decoder_input_ids``
     the decoder inputs are the shifted-right labels (HF contract), and the
-    loss is UNshifted CE — decoder position t predicts label t."""
+    loss is UNshifted CE — decoder position t predicts label t.
+    ``encoder_outputs`` skips the encoder (the HF kwarg generation uses so
+    the fixed prompt is encoded once)."""
     c = config
     if decoder_input_ids is None:
         if labels is None:
             raise ValueError("t5_apply needs decoder_input_ids or labels")
         decoder_input_ids = shift_right(labels, c.decoder_start_token_id)
 
-    enc_out = t5_encode(c, params, input_ids, attention_mask)
+    if encoder_outputs is not None:
+        enc_out = encoder_outputs
+    else:
+        enc_out = t5_encode(c, params, input_ids, attention_mask)
     x = t5_decode(
         c, params, decoder_input_ids, decoder_attention_mask, enc_out, attention_mask
     )
@@ -591,6 +597,7 @@ class T5ForConditionalGeneration:
             name="T5ForConditionalGeneration",
         )
         model.config = config
+        model.is_encoder_decoder = True
         model.stacked_params_prefix = ("encoder.layers", "decoder.layers")
         model.segments = t5_segments(config)
         # the tied v1.0 head reuses "shared" directly (never materialised),
