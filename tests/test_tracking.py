@@ -1,0 +1,113 @@
+"""Tracker zoo unit tests (reference ``tests/test_tracking.py``, 535 LoC —
+the examples cover the end-to-end flow; these pin the module contracts:
+the GeneralTracker ABC, filter_trackers resolution, availability gating,
+and the Accelerator facade round-trip)."""
+
+import json
+import os
+
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import (
+    LOGGER_TYPE_TO_CLASS,
+    GeneralTracker,
+    filter_trackers,
+)
+
+
+class JSONTracker(GeneralTracker):
+    """Custom tracker the reference docs model: log to a jsonl file."""
+
+    name = "json_test"
+    requires_logging_directory = False
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = path
+        self.config = None
+
+    @property
+    def tracker(self):
+        return self
+
+    def store_init_configuration(self, values):
+        self.config = dict(values)
+
+    def log(self, values, step=None, **kwargs):
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"step": step, **values}) + "\n")
+
+
+def test_zoo_has_all_seven_reference_trackers():
+    assert set(LOGGER_TYPE_TO_CLASS) == {
+        "tensorboard", "wandb", "mlflow", "comet_ml", "aim", "clearml", "dvclive",
+    }
+    for cls in LOGGER_TYPE_TO_CLASS.values():
+        assert issubclass(cls, GeneralTracker)
+        assert isinstance(cls.requires_logging_directory, bool)
+
+
+def test_filter_trackers_resolution_rules():
+    assert filter_trackers(None) == []
+    custom = JSONTracker("/dev/null")
+    # instances pass through; unknown names raise; unavailable names skip
+    assert filter_trackers(custom) == [custom]
+    with pytest.raises(ValueError, match="unknown tracker"):
+        filter_trackers("not_a_tracker")
+    # "all" keeps instances and only-available built-ins
+    resolved = filter_trackers(["all", custom], logging_dir="/tmp")
+    assert custom in resolved
+
+
+def test_logging_dir_requirement_enforced():
+    from accelerate_tpu.tracking import _AVAILABILITY
+
+    needs_dir = [
+        name for name, cls in LOGGER_TYPE_TO_CLASS.items()
+        if cls.requires_logging_directory and _AVAILABILITY[name]()
+    ]
+    for name in needs_dir:
+        with pytest.raises(ValueError, match="logging_dir"):
+            filter_trackers(name, logging_dir=None)
+
+
+def test_accelerator_tracker_facade_roundtrip(tmp_path):
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    path = tmp_path / "log.jsonl"
+    tracker = JSONTracker(str(path))
+    acc = Accelerator(log_with=tracker)
+    acc.init_trackers("proj", config={"lr": 0.1})
+    assert tracker.config == {"lr": 0.1}
+    acc.log({"loss": 1.5}, step=0)
+    acc.log({"loss": 0.5}, step=1)
+    acc.end_training()
+
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows == [{"step": 0, "loss": 1.5}, {"step": 1, "loss": 0.5}]
+    # get_tracker by name; unwrap returns the underlying client
+    got = acc.get_tracker("json_test")
+    assert got is tracker or getattr(got, "tracker", None) is tracker
+
+
+def test_tensorboard_tracker_writes_event_files(tmp_path):
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.tracking import _AVAILABILITY
+
+    if not _AVAILABILITY["tensorboard"]():
+        pytest.skip("tensorboard not installed")
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(log_with="tensorboard", project_dir=str(tmp_path))
+    acc.init_trackers("run1", config={"lr": 0.1})
+    acc.log({"loss": 1.0}, step=0)
+    acc.end_training()
+    written = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(tmp_path)
+        for f in files
+    ]
+    assert any("events" in os.path.basename(f) for f in written), written
