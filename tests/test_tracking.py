@@ -73,10 +73,6 @@ def test_logging_dir_requirement_enforced():
 
 
 def test_accelerator_tracker_facade_roundtrip(tmp_path):
-    from accelerate_tpu.state import AcceleratorState, GradientState
-
-    AcceleratorState._reset_state(reset_partial_state=True)
-    GradientState._reset_state()
     path = tmp_path / "log.jsonl"
     tracker = JSONTracker(str(path))
     acc = Accelerator(log_with=tracker)
@@ -94,13 +90,13 @@ def test_accelerator_tracker_facade_roundtrip(tmp_path):
 
 
 def test_tensorboard_tracker_writes_event_files(tmp_path):
-    from accelerate_tpu.state import AcceleratorState, GradientState
-    from accelerate_tpu.tracking import _AVAILABILITY
-
-    if not _AVAILABILITY["tensorboard"]():
-        pytest.skip("tensorboard not installed")
-    AcceleratorState._reset_state(reset_partial_state=True)
-    GradientState._reset_state()
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+    except ImportError:
+        try:
+            import tensorboardX  # noqa: F401
+        except ImportError:
+            pytest.skip("no SummaryWriter backend installed")
     acc = Accelerator(log_with="tensorboard", project_dir=str(tmp_path))
     acc.init_trackers("run1", config={"lr": 0.1})
     acc.log({"loss": 1.0}, step=0)
