@@ -1,0 +1,89 @@
+"""Feature: context parallelism — long sequences sharded over the ``cp``
+mesh axis. NO reference analog (SURVEY §5: the reference has no ring
+attention, Ulysses, or context parallelism anywhere); this is a capability
+this framework adds. The sequence dimension of every activation is split
+across ``cp`` devices; attention runs as a ring (KV blocks rotate over
+``ppermute`` on top of the flash kernel) or as Ulysses (all-to-all
+head↔sequence reshard), so the per-device activation memory for a
+``seq``-token document drops by the ``cp`` extent.
+
+Run on the CPU debug mesh:
+  accelerate-tpu launch --num_cpu_devices 8 \
+      examples/by_feature/context_parallel.py --cp 4 --mode ring
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu import Accelerator, ContextParallelPlugin, MeshPlugin
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.utils.random import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        mesh_plugin=MeshPlugin(dp=-1, cp=args.cp),
+        context_parallel_plugin=ContextParallelPlugin(cp_size=args.cp, mode=args.mode),
+    )
+    set_seed(7)
+    accelerator.print(f"mesh: {dict(accelerator.mesh.shape)} mode: {args.mode}")
+
+    # a "long-context" task the model can actually learn: recall a token
+    # planted early in the sequence at the final position
+    seq = args.seq
+    config = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=64, layers=2, heads=4, seq=seq
+    )
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    model, optimizer = accelerator.prepare(
+        model, optax.inject_hyperparams(optax.adamw)(learning_rate=args.lr)
+    )
+
+    rng = np.random.default_rng(0)
+    first = last = None
+    for step in range(args.steps):
+        ids = rng.integers(4, 64, size=(args.batch_size, seq)).astype(np.int32)
+        ids[:, 0] = rng.integers(4, 64, size=args.batch_size)  # planted token
+        ids[:, -2] = 2  # "recall" trigger
+        ids[:, -1] = ids[:, 0]  # target: repeat the planted token
+        labels = np.full_like(ids, -100)
+        labels[:, -1] = ids[:, -1]
+
+        out = model(input_ids=ids, labels=labels)
+        accelerator.backward(out.loss)
+        optimizer.step()
+        optimizer.zero_grad()
+        loss = float(out.loss)
+        if first is None:
+            first = loss
+        last = loss
+        if step % 8 == 0:
+            accelerator.print(f"step {step}: recall loss {loss:.4f}")
+    accelerator.print(f"recall loss {first:.4f} -> {last:.4f}")
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--mixed_precision", default="no")
+    parser.add_argument("--cp", type=int, default=4)
+    parser.add_argument("--mode", default="ring", choices=("ring", "ulysses", "allgather"))
+    parser.add_argument("--seq", type=int, default=256)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--steps", type=int, default=32)
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ test. The conftest fixture resets the state singletons between tests.
 import contextlib
 import io
 import os
+import re
 import runpy
 import subprocess
 import sys
@@ -121,16 +122,26 @@ def test_local_sgd_example():
     assert "final loss" in stdout
 
 
+def test_context_parallel_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "context_parallel.py"),
+        "--cp", "4", "--mode", "ring", "--seq", "128", "--steps", "24",
+    )
+    assert "'cp': 4" in stdout
+    m = re.search(r"recall loss ([\d.]+) -> ([\d.]+)", stdout)
+    assert m, stdout
+    assert float(m.group(2)) < float(m.group(1))  # recall task is learnable
+
+
 def test_megatron_lm_pretraining_example():
     stdout = _run(
         os.path.join(BY_FEATURE, "megatron_lm_pretraining.py"),
         "--tp", "2", "--pp", "2", "--num_micro_batches", "4", "--num_epochs", "1",
     )
     assert "'pp': 2" in stdout and "'tp': 2" in stdout
-    first, last = (
-        float(x) for x in stdout.split("pretraining loss ")[1].split()[0:3:2]
-    )
-    assert last < first  # bigram structure is learnable
+    m = re.search(r"pretraining loss ([\d.]+) -> ([\d.]+)", stdout)
+    assert m, stdout
+    assert float(m.group(2)) < float(m.group(1))  # bigram structure is learnable
 
 
 def test_tracking_example(tmp_path):
