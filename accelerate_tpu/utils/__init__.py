@@ -49,3 +49,4 @@ from .random import set_seed, synchronize_rng_states
 from .deepspeed import DummyOptim, DummyScheduler
 from .other import convert_bytes
 from .tqdm import tqdm
+from .versions import compare_versions, is_jax_version

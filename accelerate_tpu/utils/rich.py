@@ -1,0 +1,14 @@
+"""Opt-in rich tracebacks (reference ``utils/rich.py``): importing this
+module installs rich's traceback handler when rich is installed, and
+raises with install guidance otherwise."""
+
+from .imports import is_rich_available
+
+if is_rich_available():
+    from rich.traceback import install
+
+    install(show_locals=False)
+else:
+    raise ModuleNotFoundError(
+        "To use the rich extension, install rich with `pip install rich`"
+    )

@@ -1,0 +1,38 @@
+"""Version comparison helpers (reference ``utils/versions.py``: the same
+operator-dispatch contract, keyed on jax instead of torch)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+STR_OPERATION_TO_FUNC = {
+    ">": operator.gt, ">=": operator.ge, "==": operator.eq,
+    "!=": operator.ne, "<=": operator.le, "<": operator.lt,
+}
+
+
+def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
+    """``compare_versions("jax", ">=", "0.4.30")`` — accepts a package name
+    or an already-parsed :class:`packaging.version.Version`."""
+    # packaging is near-universal but NOT a declared dependency of this
+    # package; import lazily so `import accelerate_tpu` never requires it
+    from packaging.version import parse
+
+    if operation not in STR_OPERATION_TO_FUNC:
+        raise ValueError(
+            f"operation must be one of {sorted(STR_OPERATION_TO_FUNC)}, got {operation!r}"
+        )
+    if isinstance(library_or_version, str):
+        library_or_version = parse(importlib.metadata.version(library_or_version))
+    return STR_OPERATION_TO_FUNC[operation](
+        library_or_version, parse(requirement_version)
+    )
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    """(Reference analog: ``is_torch_version``.)"""
+    import jax
+    from packaging.version import parse
+
+    return compare_versions(parse(jax.__version__), operation, version)

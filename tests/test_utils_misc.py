@@ -1,0 +1,29 @@
+"""Tiny parity utils (reference utils/versions.py, rich.py, other.py, tqdm.py)."""
+
+import pytest
+
+from accelerate_tpu.utils import compare_versions, convert_bytes, is_jax_version
+
+
+def test_compare_versions_operator_dispatch():
+    assert compare_versions("jax", ">=", "0.4.0")
+    assert not compare_versions("jax", "<", "0.4.0")
+    assert is_jax_version(">=", "0.4.0")
+    with pytest.raises(ValueError, match="operation"):
+        compare_versions("jax", "~=", "1.0")
+
+
+def test_convert_bytes_units():
+    assert convert_bytes(512) == "512 bytes"
+    assert convert_bytes(2048) == "2.0 KB"
+    assert convert_bytes(3.2e9) == "2.98 GB"
+
+
+def test_rich_module_contract():
+    from accelerate_tpu.utils.imports import is_rich_available
+
+    if is_rich_available():
+        import accelerate_tpu.utils.rich  # noqa: F401 — installs the handler
+    else:
+        with pytest.raises(ModuleNotFoundError, match="rich"):
+            import accelerate_tpu.utils.rich  # noqa: F401
