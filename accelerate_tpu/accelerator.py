@@ -238,21 +238,23 @@ class Accelerator:
         from .ops.attention import AttentionContext, set_attention_context
 
         cp_mode = None
+        pp_microbatches = 0
         mesh_shape = dict(self.state.mesh.shape)
         if mesh_shape.get("pp", 1) > 1:
             # fail at construction, not at the first forward
-            from .parallel.pipeline import set_default_microbatches, validate_pipeline_axes
+            from .parallel.pipeline import validate_pipeline_axes
 
             validate_pipeline_axes(mesh_shape)
 
             # honour the requested schedule depth (reference field
             # ``num_micro_batches``, utils/dataclasses.py:1912). Our plugin
             # defaults to 0 (= auto) so an explicit 1 is honoured; foreign
-            # duck-typed plugins default to 1, which means "unset" there
+            # duck-typed plugins default to 1, which means "unset" there —
+            # see the MegatronLMPlugin docstring for the coercion rule
             _mb = getattr(megatron_lm_plugin, "num_micro_batches", 0) or 0
             if not isinstance(megatron_lm_plugin, MegatronLMPlugin):
                 _mb = _mb if _mb > 1 else 0
-            set_default_microbatches(_mb)
+            pp_microbatches = _mb
         if mesh_shape.get("cp", 1) > 1:
             if context_parallel_plugin is not None:
                 cp_mode = context_parallel_plugin.mode
@@ -298,7 +300,11 @@ class Accelerator:
                     "TPU executes the real ring"
                 )
                 cp_mode = "allgather"
-        set_attention_context(AttentionContext(mesh=self.state.mesh, cp_mode=cp_mode))
+        set_attention_context(
+            AttentionContext(
+                mesh=self.state.mesh, cp_mode=cp_mode, pipeline_microbatches=pp_microbatches
+            )
+        )
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration(
             split_batches=split_batches,

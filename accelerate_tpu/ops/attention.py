@@ -40,6 +40,11 @@ class AttentionContext:
     impl: Literal["auto", "flash", "blockwise", "reference"] = "auto"
     block_q: int = 512
     block_kv: int = 1024
+    #: session default for the GPipe microbatch count (0 = auto), carried
+    #: here so it travels atomically with the mesh it was configured for
+    #: (a new Accelerator swaps mesh + schedule depth together instead of
+    #: leaving a stale microbatch global paired with a fresh mesh).
+    pipeline_microbatches: int = 0
 
 
 _current = AttentionContext()

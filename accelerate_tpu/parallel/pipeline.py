@@ -34,16 +34,19 @@ from jax.sharding import Mesh, PartitionSpec
 P = PartitionSpec
 
 
-#: session default for the GPipe microbatch count, set by
-#: ``Accelerator.__init__`` from ``MegatronLMPlugin.num_micro_batches``
-#: (reference field ``utils/dataclasses.py:1912``). Model configs that set
-#: their own ``pipeline_microbatches`` take precedence.
-_default_num_microbatches = 0
-
-
 def set_default_microbatches(n: int) -> None:
-    global _default_num_microbatches
-    _default_num_microbatches = int(n)
+    """Set the session default for the GPipe microbatch count (0 = auto).
+
+    The default rides the parallelism context (``AttentionContext``) set by
+    ``Accelerator.__init__`` from ``MegatronLMPlugin.num_micro_batches``
+    (reference field ``utils/dataclasses.py:1912``), so it shares the mesh's
+    lifecycle instead of living in a module global. Model configs that set
+    their own ``pipeline_microbatches`` take precedence.
+    """
+    from ..ops.attention import get_attention_context, set_attention_context
+    from dataclasses import replace
+
+    set_attention_context(replace(get_attention_context(), pipeline_microbatches=int(n)))
 
 
 def remat_wrap(body, remat):
@@ -109,13 +112,31 @@ def pipeline_microbatches(batch: int, num_microbatches: int, num_stages: int) ->
     """Validate/resolve the microbatch count for a GPipe run.
 
     ``num_microbatches == 0`` means auto: the session default from
-    :func:`set_default_microbatches` if set, else the smallest divisor of
-    ``batch`` that is >= ``num_stages``, so the schedule always has at
-    least one microbatch in flight per stage (falls back to ``batch``
-    itself).
+    :func:`set_default_microbatches` if set AND it divides ``batch``
+    (an inherited default that doesn't divide falls through to auto
+    resolution rather than raising at trace time), else the smallest
+    divisor of ``batch`` that is >= ``num_stages``, so the schedule always
+    has at least one microbatch in flight per stage (falls back to
+    ``batch`` itself).
     """
     if num_microbatches == 0:
-        num_microbatches = _default_num_microbatches
+        from ..ops.attention import get_attention_context
+
+        inherited = get_attention_context().pipeline_microbatches
+        if inherited < 0:
+            raise ValueError(f"num_microbatches must be >= 1, got {inherited}")
+        if inherited >= 1:
+            if batch % inherited == 0:
+                num_microbatches = inherited
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "configured num_micro_batches=%d does not divide global "
+                    "batch %d; falling back to auto microbatch resolution",
+                    inherited,
+                    batch,
+                )
     if num_microbatches:
         if num_microbatches < 1:
             raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
@@ -242,13 +263,19 @@ def gpipe(
     nstages = dict(mesh.shape).get(axis, 1)
     if nstages <= 1:
         return stage_fn(stage_params, x, *aligned, *broadcast)
-    for leaf in jax.tree.leaves(stage_params):
-        if leaf.shape[0] % nstages != 0:
+    layer_lens = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if len(layer_lens) > 1:
+        raise ValueError(
+            f"stage_params leaves disagree on the stacked layer axis "
+            f"(leading dims {sorted(layer_lens)}); every leaf must share "
+            f"the same [layers] leading axis"
+        )
+    for n_layers in layer_lens:
+        if n_layers % nstages != 0:
             raise ValueError(
-                f"stacked layer axis of length {leaf.shape[0]} must divide "
+                f"stacked layer axis of length {n_layers} must divide "
                 f"evenly into {axis}={nstages} pipeline stages"
             )
-        break  # all leaves share the [layers] leading axis
     b = x.shape[0]
     m = pipeline_microbatches(b, num_microbatches, nstages)
     mb = b // m

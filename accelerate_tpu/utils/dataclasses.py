@@ -434,7 +434,16 @@ class DeepSpeedPlugin(KwargsHandler):
 @dataclass
 class MegatronLMPlugin(KwargsHandler):
     """Compatibility façade (reference ``dataclasses.py:1814+``): tp/pp/sp
-    degrees lower to mesh axes; there is no separate Megatron engine."""
+    degrees lower to mesh axes; there is no separate Megatron engine.
+
+    ``num_micro_batches`` uses 0 for auto (smallest divisor of the batch
+    >= the stage count). For duck-typed upstream-style plugins — whose
+    dataclass default is 1, meaning "unset" there — a value of 1 is
+    coerced to auto, so an upstream user's *explicit* ``num_micro_batches=1``
+    (whole-batch scheduling) cannot be distinguished from the default and
+    gets auto microbatching; construct THIS class with
+    ``num_micro_batches=1`` to request whole-batch scheduling explicitly.
+    """
 
     tp_degree: int = 1
     pp_degree: int = 1

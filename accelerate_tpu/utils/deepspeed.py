@@ -61,7 +61,7 @@ def optimizer_from_ds_config(ds_config: dict, dummy: DummyOptim):
     params = dict(section.get("params", {}))
     lr = float(_resolved(params.get("lr"), dummy.lr))
     weight_decay = float(_resolved(params.get("weight_decay"), dummy.weight_decay))
-    betas = params.get("betas", (0.9, 0.999))
+    betas = _resolved(params.get("betas"), dummy.kwargs.get("betas", (0.9, 0.999)))
     eps = float(_resolved(params.get("eps"), 1e-8))
     otype = str(section.get("type", "AdamW")).lower()
     if otype in ("adamw", "adam"):

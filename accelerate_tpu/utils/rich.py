@@ -4,11 +4,12 @@ raises with install guidance otherwise."""
 
 from .imports import is_rich_available
 
-if is_rich_available():
-    from rich.traceback import install
-
-    install(show_locals=False)
-else:
+if not is_rich_available():
     raise ModuleNotFoundError(
-        "To use the rich extension, install rich with `pip install rich`"
+        "Rich tracebacks need the `rich` package — add it to your environment "
+        "(e.g. `pip install rich`) before importing accelerate_tpu.utils.rich."
     )
+
+from rich.traceback import install
+
+install(show_locals=False)
