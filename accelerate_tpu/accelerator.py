@@ -150,7 +150,7 @@ class Accelerator:
         gradient_accumulation_steps: int = 1,
         cpu: bool = False,
         dataloader_config: DataLoaderConfiguration | None = None,
-        deepspeed_plugin: DeepSpeedPlugin | None = None,
+        deepspeed_plugin: DeepSpeedPlugin | dict[str, DeepSpeedPlugin] | None = None,
         fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
         megatron_lm_plugin=None,
         mesh_plugin: MeshPlugin | None = None,
@@ -175,9 +175,28 @@ class Accelerator:
             deepspeed_plugin = DeepSpeedPlugin()
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false") == "true":
             fsdp_plugin = FullyShardedDataParallelPlugin()
-        if deepspeed_plugin is not None and fsdp_plugin is None:
-            fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
-        self.deepspeed_plugin = deepspeed_plugin
+        # several named plugins may coexist (reference supports a dict with
+        # runtime selection, ``utils/deepspeed.py:25-41``); the first is
+        # active until ``state.select_deepspeed_plugin(name)`` switches
+        if isinstance(deepspeed_plugin, dict):
+            if not deepspeed_plugin:
+                raise ValueError("deepspeed_plugin dict must not be empty")
+            for key, p in deepspeed_plugin.items():
+                if not isinstance(p, DeepSpeedPlugin):
+                    raise TypeError(
+                        f"deepspeed_plugin[{key!r}] must be a DeepSpeedPlugin, "
+                        f"got {type(p).__name__}"
+                    )
+                p._unselect()
+            next(iter(deepspeed_plugin.values())).select(_from_accelerator_state=True)
+        self._deepspeed_plugins = deepspeed_plugin
+        active_ds = (
+            next(p for p in deepspeed_plugin.values() if p.selected)
+            if isinstance(deepspeed_plugin, dict)
+            else deepspeed_plugin
+        )
+        if active_ds is not None and fsdp_plugin is None:
+            fsdp_plugin = active_ds.to_fsdp_plugin()
         self.fsdp_plugin = fsdp_plugin
         self.megatron_lm_plugin = megatron_lm_plugin
         self.context_parallel_plugin = context_parallel_plugin
@@ -232,6 +251,11 @@ class Accelerator:
             _from_accelerator=True,
             **init_kwargs,
         )
+        # AcceleratorState is shared (Borg): only publish plugins this
+        # Accelerator actually brought — a later plain Accelerator() must
+        # not clear an earlier one's registration
+        if self._deepspeed_plugins is not None:
+            self.state.deepspeed_plugins = self._deepspeed_plugins
 
         # attention routing: bake the cp mode + mesh into every step compiled
         # from here on (models read this at trace time)
@@ -314,10 +338,10 @@ class Accelerator:
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             steps = gradient_accumulation_steps if gradient_accumulation_steps > 1 else env_steps
-            if steps == 1 and deepspeed_plugin is not None:
+            if steps == 1 and active_ds is not None:
                 # a ds-config's accumulation governs the loop (reference
                 # merges it in ``accelerator.py:1669-1830``)
-                steps = deepspeed_plugin.gradient_accumulation_steps
+                steps = active_ds.gradient_accumulation_steps
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
         self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
 
@@ -350,6 +374,21 @@ class Accelerator:
     @property
     def distributed_type(self):
         return self.state.distributed_type
+
+    @property
+    def deepspeed_plugin(self):
+        """The ACTIVE DeepSpeedPlugin (or None): with a dict of named
+        plugins, selection via ``state.select_deepspeed_plugin(name)``
+        changes what this returns (reference ``utils/deepspeed.py:25``)."""
+        if self._deepspeed_plugins is None:
+            return None
+        from .utils.deepspeed import get_active_deepspeed_plugin
+
+        return get_active_deepspeed_plugin(self.state)
+
+    @property
+    def deepspeed_plugins(self):
+        return self._deepspeed_plugins
 
     @property
     def num_processes(self):

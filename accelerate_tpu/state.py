@@ -302,6 +302,7 @@ class AcceleratorState:
         self._mixed_precision = mixed_precision
         self.fsdp_plugin = fsdp_plugin
         self.dynamo_plugin = None  # XLA always compiles; kept for API parity
+        self.deepspeed_plugins = None  # plugin | dict[str, plugin] | None
         self.initialized_trackers = []
 
     @property
@@ -320,6 +321,42 @@ class AcceleratorState:
     @property
     def mixed_precision(self) -> str:
         return self._mixed_precision
+
+    # -- multi-plugin DeepSpeed selection (reference ``state.py:1100-1116``) --
+
+    def _named_deepspeed_plugins(self) -> dict:
+        plugins = self.__dict__.get("deepspeed_plugins")
+        if plugins is None:
+            raise ValueError(
+                "No DeepSpeedPlugin is enabled — pass `deepspeed_plugin=` "
+                "(a plugin or a dict of named plugins) to Accelerator first."
+            )
+        if not isinstance(plugins, dict):
+            raise ValueError(
+                "A single (unnamed) DeepSpeedPlugin is enabled; named "
+                "selection needs a dict of plugins passed to Accelerator."
+            )
+        return plugins
+
+    @_require_initialized
+    def get_deepspeed_plugin(self, name: str):
+        """The DeepSpeedPlugin registered under ``name``."""
+        return self._named_deepspeed_plugins()[name]
+
+    @_require_initialized
+    def select_deepspeed_plugin(self, name: str = None):
+        """Activate the plugin registered under ``name`` and deactivate all
+        others; runtime consumers (auto-fill, accumulation, dummy-object
+        lowering) immediately see the newly active plugin's config."""
+        plugins = self._named_deepspeed_plugins()
+        if name not in plugins:
+            raise KeyError(
+                f"no DeepSpeedPlugin named {name!r}; registered: {sorted(plugins)}"
+            )
+        for key, plugin in plugins.items():
+            if key != name:
+                plugin._unselect()
+        plugins[name].select(_from_accelerator_state=True)
 
     def __getattr__(self, name: str):
         # Delegate topology/process-control surface to PartialState.

@@ -46,7 +46,7 @@ from .imports import (
 )
 from .random import set_seed, synchronize_rng_states
 
-from .deepspeed import DummyOptim, DummyScheduler
+from .deepspeed import DummyOptim, DummyScheduler, get_active_deepspeed_plugin
 from .other import convert_bytes
 from .tqdm import tqdm
 from .versions import compare_versions, is_jax_version

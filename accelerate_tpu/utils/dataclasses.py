@@ -360,6 +360,7 @@ class DeepSpeedPlugin(KwargsHandler):
     hf_ds_config: Any = None
 
     def __post_init__(self):
+        self._selected = True
         self.zero_stage = int(os.environ.get("ACCELERATE_DEEPSPEED_ZERO_STAGE", self.zero_stage))
         self.gradient_accumulation_steps = int(
             os.environ.get(
@@ -428,6 +429,34 @@ class DeepSpeedPlugin(KwargsHandler):
             sharding_strategy=strategy,
             cpu_offload=self.offload_optimizer_device == "cpu"
             or self.offload_param_device == "cpu",
+        )
+
+    # -- multi-plugin selection (reference ``dataclasses.py:1372-1399``):
+    # several named plugins can coexist on AcceleratorState; exactly one is
+    # active at a time and runtime code (auto-fill, grad accumulation,
+    # dummy-object lowering) reads the active one.
+
+    def select(self, _from_accelerator_state: bool = False):
+        if not _from_accelerator_state:
+            raise ValueError(
+                "A DeepSpeedPlugin is enabled via "
+                "`AcceleratorState().select_deepspeed_plugin(name)`, not by "
+                "calling `select()` directly."
+            )
+        self._selected = True
+
+    def _unselect(self):
+        self._selected = False
+
+    @property
+    def selected(self) -> bool:
+        return self._selected
+
+    @selected.setter
+    def selected(self, value):
+        raise NotImplementedError(
+            "`selected` is read-only; use "
+            "`AcceleratorState().select_deepspeed_plugin(name)`."
         )
 
 

@@ -15,6 +15,29 @@ from __future__ import annotations
 from typing import Any
 
 
+def get_active_deepspeed_plugin(state):
+    """Return the currently active :class:`DeepSpeedPlugin` (reference
+    ``utils/deepspeed.py:25-41``). With a dict of named plugins, the one
+    whose ``selected`` flag is set wins; a single plugin is returned
+    directly. Raises when DeepSpeed was never enabled."""
+    plugins = getattr(state, "deepspeed_plugins", None)
+    if plugins is None:
+        raise ValueError(
+            "Couldn't retrieve an active DeepSpeedPlugin: none were enabled. "
+            "Pass `deepspeed_plugin=` to Accelerator (a plugin or a dict of "
+            "named plugins) before calling this."
+        )
+    if not isinstance(plugins, dict):
+        return plugins
+    active = next((p for p in plugins.values() if p.selected), None)
+    if active is None:
+        raise ValueError(
+            "No DeepSpeedPlugin in the registered dict is selected; call "
+            "AcceleratorState().select_deepspeed_plugin(name) first."
+        )
+    return active
+
+
 class DummyOptim:
     """Placeholder for a config-file-defined optimizer (reference
     ``utils/deepspeed.py:229``). ``lr``/``weight_decay`` fill the config's

@@ -323,3 +323,56 @@ def test_dummy_optim_without_ds_plugin_raises():
     acc = Accelerator()
     with pytest.raises(ValueError, match="DummyOptim"):
         acc.prepare(RegressionModel(), DummyOptim())
+
+
+def test_multi_plugin_deepspeed_selection(tmp_path):
+    """Dict-of-plugins with runtime selection (reference
+    ``utils/deepspeed.py:25-41`` + ``state.py:1100-1116``)."""
+    from accelerate_tpu.utils import get_active_deepspeed_plugin
+
+    z2 = DeepSpeedPlugin(zero_stage=2)
+    z3 = DeepSpeedPlugin(hf_ds_config=_ds_config(tmp_path))
+    acc = Accelerator(deepspeed_plugin={"student": z2, "teacher": z3})
+
+    # first plugin is active by default
+    assert get_active_deepspeed_plugin(acc.state) is z2
+    assert acc.deepspeed_plugin is z2
+    assert z2.selected and not z3.selected
+    assert acc.state.get_deepspeed_plugin("teacher") is z3
+
+    acc.state.select_deepspeed_plugin("teacher")
+    assert acc.deepspeed_plugin is z3
+    assert z3.selected and not z2.selected
+    assert acc.deepspeed_plugin.zero_stage == 3
+
+    with pytest.raises(KeyError, match="registered"):
+        acc.state.select_deepspeed_plugin("nope")
+    with pytest.raises(ValueError, match="select_deepspeed_plugin"):
+        z2.select()
+    with pytest.raises(NotImplementedError):
+        z2.selected = True
+
+
+def test_single_plugin_active_and_empty_dict_rejected():
+    from accelerate_tpu.utils import get_active_deepspeed_plugin
+
+    plugin = DeepSpeedPlugin(zero_stage=1)
+    acc = Accelerator(deepspeed_plugin=plugin)
+    assert get_active_deepspeed_plugin(acc.state) is plugin
+    with pytest.raises(ValueError, match="named selection"):
+        acc.state.select_deepspeed_plugin("any")
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    with pytest.raises(ValueError, match="empty"):
+        Accelerator(deepspeed_plugin={})
+
+
+def test_get_active_plugin_without_deepspeed_raises():
+    from accelerate_tpu.utils import get_active_deepspeed_plugin
+
+    acc = Accelerator()
+    with pytest.raises(ValueError, match="none were enabled"):
+        get_active_deepspeed_plugin(acc.state)
