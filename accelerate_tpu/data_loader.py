@@ -664,26 +664,115 @@ class DataLoaderDispatcher(DataLoaderShard):
         return PartialState().num_processes == 1
 
     def _raw_batches(self) -> Iterator[Any]:
+        """Rank-0 fetch + broadcast. Array leaves ride RAW tensor broadcasts
+        (``broadcast_one_to_all`` — no pickling on the hot path; the
+        reference likewise broadcasts tensors, ``data_loader.py:741-786``);
+        one small control tensor per batch carries continue/end + a
+        structure-changed flag, and the pytree structure (treedef + per-leaf
+        shape/dtype) is object-broadcast only when it CHANGES — i.e. once
+        for a steady-state stream, again at an uneven tail. Non-numeric
+        leaves (strings …) fall back to one object broadcast per batch."""
         state = PartialState()
         if state.num_processes == 1:
             yield from super()._raw_batches()
             return
         from . import operations as ops
+        from jax.experimental import multihost_utils
 
-        if state.is_main_process:
+        is_main = state.is_main_process
+
+        def _control(value: int) -> int:
+            return int(
+                multihost_utils.broadcast_one_to_all(
+                    np.array([value], np.int64), is_source=is_main
+                )[0]
+            )
+
+        def _numeric(leaf):
+            a = np.asarray(leaf)
+            if np.issubdtype(a.dtype, np.number) or a.dtype == np.bool_:
+                return a
+            return None
+
+        def _send_tensor(a):
+            # >4-byte dtypes (int64/float64 — numpy's defaults) would be
+            # silently truncated by broadcast_one_to_all's jax round-trip
+            # under the default jax_enable_x64=False; ship them as raw
+            # bytes instead (still a tensor broadcast, no pickling)
+            if a.dtype.itemsize > 4:
+                a = np.frombuffer(np.ascontiguousarray(a).tobytes(), np.uint8)
+            multihost_utils.broadcast_one_to_all(a, is_source=True)
+
+        def _recv_tensor(shape, dtype, scalar):
+            dtype = np.dtype(dtype)
+            if dtype.itemsize > 4:
+                nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                data = multihost_utils.broadcast_one_to_all(
+                    np.zeros(nbytes, np.uint8), is_source=False
+                )
+                out = np.frombuffer(np.asarray(data).tobytes(), dtype).reshape(shape)
+            else:
+                out = np.asarray(
+                    multihost_utils.broadcast_one_to_all(
+                        np.zeros(shape, dtype), is_source=False
+                    )
+                )
+            # rank 0 yields its original batch; receivers must rebuild the
+            # SAME Python types — a leaf that was a plain int/float/bool on
+            # rank 0 comes back as one, not a 0-d array (rank-divergent
+            # types are heisenbugs: dict keys, `is` checks, json dumps)
+            return out.item() if scalar else out
+
+        _END, _SAME, _NEW_STRUCT = 0, 1, 2
+        desc = None  # (treedef, meta); meta: ((shape, dtype_str, is_scalar) | None, ...)
+
+        if is_main:
             it = super()._raw_batches()
             while True:
                 batch = next(it, None)
-                has_more = ops.broadcast_object_list([batch is not None])[0]
-                if not has_more:
+                if batch is None:
+                    _control(_END)
                     return
-                yield ops.broadcast_object_list([batch])[0]
+                leaves, treedef = jax.tree.flatten(batch)
+                tensors = [_numeric(l) for l in leaves]
+                meta = tuple(
+                    (a.shape, a.dtype.str, not isinstance(l, (np.ndarray, jax.Array)))
+                    if a is not None
+                    else None
+                    for l, a in zip(leaves, tensors)
+                )
+                changed = desc is None or desc != (treedef, meta)
+                _control(_NEW_STRUCT if changed else _SAME)
+                if changed:
+                    desc = (treedef, meta)
+                    ops.broadcast_object_list([desc])
+                objects = [l for l, a in zip(leaves, tensors) if a is None]
+                if objects:
+                    ops.broadcast_object_list([objects])
+                for a in tensors:
+                    if a is not None:
+                        _send_tensor(a)
+                yield batch
         else:
             while True:
-                has_more = ops.broadcast_object_list([None])[0]
-                if not has_more:
+                code = _control(_END)
+                if code == _END:
                     return
-                yield ops.broadcast_object_list([None])[0]
+                if code == _NEW_STRUCT:
+                    desc = ops.broadcast_object_list([None])[0]
+                treedef, meta = desc
+                objects = (
+                    iter(ops.broadcast_object_list([None])[0])
+                    if any(m is None for m in meta)
+                    else iter(())
+                )
+                leaves = []
+                for m in meta:
+                    if m is None:
+                        leaves.append(next(objects))
+                    else:
+                        leaves.append(_recv_tensor(*m))
+                yield jax.tree.unflatten(treedef, leaves)
 
     def _place(self, batch):
         """Slice this process's rows out of the broadcast global batch, then
