@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ..modules import Model, ModelOutput
 from ..ops.attention import attention
 from ..ops.fp8 import dense
-from ..ops.layers import cached_attention, cross_entropy_loss
+from ..ops.layers import cached_attention, cross_entropy_loss, write_kv_cache
 from ..parallel.pipeline import remat_wrap
 from .llama import _constrain
 
@@ -155,11 +155,6 @@ def gpt2_apply(
     from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
     pp_mesh = active_pipeline_mesh()
-    if (use_cache or kv_cache is not None) and pp_mesh is not None:
-        raise NotImplementedError(
-            "KV-cache generation (use_cache/kv_cache) is not implemented "
-            "over a pp>1 mesh; run generation on a mesh with pp=1"
-        )
     if kv_cache is not None:
         return _gpt2_decode_step(c, params, input_ids, kv_cache, cache_index)
     if positions is None:
@@ -177,14 +172,22 @@ def gpt2_apply(
                 f"{c.max_position_embeddings} (max_position_embeddings)]"
             )
 
-        def cache_body(x, layer):
-            pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
-            out, (k, v) = gpt2_layer_apply(
-                c, layer, x, attention_mask, return_kv=True
-            )
+        from ..parallel.pipeline import prefill_stack
+
+        pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+        has_mask = attention_mask is not None
+        ops = (attention_mask,) if has_mask else ()
+
+        def prefill_layer(layer, h, *rest):
+            mask_b = rest[0] if has_mask else None
+            out, (k, v) = gpt2_layer_apply(c, layer, h, mask_b, return_kv=True)
             return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-        x, caches = jax.lax.scan(cache_body, x, params["layers"])
+        x, caches = prefill_stack(
+            prefill_layer, params["layers"], x,
+            (c.num_hidden_layers, b, max_cache, c.num_attention_heads, c.head_dim),
+            broadcast=ops,
+        )
     elif pp_mesh is not None:
         # GPipe over the pp axis: positions are already folded into x at
         # the embedding, so only the mask rides the microbatch schedule
@@ -209,44 +212,54 @@ def gpt2_apply(
 
     out = ModelOutput(logits=logits)
     if caches is not None:
-        out["kv_cache"] = {"k": caches[0], "v": caches[1]}
+        out["kv_cache"] = caches
     if labels is not None:
         out["loss"] = cross_entropy_loss(logits[:, :-1, :], labels[:, 1:])
     return out
 
 
+def _gpt2_decode_layer(c, layer, x, k_cache_l, v_cache_l, idx, pp_manual=False):
+    """One cached decode block on UNstacked layer params (mirrors
+    ``_llama_decode_layer``, with learned positions and fused QKV;
+    ``pp_manual``: see :func:`accelerate_tpu.ops.layers.write_kv_cache`)."""
+    b, s, _ = x.shape
+    nh, hd = c.num_attention_heads, c.head_dim
+    y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+    qkv = dense(y, layer["w_qkv"]) + layer["b_qkv"]
+    q, k, v = (z.reshape(b, s, nh, hd) for z in jnp.split(qkv, 3, axis=-1))
+    if pp_manual:
+        q = _constrain(q, P())
+    k_cache_l, v_cache_l = write_kv_cache(
+        k_cache_l, v_cache_l, k, v, idx, pin_replicated=pp_manual
+    )
+    attn = cached_attention(q, k_cache_l, v_cache_l, idx)
+    x = x + dense(attn.reshape(b, s, nh * hd), layer["w_proj"]) + layer["b_proj"]
+    y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+    x = x + dense(
+        jax.nn.gelu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
+    ) + layer["b_out"]
+    return x, k_cache_l, v_cache_l
+
+
 def _gpt2_decode_step(c, params, input_ids, kv_cache, cache_index):
     """One cached decode step: s == 1 token per row appended at
-    ``cache_index[b]``; attention is q(1) vs the cache prefix (mirrors
-    ``_llama_decode_step`` with learned positions and fused QKV)."""
-    b, s = input_ids.shape
-    nh, hd = c.num_attention_heads, c.head_dim
-    rows = jnp.arange(b)
-    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
+    ``cache_index[b]``; attention is q(1) vs the cache prefix. The layer
+    loop is owned by :func:`parallel.pipeline.decode_stack`."""
+    from ..parallel.pipeline import decode_stack
 
+    b, s = input_ids.shape
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
     x = params["wte"][input_ids] + params["wpe"][idx[:, None]]
 
-    def body(x, xs):
-        layer, k_cache_l, v_cache_l = xs
-        y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
-        qkv = dense(y, layer["w_qkv"]) + layer["b_qkv"]
-        q, k, v = (z.reshape(b, s, nh, hd) for z in jnp.split(qkv, 3, axis=-1))
-        k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
-        v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
-        attn = cached_attention(q, k_cache_l, v_cache_l, idx)
-        x = x + dense(attn.reshape(b, s, nh * hd), layer["w_proj"]) + layer["b_proj"]
-        y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
-        x = x + dense(
-            jax.nn.gelu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
-        ) + layer["b_out"]
-        return x, (k_cache_l, v_cache_l)
-
-    x, (k_cache, v_cache) = jax.lax.scan(
-        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    x, kv = decode_stack(
+        lambda layer, h, kc_l, vc_l, idx_b, pp_manual: _gpt2_decode_layer(
+            c, layer, h, kc_l, vc_l, idx_b, pp_manual=pp_manual
+        ),
+        params["layers"], kv_cache, x, broadcast=(idx,),
     )
     x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)
     logits = dense(x, params["wte"].T)
-    return ModelOutput(logits=logits, kv_cache={"k": k_cache, "v": v_cache})
+    return ModelOutput(logits=logits, kv_cache=kv)
 
 
 _LAYER_KEYS = (

@@ -33,8 +33,11 @@ from ..ops.layers import (
     apply_rope,
     cached_attention,
     cross_entropy_loss,
+    fused_cross_entropy,
     rms_norm,
+    rope_cached_attention_block,
     rope_frequencies,
+    shift_labels,
 )
 
 
@@ -243,15 +246,10 @@ def llama_apply(
         )
     cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
 
-    if (use_cache or kv_cache is not None) and _pipeline_mesh() is not None:
-        # the prefill/decode scans have no GPipe path; running them over
-        # stage-split weights would silently all-gather the full stack onto
-        # every pp group — refuse, like the models without a pipeline path
-        raise NotImplementedError(
-            "KV-cache generation (use_cache/kv_cache) is not implemented "
-            "over a pp>1 mesh; run generation on a mesh with pp=1"
-        )
-
+    # over a pp>1 mesh, prefill/decode run through the stage-local-cache
+    # pipeline engine (parallel.pipeline.pipeline_cached_stack via the
+    # prefill_stack/decode_stack drivers), so stage-split weights and
+    # caches stay put instead of the plain scans all-gathering them
     if kv_cache is not None:
         return _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
 
@@ -270,14 +268,24 @@ def llama_apply(
                 "above it RoPE tables would silently clamp"
             )
 
-        def body(x, layer):
-            pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+        from ..parallel.pipeline import prefill_stack
+
+        pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+        has_mask = attention_mask is not None
+        ops = (positions,) + ((attention_mask,) if has_mask else ()) + (cos, sin)
+
+        def prefill_layer(layer, h, pos_b, *rest):
+            mask_b = rest[0] if has_mask else None
             out, (k, v) = llama_layer_apply(
-                c, layer, x, cos, sin, positions, attention_mask, return_kv=True
+                c, layer, h, rest[-2], rest[-1], pos_b, mask_b, return_kv=True
             )
             return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-        x, (k_cache, v_cache) = jax.lax.scan(body, x, params["layers"])
+        x, caches = prefill_stack(
+            prefill_layer, params["layers"], x,
+            (c.num_hidden_layers, b, max_cache, c.num_key_value_heads, c.head_dim),
+            broadcast=ops,
+        )
     else:
         pp_mesh = _pipeline_mesh()
         if pp_mesh is not None:
@@ -296,50 +304,64 @@ def llama_apply(
 
     out = ModelOutput(logits=logits)
     if use_cache:
-        out["kv_cache"] = {"k": k_cache, "v": v_cache}
+        out["kv_cache"] = caches
     if labels is not None:
-        # causal shift: predict token t+1 from prefix ≤ t
-        shifted_logits = logits[:, :-1, :]
-        shifted_labels = labels[:, 1:]
-        out["loss"] = cross_entropy_loss(shifted_logits, shifted_labels)
+        # predict token t+1 from prefix ≤ t. The loss is computed straight
+        # from the pre-head hidden states (NOT from `logits` above): when a
+        # training step only forces `loss`, XLA dead-code-eliminates the
+        # full [b, s, vocab] logits buffer and the fused path holds one
+        # sequence chunk of logits at a time — the memory headroom is what
+        # lets the bench run larger per-chip batches. Under cp the sequence
+        # dim is sharded, so chunking it would cut across shards; the plain
+        # whole-sequence loss stays on that path.
+        from ..ops.attention import get_attention_context
+
+        ctx_mesh = get_attention_context().mesh
+        cp_active = ctx_mesh is not None and dict(ctx_mesh.shape).get("cp", 1) > 1
+        if cp_active:
+            out["loss"] = cross_entropy_loss(logits[:, :-1, :], labels[:, 1:])
+        else:
+            out["loss"] = fused_cross_entropy(x, head, shift_labels(labels), dense_fn=dense)
     return out
+
+
+def _llama_decode_layer(c, layer, x, k_cache_l, v_cache_l, cos, sin, idx, pp_manual=False):
+    """One cached decode block on UNstacked layer params: the shared
+    rope/cache attention sub-block + llama's SwiGLU MLP."""
+    x, k_cache_l, v_cache_l = rope_cached_attention_block(
+        layer, x, k_cache_l, v_cache_l, cos, sin, idx,
+        c.num_attention_heads, c.num_key_value_heads, c.head_dim,
+        c.rms_norm_eps, pp_manual=pp_manual,
+    )
+    y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+    gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
+    x = x + dense(gated, layer["w_down"])
+    return x, k_cache_l, v_cache_l
 
 
 def _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
     """One cached decode step: s == 1 token per row, appended at
-    ``cache_index[b]``; attention is q(1) against the cache prefix."""
-    b, s = input_ids.shape
-    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
-    rows = jnp.arange(b)
-    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
-    positions = idx[:, None]  # [b, 1]
+    ``cache_index[b]``; attention is q(1) against the cache prefix. The
+    layer loop (plain scan vs pp stage pipeline) is owned by
+    :func:`parallel.pipeline.decode_stack`."""
+    from ..parallel.pipeline import decode_stack
 
+    b, s = input_ids.shape
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
     x = params["embed_tokens"][input_ids]
 
-    def body(x, xs):
-        layer, k_cache_l, v_cache_l = xs
-        y = rms_norm(x, layer["attn_norm"], c.rms_norm_eps)
-        q = apply_rope(dense(y, layer["wq"]).reshape(b, s, nh, hd), cos, sin, positions)
-        k = apply_rope(dense(y, layer["wk"]).reshape(b, s, nkv, hd), cos, sin, positions)
-        v = dense(y, layer["wv"]).reshape(b, s, nkv, hd)
-        k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
-        v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
-        attn = cached_attention(q, k_cache_l, v_cache_l, idx)
-        x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
-        y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
-        gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
-        x = x + dense(gated, layer["w_down"])
-        return x, (k_cache_l, v_cache_l)
-
-    x, (k_cache, v_cache) = jax.lax.scan(
-        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    x, kv = decode_stack(
+        lambda layer, h, kc_l, vc_l, idx_b, cos_b, sin_b, pp_manual: _llama_decode_layer(
+            c, layer, h, kc_l, vc_l, cos_b, sin_b, idx_b, pp_manual=pp_manual
+        ),
+        params["layers"], kv_cache, x, broadcast=(idx, cos, sin),
     )
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed_tokens"].T
     logits = dense(x, head)
-    return ModelOutput(logits=logits, kv_cache={"k": k_cache, "v": v_cache})
+    return ModelOutput(logits=logits, kv_cache=kv)
 
 
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")

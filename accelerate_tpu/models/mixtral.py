@@ -168,7 +168,10 @@ def moe_ffn(config: MixtralConfig, layer, x):
     return y, aux
 
 
-def mixtral_layer_apply(config: MixtralConfig, layer, x, cos, sin, positions, attention_mask):
+def mixtral_layer_apply(
+    config: MixtralConfig, layer, x, cos, sin, positions, attention_mask,
+    return_kv: bool = False,
+):
     c = config
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     b, s, h = x.shape
@@ -186,7 +189,26 @@ def mixtral_layer_apply(config: MixtralConfig, layer, x, cos, sin, positions, at
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
     moe_out, aux = moe_ffn(config, layer, y)
     x = x + moe_out
-    return _constrain(x, P(("dp", "fsdp"), "cp", None)), aux
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    if return_kv:
+        return x, aux, (k, v)
+    return x, aux
+
+
+def _mixtral_decode_layer(c, layer, x, k_cache_l, v_cache_l, cos, sin, idx, pp_manual=False):
+    """One cached decode block: the shared rope/cache attention sub-block
+    (GQA caches store ``n_kv`` heads) + the routed expert FFN on the single
+    token. Experts have no state to cache — only attention does."""
+    from ..ops.layers import rope_cached_attention_block
+
+    x, k_cache_l, v_cache_l = rope_cached_attention_block(
+        layer, x, k_cache_l, v_cache_l, cos, sin, idx,
+        c.num_attention_heads, c.num_key_value_heads, c.head_dim,
+        c.rms_norm_eps, pp_manual=pp_manual,
+    )
+    y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+    moe_out, _ = moe_ffn(c, layer, y)
+    return x + moe_out, k_cache_l, v_cache_l
 
 
 def mixtral_apply(
@@ -196,6 +218,10 @@ def mixtral_apply(
     attention_mask: jax.Array | None = None,
     labels: jax.Array | None = None,
     positions: jax.Array | None = None,
+    use_cache: bool = False,
+    kv_cache=None,  # {"k","v"}: [L, b, max_cache, n_kv, hd] (decode step)
+    cache_index: jax.Array | None = None,
+    max_cache_len: int | None = None,
 ):
     c = config
     b, s = input_ids.shape
@@ -203,13 +229,27 @@ def mixtral_apply(
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_frequencies(c.head_dim, c.max_position_embeddings, c.rope_theta)
 
-    x = params["embed_tokens"][input_ids]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
-
     from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 
     pp_mesh = active_pipeline_mesh()
-    if pp_mesh is not None:
+    if kv_cache is not None:
+        return _mixtral_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
+
+    x = params["embed_tokens"][input_ids]
+    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+
+    caches = None
+    if use_cache:
+        max_cache = int(max_cache_len or c.max_position_embeddings)
+        if not (s <= max_cache <= c.max_position_embeddings):
+            raise ValueError(
+                f"max_cache_len {max_cache} must be in [{s} (prompt length), "
+                f"{c.max_position_embeddings} (max_position_embeddings)]"
+            )
+        x, aux_total, caches = _mixtral_prefill(
+            c, params["layers"], x, cos, sin, positions, attention_mask, max_cache
+        )
+    elif pp_mesh is not None:
         # GPipe with the aux accumulator: routing/capacity statistics are
         # per-microbatch (standard MoE x pipeline semantics), so aux_loss
         # is the microbatch mean rather than the whole-batch statistic
@@ -241,12 +281,90 @@ def mixtral_apply(
     logits = dense(x, params["lm_head"])
     logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
 
-    out = ModelOutput(logits=logits, aux_loss=aux_total / c.num_hidden_layers)
+    if aux_total is None and labels is not None:
+        # pp prefill has no aux channel; a silent aux-less "loss" would
+        # diverge from the uncached forward on identical inputs
+        raise ValueError(
+            "use_cache=True with labels over a pp>1 mesh cannot fold the "
+            "router aux statistic into the loss; compute the training loss "
+            "without use_cache (prefill serves decoding)"
+        )
+    out = ModelOutput(
+        logits=logits,
+        aux_loss=(jnp.asarray(0.0, jnp.float32) if aux_total is None
+                  else aux_total / c.num_hidden_layers),
+    )
+    if caches is not None:
+        out["kv_cache"] = caches
     if labels is not None:
         lm_loss = cross_entropy_loss(logits[:, :-1, :], labels[:, 1:])
         out["lm_loss"] = lm_loss
         out["loss"] = lm_loss + c.router_aux_loss_coef * out["aux_loss"]
     return out
+
+
+def _mixtral_prefill(c, layers, x, cos, sin, positions, attention_mask, max_cache):
+    """Forward that also fills the attention K/V cache. On a pp=1 mesh the
+    plain scan additionally accumulates the router aux statistic (so
+    ``loss`` with ``use_cache=True`` matches the uncached forward exactly);
+    over a pp mesh the fill rides :func:`parallel.pipeline.prefill_stack`,
+    which has no aux channel — ``aux_total`` is returned as None and the
+    caller refuses to fold it into a training loss."""
+    from ..parallel.pipeline import active_pipeline_mesh, prefill_stack
+
+    b, s, _ = x.shape
+    pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+
+    if active_pipeline_mesh() is None:
+
+        def body(carry, layer):
+            h, aux_sum = carry
+            h, aux, (k, v) = mixtral_layer_apply(
+                c, layer, h, cos, sin, positions, attention_mask, return_kv=True
+            )
+            return (h, aux_sum + aux), (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        (x, aux_total), (kc, vc) = jax.lax.scan(
+            body, (x, jnp.asarray(0.0, jnp.float32)), layers
+        )
+        return x, aux_total, {"k": kc, "v": vc}
+
+    has_mask = attention_mask is not None
+    ops = (positions,) + ((attention_mask,) if has_mask else ()) + (cos, sin)
+
+    def prefill_layer(layer, h, pos_b, *rest):
+        mask_b = rest[0] if has_mask else None
+        out, _aux, (k, v) = mixtral_layer_apply(
+            c, layer, h, rest[-2], rest[-1], pos_b, mask_b, return_kv=True
+        )
+        return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, caches = prefill_stack(
+        prefill_layer, layers, x,
+        (c.num_hidden_layers, b, max_cache, c.num_key_value_heads, c.head_dim),
+        broadcast=ops,
+    )
+    return x, None, caches
+
+
+def _mixtral_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
+    """One cached decode step (s == 1 token per row at ``cache_index[b]``);
+    the layer loop is owned by :func:`parallel.pipeline.decode_stack`."""
+    from ..parallel.pipeline import decode_stack
+
+    b, s = input_ids.shape
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
+    x = params["embed_tokens"][input_ids]
+
+    x, kv = decode_stack(
+        lambda layer, h, kc_l, vc_l, idx_b, cos_b, sin_b, pp_manual: _mixtral_decode_layer(
+            c, layer, h, kc_l, vc_l, cos_b, sin_b, idx_b, pp_manual=pp_manual
+        ),
+        params["layers"], kv_cache, x, broadcast=(idx, cos, sin),
+    )
+    x = rms_norm(x, params["norm"], c.rms_norm_eps)
+    logits = dense(x, params["lm_head"])
+    return ModelOutput(logits=logits, kv_cache=kv)
 
 
 class MixtralForCausalLM:
@@ -278,4 +396,5 @@ class MixtralForCausalLM:
             name="MixtralForCausalLM",
         )
         model.config = config
+        model.supports_kv_cache = True
         return model

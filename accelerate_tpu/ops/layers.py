@@ -94,6 +94,137 @@ def cross_entropy_loss(
     return nll.sum() / jnp.maximum(valid.sum(), 1)
 
 
+def shift_labels(labels: jax.Array, ignore_index: int = -100) -> jax.Array:
+    """Causal next-token targets WITHOUT slicing the sequence: position t's
+    target is token t+1, and the final position is masked with
+    ``ignore_index``. Keeping the sequence length unchanged (vs the
+    ``logits[:, :-1] / labels[:, 1:]`` formulation) preserves nice
+    power-of-two token counts for :func:`fused_cross_entropy` chunking."""
+    b, s = labels.shape
+    pad = jnp.full((b, 1), ignore_index, dtype=labels.dtype)
+    return jnp.concatenate([labels[:, 1:], pad], axis=1)
+
+
+def fused_cross_entropy(
+    x: jax.Array,  # [b, s, h] final hidden states (pre-head)
+    head: jax.Array,  # [h, vocab]
+    labels: jax.Array,  # [b, s] int; -100 = ignore (already shifted)
+    ignore_index: int = -100,
+    chunk_tokens: int = 1024,
+    dense_fn=None,
+) -> jax.Array:
+    """Token CE computed from pre-head hidden states without ever holding
+    the full ``[b, s, vocab]`` logits: the head matmul + fp32 log-softmax
+    run one sequence chunk at a time under ``lax.scan`` +
+    ``jax.checkpoint``, so forward AND backward materialise only
+    ``~chunk_tokens × vocab`` at once. The backward pass recomputes each
+    chunk's logits and the scan transpose accumulates the head gradient
+    across chunks — the standard fused-CE memory/FLOPs trade that unlocks
+    larger per-chip batches (the [b,s,V] buffer, not the matmul, is what
+    capped them).
+
+    Numerically identical to ``cross_entropy_loss(dense_fn(x, head),
+    labels)`` (same fp32 log-softmax, same masked mean).
+    """
+    if dense_fn is None:
+        dense_fn = jnp.matmul
+    b, s, h = x.shape
+
+    # largest divisor of s giving chunks of >= ~chunk_tokens tokens; C == 1
+    # (e.g. tiny test shapes) degenerates to the plain single-shot loss
+    rows = max(1, chunk_tokens // b)
+    C = 1
+    for c in range(1, s + 1):
+        if s % c == 0 and s // c >= rows:
+            C = c
+    if C == 1:
+        return cross_entropy_loss(dense_fn(x, head), labels, ignore_index)
+
+    xc = jnp.moveaxis(x.reshape(b, C, s // C, h), 1, 0)  # [C, b, s/C, h]
+    lc = jnp.moveaxis(labels.reshape(b, C, s // C), 1, 0)
+
+    def chunk_fn(x_i, l_i):
+        logits = dense_fn(x_i, head).astype(jnp.float32)  # [b, s/C, V]
+        valid = l_i != ignore_index
+        safe = jnp.where(valid, l_i, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return ((logz - gold) * valid).sum(), valid.sum()
+
+    def body(carry, xs):
+        nll, cnt = carry
+        d_nll, d_cnt = jax.checkpoint(chunk_fn)(*xs)
+        return (nll + d_nll, cnt + d_cnt), None
+
+    (nll, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xc, lc)
+    )
+    return nll / jnp.maximum(count, 1)
+
+
+def write_kv_cache(k_cache_l, v_cache_l, k, v, idx, pin_replicated: bool = False):
+    """Append one decode step's K/V (``[b, 1, n_kv, hd]``) at each row's own
+    cache position ``idx[b]`` — the single owner of the decode scatter every
+    causal family shares. ``pin_replicated`` constrains the scatter operands
+    replicated over the AUTO mesh axes: under a shard_map manual over
+    ``pp``, GSPMD's scatter partitioner check-fails when it tries to
+    tp-shard the cache update, and decode tensors are tiny."""
+    if pin_replicated:
+        from jax.sharding import PartitionSpec
+
+        def _pin(t):
+            try:
+                return jax.lax.with_sharding_constraint(t, PartitionSpec())
+            except Exception:  # no mesh context (bare single device)
+                return t
+
+        k, v = _pin(k), _pin(v)
+        k_cache_l, v_cache_l = _pin(k_cache_l), _pin(v_cache_l)
+    rows = jnp.arange(k.shape[0])
+    idx = jnp.asarray(idx, jnp.int32).reshape(k.shape[0])
+    k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
+    v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
+    return k_cache_l, v_cache_l
+
+
+def rope_cached_attention_block(
+    layer, x, k_cache_l, v_cache_l, cos, sin, idx,
+    n_heads: int, n_kv_heads: int, head_dim: int, eps: float,
+    pp_manual: bool = False,
+):
+    """The decode-step attention sub-block shared by the llama-style
+    families (llama, mixtral): RMSNorm → q/k/v projections → RoPE at each
+    row's cache position → cache append → cached attention → output
+    projection residual. gpt2 keeps its own (LayerNorm, fused QKV, learned
+    positions). Returns ``(x + attn_out, kc_l, vc_l)``; ``pp_manual``: see
+    :func:`write_kv_cache`."""
+    from .fp8 import dense
+
+    b, s, _ = x.shape
+    positions = idx[:, None]  # [b, 1]
+    y = rms_norm(x, layer["attn_norm"], eps)
+    q = apply_rope(
+        dense(y, layer["wq"]).reshape(b, s, n_heads, head_dim), cos, sin, positions
+    )
+    k = apply_rope(
+        dense(y, layer["wk"]).reshape(b, s, n_kv_heads, head_dim), cos, sin, positions
+    )
+    v = dense(y, layer["wv"]).reshape(b, s, n_kv_heads, head_dim)
+    if pp_manual:
+        from jax.sharding import PartitionSpec
+
+        try:
+            q = jax.lax.with_sharding_constraint(q, PartitionSpec())
+        except Exception:  # no mesh context (bare single device)
+            pass
+    k_cache_l, v_cache_l = write_kv_cache(
+        k_cache_l, v_cache_l, k, v, idx, pin_replicated=pp_manual
+    )
+    attn = cached_attention(q, k_cache_l, v_cache_l, idx)
+    x = x + dense(attn.reshape(b, s, n_heads * head_dim), layer["wo"])
+    return x, k_cache_l, v_cache_l
+
+
 def cached_attention(q, k_cache, v_cache, idx):
     """Single-token attention against a KV cache with per-row valid prefix.
 

@@ -217,6 +217,198 @@ def pipeline_layer_stack(
     )
 
 
+def _validate_layer_stack(stage_params, nstages: int, axis: str) -> None:
+    """Stacked-layer shape agreement + stage divisibility (shared by the
+    training and generation pipeline engines)."""
+    layer_lens = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if len(layer_lens) > 1:
+        raise ValueError(
+            f"stage_params leaves disagree on the stacked layer axis "
+            f"(leading dims {sorted(layer_lens)}); every leaf must share "
+            f"the same [layers] leading axis"
+        )
+    for n_layers in layer_lens:
+        if n_layers % nstages != 0:
+            raise ValueError(
+                f"stacked layer axis of length {n_layers} must divide "
+                f"evenly into {axis}={nstages} pipeline stages"
+            )
+
+
+def pipeline_cached_stack(
+    stage_fn: Callable,
+    stage_params,
+    kv_cache: tuple,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    broadcast: tuple = (),
+):
+    """Run a layer stack with STAGE-LOCAL KV caches over the ``pp`` axis —
+    the generation (prefill/decode) counterpart of :func:`gpipe`.
+
+    Training pipelining wants microbatch overlap; cached generation wants
+    the cache to stay where its layers live. This engine runs the classic
+    single-microbatch tick chain: every stage applies its local layers each
+    tick, activations hop forward over ``ppermute``, and each stage commits
+    its cache update only at ITS tick (``t == stage``), when the activation
+    reaching it is the real one. The K/V cache never leaves its stage —
+    decode moves one ``[b, 1, h]`` activation across ICI per hop instead of
+    all-gathering ``layers/S`` weight shards per token (reference-side
+    analog: PiPPy serves generation by feeding microbatches through stages,
+    ``inference.py:99-122``).
+
+    Args:
+      stage_fn: ``(local_layers, local_k, local_v, x, *broadcast) ->
+        (y, new_local_k, new_local_v)`` — applies this stage's layer slice,
+        returning updated local caches (same shapes).
+      stage_params: ``[L, ...]`` pytree split over ``axis`` like gpipe.
+      kv_cache: ``(k, v)`` arrays ``[L, b, ...]`` split over ``axis`` on
+        dim 0 (zeros for prefill).
+      x: activations entering stage 0 (already embedded).
+      broadcast: operands handed to every stage call unchanged.
+
+    Returns ``(y, (k, v))``: last-stage output replicated over ``axis``,
+    caches still split over it.
+    """
+    nstages = dict(mesh.shape).get(axis, 1)
+    k_cache, v_cache = kv_cache
+    if nstages <= 1:
+        y, k2, v2 = stage_fn(stage_params, k_cache, v_cache, x, *broadcast)
+        return y, (k2, v2)
+    _validate_layer_stack(stage_params, nstages, axis)
+
+    fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
+    back_perm = [(i + 1, i) for i in range(nstages - 1)]
+    # On TPU, skip the ticks where this stage's activation hasn't arrived
+    # yet (lax.cond): the predicate is uniform across the auto axes (tp/dp
+    # peers share the pp coordinate), so auto-axis collectives inside the
+    # branch stay uniform, and inactive stages idle instead of computing
+    # discarded work. XLA:CPU's collective rendezvous stalls on the
+    # branch-gated collectives, so the CPU debug backend computes every
+    # tick and masks with `where` — same results, correctness-only backend.
+    use_cond = jax.devices()[0].platform != "cpu"
+
+    def body(local_params, kc, vc, x, *broadcast_ops):
+        stage = jax.lax.axis_index(axis)
+
+        def tick(carry, t):
+            state, kc, vc, out = carry
+            active = t == stage
+
+            def run(args):
+                state, kc, vc = args
+                return stage_fn(local_params, kc, vc, state, *broadcast_ops)
+
+            def skip(args):
+                return args
+
+            if use_cond:
+                y, kc, vc = jax.lax.cond(active, run, skip, (state, kc, vc))
+            else:
+                y, kc_new, vc_new = run((state, kc, vc))
+                kc = jnp.where(active, kc_new, kc)
+                vc = jnp.where(active, vc_new, vc)
+            out = jnp.where(active & (stage == nstages - 1), y, out)
+            state = jax.lax.ppermute(y, axis, fwd_perm)
+            return (state, kc, vc, out), None
+
+        (_, kc, vc, out), _ = jax.lax.scan(
+            tick, (x, kc, vc, jnp.zeros_like(x)), jnp.arange(nstages)
+        )
+        # replicate the last stage's output backward (same ppermute chain
+        # rationale as gpipe: psum's reduction region trips XLA:CPU's
+        # AllReducePromotion under check_vma=False)
+        for _ in range(nstages - 1):
+            incoming = jax.lax.ppermute(out, axis, back_perm)
+            out = jnp.where(stage == nstages - 1, out, incoming)
+        return out, kc, vc
+
+    n_b = len(broadcast)
+    y, k2, v2 = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()) + (P(),) * n_b,
+        out_specs=(P(), P(axis), P(axis)),
+        axis_names={axis},
+        check_vma=False,
+    )(stage_params, k_cache, v_cache, x, *broadcast)
+    return y, (k2, v2)
+
+
+def decode_stack(decode_layer_fn: Callable, layers, kv_cache: dict, x: jax.Array,
+                 *, broadcast: tuple = ()):
+    """Run a per-layer cached decode over the whole stack — plain
+    ``lax.scan`` on a pp=1 mesh, :func:`pipeline_cached_stack` otherwise.
+    The one owner of the "scan decode layer over (layers, k, v)" wrapper
+    every causal family shares.
+
+    ``decode_layer_fn(layer, h, kc_l, vc_l, *broadcast, pp_manual=...) ->
+    (h, kc_l, vc_l)`` applies one UNstacked layer; ``pp_manual`` tells it
+    the call runs inside the pp-manual shard_map (see the models'
+    ``write_kv_cache`` pinning). Returns ``(h, {"k": ..., "v": ...})``.
+    """
+    mesh = active_pipeline_mesh()
+    if mesh is None:
+
+        def body(h, xs):
+            layer, kc_l, vc_l = xs
+            h, kc_l, vc_l = decode_layer_fn(layer, h, kc_l, vc_l, *broadcast, pp_manual=False)
+            return h, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(body, x, (layers, kv_cache["k"], kv_cache["v"]))
+        return x, {"k": kc, "v": vc}
+
+    def stage_fn(local_layers, kc, vc, h, *ops):
+        def body(carry, xs):
+            layer, kc_l, vc_l = xs
+            h2, kc_l, vc_l = decode_layer_fn(layer, carry, kc_l, vc_l, *ops, pp_manual=True)
+            return h2, (kc_l, vc_l)
+
+        y, (kc2, vc2) = jax.lax.scan(body, h, (local_layers, kc, vc))
+        return y, kc2, vc2
+
+    x, (kc, vc) = pipeline_cached_stack(
+        stage_fn, layers, (kv_cache["k"], kv_cache["v"]), x, mesh=mesh, broadcast=broadcast
+    )
+    return x, {"k": kc, "v": vc}
+
+
+def prefill_stack(prefill_layer_fn: Callable, layers, x: jax.Array,
+                  cache_shape: tuple, *, broadcast: tuple = ()):
+    """Forward the stack while collecting each layer's (padded) K/V — the
+    prefill counterpart of :func:`decode_stack`.
+
+    ``prefill_layer_fn(layer, h, *broadcast) -> (h, (k_pad, v_pad))``
+    applies one UNstacked layer and returns its cache row already padded
+    to ``cache_shape[2:]``. Returns ``(h, {"k": ..., "v": ...})`` with
+    caches ``cache_shape`` = ``[L, b, max_cache, n_kv, hd]``.
+    """
+    mesh = active_pipeline_mesh()
+    if mesh is None:
+
+        def body(h, layer):
+            return prefill_layer_fn(layer, h, *broadcast)
+
+        x, (kc, vc) = jax.lax.scan(body, x, layers)
+        return x, {"k": kc, "v": vc}
+
+    cache0 = jnp.zeros(cache_shape, x.dtype)
+
+    def stage_fn(local_layers, kc, vc, h, *ops):
+        def body(h, layer):
+            return prefill_layer_fn(layer, h, *ops)
+
+        y, (knew, vnew) = jax.lax.scan(body, h, local_layers)
+        return y, knew, vnew
+
+    x, (kc, vc) = pipeline_cached_stack(
+        stage_fn, layers, (cache0, cache0), x, mesh=mesh, broadcast=broadcast
+    )
+    return x, {"k": kc, "v": vc}
+
+
 def gpipe(
     stage_fn: Callable,
     stage_params,
@@ -263,19 +455,7 @@ def gpipe(
     nstages = dict(mesh.shape).get(axis, 1)
     if nstages <= 1:
         return stage_fn(stage_params, x, *aligned, *broadcast)
-    layer_lens = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
-    if len(layer_lens) > 1:
-        raise ValueError(
-            f"stage_params leaves disagree on the stacked layer axis "
-            f"(leading dims {sorted(layer_lens)}); every leaf must share "
-            f"the same [layers] leading axis"
-        )
-    for n_layers in layer_lens:
-        if n_layers % nstages != 0:
-            raise ValueError(
-                f"stacked layer axis of length {n_layers} must divide "
-                f"evenly into {axis}={nstages} pipeline stages"
-            )
+    _validate_layer_stack(stage_params, nstages, axis)
     b = x.shape[0]
     m = pipeline_microbatches(b, num_microbatches, nstages)
     mb = b // m

@@ -1,0 +1,100 @@
+"""Dev ablation: where does the seq-1024 train step spend its time?
+Times (a) fwd loss only, (b) fwd+bwd, (c) full step, under flash vs
+blockwise attention and with/without the fused CE path. One subprocess
+per point (clean HBM)."""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one(mode, attn_impl):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.ops.attention import AttentionContext, set_attention_context
+    from accelerate_tpu.mesh import single_device_mesh
+
+    set_attention_context(AttentionContext(mesh=single_device_mesh(), impl=attn_impl))
+
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=1024, remat="dots_saveable",
+    )
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 32000, size=(8, 1024)).astype(np.int32))
+
+    def cast(p):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
+        )
+
+    def loss_fn(p, ids):
+        return model.apply_fn(cast(p), input_ids=ids, labels=ids)["loss"].astype(jnp.float32)
+
+    params = model.params
+    if mode == "fwd":
+        fn = jax.jit(loss_fn)
+        def step():
+            return fn(params, ids)
+    elif mode == "fwdbwd":
+        def vg(p, i):
+            loss, grads = jax.value_and_grad(loss_fn)(p, i)
+            # force the whole backward: fold every grad into the scalar
+            return loss + sum(jnp.sum(g).astype(jnp.float32) for g in jax.tree.leaves(grads)) * 0.0
+        g = jax.jit(vg)
+        def step():
+            return g(params, ids)
+    else:  # full
+        tx = optax.adamw(1e-4)
+        opt_state = tx.init(params)
+
+        import functools
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train(p, s, i):
+            loss, grads = jax.value_and_grad(loss_fn)(p, i)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            up, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, up), s, loss
+        state = {"p": params, "s": opt_state}
+        def step():
+            state["p"], state["s"], loss = train(state["p"], state["s"], ids)
+            return loss
+
+    for _ in range(2):
+        last = step()
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        last = step()
+    float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 10
+    print(f"RESULT mode={mode} attn={attn_impl} t={t*1000:.1f}ms")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2:
+        _one(sys.argv[1], sys.argv[2])
+        sys.exit(0)
+    import sys as _s
+    points = [("fwdbwd", "flash"), ("fwdbwd", "blockwise")]
+    for mode, impl in points:
+        for attempt in range(2):
+            r = subprocess.run(
+                [sys.executable, __file__, mode, impl],
+                capture_output=True, text=True, timeout=600,
+            )
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            if r.returncode == 0 and out:
+                print(out[0], flush=True)
+                break
+            print(f"retry {mode}/{impl}: {(r.stdout + r.stderr)[-300:]}", flush=True)
+            time.sleep(10)

@@ -1,0 +1,89 @@
+"""Dev sweep: framework train-step throughput vs (bsz, seq, remat) on the
+attached chip. One subprocess per point (clean HBM). Not run by the driver —
+`bench.py` is the recorded artifact; this explores the config space."""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one(bsz, seq, remat):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.mesh import data_sharding
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=seq, remat=remat,
+    )
+    accelerator = Accelerator(mixed_precision="bf16")
+    model, opt = accelerator.prepare(
+        LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
+    )
+    n_params = sum(int(x.size) for x in jax.tree.leaves(model.params))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32000, size=(bsz, seq)).astype(np.int32)
+    sharding = data_sharding(accelerator.mesh)
+    batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in
+             {"input_ids": ids, "labels": ids}.items()}
+
+    def step():
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        return out.loss.force()
+
+    for _ in range(2):
+        last = step()
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        last = step()
+    float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 10
+
+    tokens = bsz * seq
+    attn = 6.0 * config.num_hidden_layers * tokens * seq * config.hidden_size
+    flops = 6.0 * n_params * tokens + attn
+    print(f"RESULT bsz={bsz} seq={seq} remat={remat} t={t*1000:.1f}ms "
+          f"tok/s={tokens/t:.0f} mfu={flops/t/197e12:.4f}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 3:
+        remat = {"0": False, "1": True}.get(sys.argv[3], sys.argv[3])
+        _one(int(sys.argv[1]), int(sys.argv[2]), remat)
+        sys.exit(0)
+    points = [
+        (8, 1024, "dots_saveable"),
+        (16, 1024, "dots_saveable"),
+        (32, 1024, "dots_saveable"),
+        (32, 1024, "1"),
+        (64, 1024, "1"),
+    ]
+    for bsz, seq, remat in points:
+        for attempt in range(3):
+            r = subprocess.run(
+                [sys.executable, __file__, str(bsz), str(seq), str(remat)],
+                capture_output=True, text=True, timeout=1200,
+            )
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            if r.returncode == 0 and out:
+                print(out[0], flush=True)
+                break
+            err = (r.stdout + r.stderr)[-400:]
+            if "RESOURCE_EXHAUSTED" in err or "Out of memory" in err:
+                print(f"OOM bsz={bsz} seq={seq} remat={remat}", flush=True)
+                break
+            print(f"retry {bsz}/{seq}: {err}", flush=True)
+            time.sleep(15)

@@ -401,11 +401,25 @@ def test_llama_pipeline_rejects_cp_combination():
             llama_apply(c, params, ids, labels=ids)
 
 
-def test_llama_pipeline_rejects_kv_cache_generation():
+def test_llama_pipeline_prefill_matches_plain_forward():
+    """KV-cache prefill over a pp mesh (stage-local caches via
+    pipeline_cached_stack) returns the same logits AND the same cache the
+    plain single-device scan produces (round 2 refused this path)."""
     c = LlamaConfig.tiny(layers=2, hidden_size=32, heads=2, seq=64)
     params = init_llama_params(jax.random.PRNGKey(0), c)
     ids = _batch(b=8, s=32)
+
+    plain = llama_apply(c, params, ids, use_cache=True, max_cache_len=48)
+
     mesh = build_mesh(MeshPlugin(dp=4, pp=2))
     with attention_context(mesh=mesh), jax.set_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="KV-cache"):
-            llama_apply(c, params, ids, use_cache=True)
+        piped = jax.jit(
+            lambda p, i: llama_apply(c, p, i, use_cache=True, max_cache_len=48)
+        )(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(piped["logits"]), np.asarray(plain["logits"]), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(piped["kv_cache"]["k"]), np.asarray(plain["kv_cache"]["k"]),
+        rtol=2e-5, atol=2e-5,
+    )
