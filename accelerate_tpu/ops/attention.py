@@ -38,8 +38,11 @@ class AttentionContext:
     batch_axes: tuple[str, ...] = ("dp", "fsdp")
     head_axis: str = "tp"
     impl: Literal["auto", "flash", "blockwise", "reference"] = "auto"
-    block_q: int = 512
-    block_kv: int = 1024
+    #: flash-kernel tile sizes; None = auto (512/1024 at short seq, a
+    #: 1024-row q tile from seq 2048 up — measured +2% train throughput at
+    #: seq 2048 on v5e, benchmarks/ablate_blocks.py). Explicit values win.
+    block_q: int | None = None
+    block_kv: int | None = None
     #: session default for the GPipe microbatch count (0 = auto), carried
     #: here so it travels atomically with the mesh it was configured for
     #: (a new Accelerator swaps mesh + schedule depth together instead of
@@ -90,6 +93,16 @@ def adapt_attention_specs(
     return batch_entry, head_entry
 
 
+def resolve_flash_blocks(seq_len: int, ctx: AttentionContext) -> tuple[int, int]:
+    """Effective (block_q, block_kv) for the flash kernel: the context's
+    explicit values win; auto picks 512 q-rows below seq 2048 and 1024
+    from there (the deeper grid amortises the online-softmax bookkeeping
+    once there are enough kv blocks per q tile)."""
+    block_q = ctx.block_q if ctx.block_q is not None else (1024 if seq_len >= 2048 else 512)
+    block_kv = ctx.block_kv if ctx.block_kv is not None else 1024
+    return block_q, block_kv
+
+
 def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
     """Run the flash kernel under shard_map: batch over dp/fsdp, heads over
     tp, sequence replicated (cp==1 on this path — cp>1 routes to
@@ -103,10 +116,11 @@ def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
     batch_entry, head_entry = adapt_attention_specs(
         shape, b, nh, n_kv, ctx.batch_axes, ctx.head_axis
     )
+    block_q, block_kv = resolve_flash_blocks(q.shape[1], ctx)
     if batch_entry is None and head_entry is None:
         return flash_attention(
             q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
-            block_q=ctx.block_q, block_kv=ctx.block_kv,
+            block_q=block_q, block_kv=block_kv,
         )
 
     qkv_spec = P(batch_entry, None, head_entry, None)
@@ -122,7 +136,7 @@ def _flash_sharded(q, k, v, segment_mask, causal, scale, ctx: AttentionContext):
             q_, k_, v_,
             segment_mask=mask_[0] if mask_ else None,
             causal=causal, scale=scale,
-            block_q=ctx.block_q, block_kv=ctx.block_kv,
+            block_q=block_q, block_kv=block_kv,
         )
 
     args = (q, k, v, segment_mask) if has_mask else (q, k, v)
@@ -164,16 +178,17 @@ def attention(
             # mesh the kernel must run under shard_map with explicit batch /
             # head partitioning — otherwise XLA replicates q,k,v per device.
             return _flash_sharded(q, k, v, segment_mask, causal, scale, ctx)
+        block_q, block_kv = resolve_flash_blocks(q.shape[1], ctx)
         return flash_attention(
             q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
-            block_q=ctx.block_q, block_kv=ctx.block_kv,
+            block_q=block_q, block_kv=block_kv,
         )
     if impl == "blockwise":
         # the pure-JAX fallback has its own sweet spot — the Pallas-tuned
         # kv block would 8x the materialised score tile on CPU
         return blockwise_attention(
             q, k, v, segment_mask=segment_mask, causal=causal, scale=scale,
-            block_kv=min(max(ctx.block_kv, 128), 512),
+            block_kv=min(max(ctx.block_kv or 1024, 128), 512),
         )
     if not causal:
         from .layers import dot_product_attention

@@ -1,0 +1,78 @@
+"""Dev ablation: flash-kernel block sizes for the long-context rows
+(seq 2048/4096). The round-2 tuning targeted seq 1024; deeper sequences
+may want bigger kv blocks."""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one(seq, bq, bkv):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.mesh import data_sharding
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.ops.attention import attention_context
+
+    bsz = max(8 * 1024 // seq, 1)
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=seq, remat="dots_saveable",
+    )
+    accelerator = Accelerator(mixed_precision="bf16")
+    model, opt = accelerator.prepare(
+        LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32000, size=(bsz, seq)).astype(np.int32)
+    sharding = data_sharding(accelerator.mesh)
+    batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in
+             {"input_ids": ids, "labels": ids}.items()}
+
+    with attention_context(block_q=bq, block_kv=bkv):
+        def step():
+            out = model(**batch)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            return out.loss.force()
+
+        for _ in range(2):
+            last = step()
+        float(np.asarray(last))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            last = step()
+        float(np.asarray(last))
+        t = (time.perf_counter() - t0) / 10
+    print(f"RESULT seq={seq} bq={bq} bkv={bkv} t={t*1000:.1f}ms tok/s={bsz*seq/t:.0f}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 3:
+        _one(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
+        sys.exit(0)
+    points = [(2048, 512, 1024), (2048, 1024, 1024), (2048, 512, 2048),
+              (2048, 1024, 2048), (2048, 256, 1024)]
+    if len(sys.argv) > 1 and sys.argv[1] == "4k":
+        points = [(4096, 512, 1024), (4096, 1024, 2048), (4096, 512, 2048)]
+    for seq, bq, bkv in points:
+        for attempt in range(2):
+            r = subprocess.run(
+                [sys.executable, __file__, str(seq), str(bq), str(bkv)],
+                capture_output=True, text=True, timeout=400,
+            )
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            if r.returncode == 0 and out:
+                print(out[0], flush=True)
+                break
+            print(f"retry {seq}/{bq}/{bkv}: {(r.stdout + r.stderr)[-200:]}", flush=True)
+            time.sleep(10)

@@ -1,0 +1,92 @@
+"""Dev ablation: component cost inside the seq-1024 fwd pass. Variants
+monkeypatch one component to a cheap stand-in; the delta vs baseline is
+that component's cost. Numerics are garbage — timing only."""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one(variant):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.ops import layers as L
+    from accelerate_tpu.ops import attention as A
+
+    if variant == "norope":
+        L.apply_rope = lambda x, cos, sin, positions: x
+    elif variant == "nonorm":
+        L.rms_norm = lambda x, w, eps=1e-6: x
+    elif variant == "noattn":
+        A.attention = lambda q, k, v, segment_mask=None, causal=True, scale=None: v.repeat(
+            q.shape[2] // v.shape[2], 2
+        ) if q.shape[2] != v.shape[2] else v
+    elif variant == "sumloss":
+        pass  # handled below
+
+    # import AFTER patching so the model module binds the stand-ins
+    import importlib
+    import accelerate_tpu.models.llama as llama_mod
+    importlib.reload(llama_mod)
+
+    config = llama_mod.LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=1024, remat="dots_saveable",
+    )
+    model = llama_mod.LlamaForCausalLM.from_config(config, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 32000, size=(8, 1024)).astype(np.int32))
+
+    def cast(p):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
+        )
+
+    if variant == "sumloss":
+        def loss_fn(p, i):
+            out = model.apply_fn(cast(p), input_ids=i)
+            return out["logits"].astype(jnp.float32).mean()
+    elif variant == "nohead":
+        def loss_fn(p, i):
+            out = model.apply_fn(cast(p), input_ids=i)
+            # touch only the last position's logits: head matmul shrinks to 8 rows
+            return out["logits"][:, -1, :].astype(jnp.float32).mean()
+    else:
+        def loss_fn(p, i):
+            return model.apply_fn(cast(p), input_ids=i, labels=i)["loss"].astype(jnp.float32)
+
+    fn = jax.jit(loss_fn)
+    params = model.params
+    for _ in range(2):
+        last = fn(params, ids)
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        last = fn(params, ids)
+    float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 10
+    print(f"RESULT variant={variant} t={t*1000:.1f}ms")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        _one(sys.argv[1])
+        sys.exit(0)
+    for variant in ["base", "norope", "nonorm", "noattn", "sumloss", "nohead"]:
+        for attempt in range(2):
+            r = subprocess.run(
+                [sys.executable, __file__, variant],
+                capture_output=True, text=True, timeout=400,
+            )
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            if r.returncode == 0 and out:
+                print(out[0], flush=True)
+                break
+            print(f"retry {variant}: {(r.stdout + r.stderr)[-200:]}", flush=True)
+            time.sleep(10)

@@ -1,0 +1,132 @@
+"""Dev ablation: candidate optimizations for the seq-1024 full train step.
+Variants: bf16 rope, a remat policy that additionally saves named
+rope/swiglu outputs, and their combination."""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one(variant):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.ops import layers as L
+
+    if "bf16rope" in variant:
+        def fast_rope(x, cos, sin, positions):
+            dtype = x.dtype
+            cos = cos[positions][:, :, None, :].astype(dtype)
+            sin = sin[positions][:, :, None, :].astype(dtype)
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+        L.apply_rope = fast_rope
+
+    import importlib
+    import accelerate_tpu.models.llama as llama_mod
+    importlib.reload(llama_mod)
+
+    remat = "dots_saveable"
+    if "savenames" in variant:
+        # tag rope/swiglu outputs; policy saves dots + those names
+        orig_layer_apply = llama_mod.llama_layer_apply
+
+        from jax.ad_checkpoint import checkpoint_name
+
+        def tagged_layer_apply(config, layer, x, cos, sin, positions, attention_mask,
+                               return_kv=False):
+            return orig_layer_apply(config, layer, x, cos, sin, positions,
+                                    attention_mask, return_kv=return_kv)
+
+        # tag inside apply_rope + silu product instead (fewer touch points)
+        base_rope = L.apply_rope
+
+        def rope_tagged(x, cos, sin, positions):
+            return checkpoint_name(base_rope(x, cos, sin, positions), "rope")
+
+        L.apply_rope = rope_tagged
+        importlib.reload(llama_mod)
+
+        import accelerate_tpu.parallel.pipeline as pl
+
+        orig_wrap = pl.remat_wrap
+
+        def tuned_wrap(body, remat_arg):
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_saveable,
+                jax.checkpoint_policies.save_only_these_names("rope"),
+            )
+            return jax.checkpoint(body, prevent_cse=False, policy=policy)
+
+        pl.remat_wrap = tuned_wrap
+        llama_mod.remat_wrap = tuned_wrap
+
+    config = llama_mod.LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=1024, remat=remat,
+    )
+    model = llama_mod.LlamaForCausalLM.from_config(config, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 32000, size=(8, 1024)).astype(np.int32))
+
+    def cast(p):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p
+        )
+
+    def loss_fn(p, i):
+        return model.apply_fn(cast(p), input_ids=i, labels=i)["loss"].astype(jnp.float32)
+
+    tx = optax.adamw(1e-4)
+    params = model.params
+    opt_state = tx.init(params)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train(p, s, i):
+        loss, grads = jax.value_and_grad(loss_fn)(p, i)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        up, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    state = {"p": params, "s": opt_state}
+
+    def step():
+        state["p"], state["s"], loss = train(state["p"], state["s"], ids)
+        return loss
+
+    for _ in range(2):
+        last = step()
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        last = step()
+    float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 10
+    print(f"RESULT variant={variant} t={t*1000:.1f}ms tok/s={8*1024/t:.0f}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        _one(sys.argv[1])
+        sys.exit(0)
+    for variant in ["full", "bf16rope", "savenames", "bf16rope+savenames"]:
+        for attempt in range(2):
+            r = subprocess.run(
+                [sys.executable, __file__, variant],
+                capture_output=True, text=True, timeout=400,
+            )
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            if r.returncode == 0 and out:
+                print(out[0], flush=True)
+                break
+            print(f"retry {variant}: {(r.stdout + r.stderr)[-300:]}", flush=True)
+            time.sleep(10)
