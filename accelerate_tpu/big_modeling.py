@@ -358,7 +358,7 @@ class DispatchedModel:
         disk→host→HBM; they stay :class:`QTensor`s here and the segment's
         compiled fn dequantizes in-kernel (fused into the consuming matmul —
         no materialised full-precision copy)."""
-        from .utils.quantization import QTensor
+        from .utils.quantization import Q4Tensor, QTensor
 
         out = {}
         for entry in paths:
@@ -366,10 +366,21 @@ class DispatchedModel:
             try:
                 out[p] = self._fetch_one(p, idx)
             except KeyError:
-                out[p] = QTensor(
-                    self._fetch_one(f"{p}.q", idx),
-                    self._fetch_one(f"{p}.scale", idx),
-                )
+                try:
+                    out[p] = QTensor(
+                        self._fetch_one(f"{p}.q", idx),
+                        self._fetch_one(f"{p}.scale", idx),
+                    )
+                except KeyError:
+                    # 4-bit leaves: all-array children, path-addressed (the
+                    # [16] codebook is per-tensor, never layer-sliced)
+                    out[p] = Q4Tensor(
+                        self._fetch_one(f"{p}.packed", idx),
+                        self._fetch_one(f"{p}.scale_q", idx),
+                        self._fetch_one(f"{p}.scale_offset", idx),
+                        self._fetch_one(f"{p}.scale_scale", idx),
+                        self._fetch_one(f"{p}.code", None),
+                    )
         return out
 
     def _call_streaming(self, segments, *args, **kwargs):
