@@ -107,6 +107,12 @@ class ProfileContext:
         self.schedule = handler.build_schedule()
         self.step_num = 0
         self._tracing = False
+        if handler.with_flops:
+            # record XLA cost analyses of every compiled step executed
+            # during the session (dumped to flops.json at exit)
+            from .lazy import set_cost_collection
+
+            set_cost_collection(True)
 
     def _maybe_start(self):
         if self.schedule(self.step_num) == "active" and not self._tracing:
@@ -136,6 +142,25 @@ class ProfileContext:
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
+        if self.handler.with_flops:
+            import json as _json
+            import os as _os
+
+            from .lazy import PROFILE_COST_STATS, set_cost_collection
+
+            set_cost_collection(False)
+            # the tracer creates trace_dir only when a window went active
+            _os.makedirs(self.trace_dir, exist_ok=True)
+            with open(_os.path.join(self.trace_dir, "flops.json"), "w") as f:
+                _json.dump(
+                    {
+                        "compiled_programs": PROFILE_COST_STATS,
+                        "total_flops": sum(
+                            s["flops"] for s in PROFILE_COST_STATS if s.get("flops")
+                        ),
+                    },
+                    f,
+                )
 
 
 class Accelerator:

@@ -432,6 +432,60 @@ def is_deferred(x) -> bool:
 _FORCE_CACHE: dict = {}
 _GRAD_CACHE: dict = {}
 
+#: compiled-step cost analyses collected while a profile session with
+#: ``with_flops`` is live (reference wires the flag into torch.profiler,
+#: ``dataclasses.py:487-513``; here the XLA compiler's own cost model is
+#: the source of truth)
+PROFILE_COST_STATS: list = []
+_COLLECT_COSTS = False
+#: (label, signature) → AOT-compiled executable, so each signature compiles
+#: ONCE per collection session (the executable both serves the call and
+#: answers cost_analysis); cleared when a session starts
+_COST_COMPILED: dict = {}
+
+
+def set_cost_collection(enabled: bool) -> None:
+    global _COLLECT_COSTS
+    _COLLECT_COSTS = bool(enabled)
+    if enabled:
+        PROFILE_COST_STATS.clear()
+        _COST_COMPILED.clear()
+
+
+def _cost_aware_jit(fn, donate_argnums=(), label=""):
+    """``jax.jit`` that, while cost collection is on, records the compiled
+    program's XLA cost analysis (flops, bytes accessed) once per signature
+    per session. The AOT executable is kept and serves the calls, so
+    collection never compiles a program twice. Zero overhead when
+    collection is off."""
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+
+    def call(*args):
+        if _COLLECT_COSTS:
+            sig = (label, id(fn)) + tuple(
+                (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+                for l in jax.tree.leaves(args)[:16]
+            )
+            compiled = _COST_COMPILED.get(sig)
+            if compiled is None:
+                try:
+                    compiled = jitted.lower(*args).compile()
+                    stats = compiled.cost_analysis() or {}
+                    PROFILE_COST_STATS.append(
+                        {
+                            "label": label,
+                            "flops": stats.get("flops"),
+                            "bytes_accessed": stats.get("bytes accessed"),
+                        }
+                    )
+                    _COST_COMPILED[sig] = compiled
+                except Exception:  # cost model unavailable on this backend
+                    return jitted(*args)
+            return compiled(*args)
+        return jitted(*args)
+
+    return call
+
 
 def clear_caches():
     _FORCE_CACHE.clear()
@@ -450,7 +504,7 @@ def force_value(deferred: Deferred):
             env = {id(m): p for m, p in zip(models, model_params)}
             return replay(root, input_values, env)
 
-        entry = (jax.jit(fn), models)
+        entry = (_cost_aware_jit(fn, label="forward"), models)
         _FORCE_CACHE[key] = entry
     jitted, cached_models = entry
     params = [m.params for m in cached_models]
@@ -483,7 +537,7 @@ def grad_fn_for(loss: Deferred, trainable_models: list, loss_scale: float = 1.0)
             return (unscaled / loss_scale), unscaled
 
         vag = jax.value_and_grad(loss_fn, argnums=0, has_aux=True)
-        entry = (jax.jit(vag), trainables, frozen)
+        entry = (_cost_aware_jit(vag, label="grad"), trainables, frozen)
         _GRAD_CACHE[key] = entry
     jitted, trainables, frozen = entry
     return jitted, trainables, frozen, inputs
@@ -566,7 +620,7 @@ def fused_step_fn_for(
                 new_opt_state = keep(new_opt_state, opt_state)
             return new_params, new_opt_state, loss_value, norm, step_ok
 
-        entry = (jax.jit(step, donate_argnums=(0, 1)), frozen)
+        entry = (_cost_aware_jit(step, donate_argnums=(0, 1), label="fused_step"), frozen)
         _FUSED_CACHE[key] = entry
     jitted, frozen = entry
     return jitted, frozen, inputs

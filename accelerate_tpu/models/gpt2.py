@@ -172,21 +172,18 @@ def gpt2_apply(
                 f"{c.max_position_embeddings} (max_position_embeddings)]"
             )
 
-        from ..parallel.pipeline import prefill_stack
+        from ..parallel.pipeline import prefill_layer_stack
 
         pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
-        has_mask = attention_mask is not None
-        ops = (attention_mask,) if has_mask else ()
 
-        def prefill_layer(layer, h, *rest):
-            mask_b = rest[0] if has_mask else None
+        def prefill_layer(layer, h, pos_b, mask_b):
             out, (k, v) = gpt2_layer_apply(c, layer, h, mask_b, return_kv=True)
             return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-        x, caches = prefill_stack(
+        x, caches = prefill_layer_stack(
             prefill_layer, params["layers"], x,
             (c.num_hidden_layers, b, max_cache, c.num_attention_heads, c.head_dim),
-            broadcast=ops,
+            mask=attention_mask,
         )
     elif pp_mesh is not None:
         # GPipe over the pp axis: positions are already folded into x at

@@ -268,23 +268,20 @@ def llama_apply(
                 "above it RoPE tables would silently clamp"
             )
 
-        from ..parallel.pipeline import prefill_stack
+        from ..parallel.pipeline import prefill_layer_stack
 
         pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
-        has_mask = attention_mask is not None
-        ops = (positions,) + ((attention_mask,) if has_mask else ()) + (cos, sin)
 
-        def prefill_layer(layer, h, pos_b, *rest):
-            mask_b = rest[0] if has_mask else None
+        def prefill_layer(layer, h, pos_b, mask_b, cos_b, sin_b):
             out, (k, v) = llama_layer_apply(
-                c, layer, h, rest[-2], rest[-1], pos_b, mask_b, return_kv=True
+                c, layer, h, cos_b, sin_b, pos_b, mask_b, return_kv=True
             )
             return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-        x, caches = prefill_stack(
+        x, caches = prefill_layer_stack(
             prefill_layer, params["layers"], x,
             (c.num_hidden_layers, b, max_cache, c.num_key_value_heads, c.head_dim),
-            broadcast=ops,
+            positions=positions, mask=attention_mask, rope=(cos, sin),
         )
     else:
         pp_mesh = _pipeline_mesh()

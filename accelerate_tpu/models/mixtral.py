@@ -310,7 +310,7 @@ def _mixtral_prefill(c, layers, x, cos, sin, positions, attention_mask, max_cach
     over a pp mesh the fill rides :func:`parallel.pipeline.prefill_stack`,
     which has no aux channel — ``aux_total`` is returned as None and the
     caller refuses to fold it into a training loss."""
-    from ..parallel.pipeline import active_pipeline_mesh, prefill_stack
+    from ..parallel.pipeline import active_pipeline_mesh
 
     b, s, _ = x.shape
     pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
@@ -329,20 +329,18 @@ def _mixtral_prefill(c, layers, x, cos, sin, positions, attention_mask, max_cach
         )
         return x, aux_total, {"k": kc, "v": vc}
 
-    has_mask = attention_mask is not None
-    ops = (positions,) + ((attention_mask,) if has_mask else ()) + (cos, sin)
+    from ..parallel.pipeline import prefill_layer_stack
 
-    def prefill_layer(layer, h, pos_b, *rest):
-        mask_b = rest[0] if has_mask else None
+    def prefill_layer(layer, h, pos_b, mask_b, cos_b, sin_b):
         out, _aux, (k, v) = mixtral_layer_apply(
-            c, layer, h, rest[-2], rest[-1], pos_b, mask_b, return_kv=True
+            c, layer, h, cos_b, sin_b, pos_b, mask_b, return_kv=True
         )
         return out, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-    x, caches = prefill_stack(
+    x, caches = prefill_layer_stack(
         prefill_layer, layers, x,
         (c.num_hidden_layers, b, max_cache, c.num_key_value_heads, c.head_dim),
-        broadcast=ops,
+        positions=positions, mask=attention_mask, rope=(cos, sin),
     )
     return x, None, caches
 

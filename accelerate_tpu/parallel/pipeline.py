@@ -409,6 +409,28 @@ def prefill_stack(prefill_layer_fn: Callable, layers, x: jax.Array,
     return x, {"k": kc, "v": vc}
 
 
+def prefill_layer_stack(layer_fn: Callable, layers, x: jax.Array,
+                        cache_shape: tuple, *, positions=None, mask=None,
+                        rope: tuple = ()):
+    """Convention-owning wrapper over :func:`prefill_stack` (the prefill
+    analog of :func:`pipeline_layer_stack`): models hand over their
+    operands once and ``layer_fn(layer, h, positions, mask, *rope) ->
+    (h, (k_pad, v_pad))`` receives them positionally inside any backend —
+    no per-family packing/unpacking of the broadcast tuple to keep in
+    sync. ``positions``/``mask`` may be None."""
+    has_pos = positions is not None
+    has_mask = mask is not None
+    ops = tuple(o for o in (positions, mask) if o is not None) + tuple(rope)
+
+    def fn(layer, h, *rest):
+        pos_b = rest[0] if has_pos else None
+        mask_b = rest[int(has_pos)] if has_mask else None
+        rope_ops = rest[int(has_pos) + int(has_mask):]
+        return layer_fn(layer, h, pos_b, mask_b, *rope_ops)
+
+    return prefill_stack(fn, layers, x, cache_shape, broadcast=ops)
+
+
 def gpipe(
     stage_fn: Callable,
     stage_params,
