@@ -73,6 +73,141 @@ def check_epoch_reshuffle(accelerator):
     accelerator.print("seedable epoch reshuffle ok")
 
 
+def verify_dataloader_batch_sizes(accelerator, dataset_size, batch_size,
+                                  expected_sizes, even_batches=True):
+    """Port of the reference's core helper
+    (``test_distributed_data_loop.py:101-120``): the per-iteration batch
+    sizes must exactly match expectation for this (size, bs, even) cell."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    dl = prepare_data_loader(
+        _Loader(_RangeDataset(dataset_size), batch_size),
+        even_batches=even_batches,
+        put_on_device=False,
+    )
+    sizes = [len(np.atleast_1d(b["x"])) for b in dl]
+    assert sizes == expected_sizes, (
+        dataset_size, batch_size, even_batches, sizes, expected_sizes,
+    )
+
+
+def check_even_batch_matrix(accelerator):
+    """The end-of-loader size matrix (reference
+    ``test_default_ensures_even_batch_sizes`` +
+    ``test_can_disable_even_batches``)."""
+    n = max(accelerator.state.data_parallel_size, 1)
+    if n == 1:
+        verify_dataloader_batch_sizes(accelerator, 32, 8, [8, 8, 8, 8])
+        # even_batches wraps the tail to a FULL batch even single-shard —
+        # static shapes, no tail recompile (gather_for_metrics drops the
+        # wrapped duplicates); disabling it yields the true remainder
+        verify_dataloader_batch_sizes(accelerator, 30, 8, [8, 8, 8, 8])
+        verify_dataloader_batch_sizes(
+            accelerator, 30, 8, [8, 8, 8, 6], even_batches=False
+        )
+    else:
+        # every shard sees equal batch counts; with even_batches the tail
+        # wraps to full size, without it the global tail splits unevenly
+        from accelerate_tpu.data_loader import prepare_data_loader
+
+        dl = prepare_data_loader(
+            _Loader(_RangeDataset(n * 8 + 2), 8), put_on_device=False
+        )
+        sizes = [len(np.atleast_1d(b["x"])) for b in dl]
+        assert all(s == sizes[0] for s in sizes), sizes
+    accelerator.print("even-batch matrix ok")
+
+
+def check_join_uneven_inputs(accelerator):
+    """``join_uneven_inputs`` lets ranks run different iteration counts
+    (reference ``test_can_join_uneven_inputs`` /
+    ``test_join_can_override_even_batches``)."""
+    from accelerate_tpu.modules import Model
+
+    import jax.numpy as jnp
+
+    model = Model(lambda p, x: {"logits": x * p["w"]}, {"w": jnp.ones(())}, name="m")
+    prepared = accelerator.prepare(model)
+    steps = 3 + accelerator.process_index  # deliberately uneven
+    with accelerator.join_uneven_inputs([prepared]):
+        for _ in range(steps):
+            out = prepared(jnp.ones((2, 1)))
+    accelerator.wait_for_everyone()
+    accelerator.print("join uneven inputs ok")
+
+
+def check_iterable_dispatch(accelerator):
+    """IterableDataset through the dispatcher: rank 0's stream feeds every
+    process (reference ``DataLoaderDispatcher`` tests)."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    class _Stream:
+        def __iter__(self):
+            for i in range(12):
+                yield {"x": np.float32(i)}
+
+    class _IterLoader:
+        def __init__(self):
+            self.dataset = _Stream()
+            self.batch_size = 4
+            self.drop_last = False
+            self.sampler = self.batch_sampler = self.collate_fn = None
+
+    dl = prepare_data_loader(_IterLoader(), dispatch_batches=True, put_on_device=False)
+    seen = []
+    for batch in dl:
+        seen.extend(np.atleast_1d(np.asarray(batch["x"])).tolist())
+    assert len(seen) >= 12 // max(accelerator.num_processes, 1), seen
+    accelerator.print("iterable dispatch ok")
+
+
+def check_stateful_resume(accelerator):
+    """Loader ``state_dict``/``load_state_dict`` mid-epoch round-trip
+    (reference ``test_stateful_dataloader`` /
+    ``test_stateful_dataloader_save_state``)."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    dl = prepare_data_loader(_Loader(_RangeDataset(32), 8), put_on_device=False)
+    it = iter(dl)
+    first = np.atleast_1d(np.asarray(next(it)["x"])).tolist()
+    state = dl.state_dict()
+
+    rest = [np.atleast_1d(np.asarray(b["x"])).tolist() for b in it]
+
+    dl2 = prepare_data_loader(_Loader(_RangeDataset(32), 8), put_on_device=False)
+    dl2.load_state_dict(state)
+    resumed = [np.atleast_1d(np.asarray(b["x"])).tolist() for b in dl2]
+    assert resumed == rest, (resumed, rest)
+    accelerator.print("stateful resume ok")
+
+
+def check_skip_first_batches(accelerator):
+    from accelerate_tpu.data_loader import prepare_data_loader, skip_first_batches
+
+    dl = prepare_data_loader(_Loader(_RangeDataset(32), 8), put_on_device=False)
+    full = [np.atleast_1d(np.asarray(b["x"])).tolist() for b in dl]
+    skipped = skip_first_batches(dl, 2)
+    tail = [np.atleast_1d(np.asarray(b["x"])).tolist() for b in skipped]
+    assert tail == full[2:], (tail, full[2:])
+    accelerator.print("skip_first_batches ok")
+
+
+def check_split_batches_semantics(accelerator):
+    """``split_batches=True``: the loader's batch size is the GLOBAL batch,
+    divided across processes instead of multiplied (reference
+    ``test_data_loader`` semantics)."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    n = max(accelerator.num_processes, 1)
+    dl = prepare_data_loader(
+        _Loader(_RangeDataset(32), 8 * n), split_batches=True, put_on_device=False
+    )
+    sizes = [len(np.atleast_1d(b["x"])) for b in dl]
+    assert all(s == 8 for s in sizes), sizes
+    assert dl.total_batch_size == 8 * n
+    accelerator.print("split_batches ok")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -81,6 +216,12 @@ def main():
     check_remainder_feeds_gather_for_metrics(accelerator)
     check_drop_last(accelerator)
     check_epoch_reshuffle(accelerator)
+    check_even_batch_matrix(accelerator)
+    check_join_uneven_inputs(accelerator)
+    check_iterable_dispatch(accelerator)
+    check_stateful_resume(accelerator)
+    check_skip_first_batches(accelerator)
+    check_split_batches_semantics(accelerator)
     accelerator.print("ALL_DATA_LOOP_OK")
 
 

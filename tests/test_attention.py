@@ -20,6 +20,8 @@ from accelerate_tpu.ops.flash_attention import blockwise_attention, flash_attent
 from accelerate_tpu.ops.layers import causal_mask, dot_product_attention
 from accelerate_tpu.parallel.context import context_parallel_attention
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _make_qkv(b=2, s=128, h=4, d=32, n_kv=None, seed=0):
     rng = np.random.default_rng(seed)

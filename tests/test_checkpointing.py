@@ -11,6 +11,8 @@ from accelerate_tpu import Accelerator, ProjectConfiguration
 from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 class _Loader:
     def __init__(self, dataset, batch_size):

@@ -14,6 +14,8 @@ import pytest
 from accelerate_tpu.commands.accelerate_cli import main as cli_main
 from accelerate_tpu.commands.config import ClusterConfig, write_basic_config
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

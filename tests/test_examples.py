@@ -20,6 +20,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.examples  # end-to-end example runs: slowest lane (make test_all)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 BY_FEATURE = os.path.join(EXAMPLES, "by_feature")

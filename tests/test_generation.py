@@ -11,6 +11,8 @@ from accelerate_tpu.generation import generate
 from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
 from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _model(cls=LlamaForCausalLM, cfg=None):
     cfg = cfg or LlamaConfig.tiny(layers=2, seq=64)

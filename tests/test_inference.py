@@ -12,6 +12,8 @@ from accelerate_tpu.inference import (
 )
 from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _model_and_batch(layers=4):
     config = LlamaConfig.tiny(layers=layers)

@@ -4,11 +4,14 @@ offload, pipeline inference (reference exposure: BERT-base is the
 
 import jax
 import numpy as np
+import pytest
 import optax
 
 from accelerate_tpu import Accelerator, MeshPlugin, prepare_pippy
 from accelerate_tpu.big_modeling import cpu_offload
 from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
 
 
 def _tiny(layers=2):

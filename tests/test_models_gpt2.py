@@ -15,6 +15,8 @@ from accelerate_tpu.models.gpt2 import (
     convert_hf_gpt2_state_dict,
 )
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _tiny(layers=2):
     config = GPT2Config.tiny(layers=layers)

@@ -10,6 +10,8 @@ from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, MeshPlug
 from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
 from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _batch(b=8, s=32, vocab=256, seed=0):
     rng = np.random.default_rng(seed)

@@ -17,6 +17,8 @@ from accelerate_tpu.models.mixtral import (
 )
 from accelerate_tpu.state import AcceleratorState, GradientState
 
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
+
 
 def _layer0(config, seed=0):
     params = init_mixtral_params(jax.random.key(seed), config)

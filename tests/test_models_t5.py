@@ -6,6 +6,7 @@ conversion (reference exposure: transformers T5 in
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from accelerate_tpu import Accelerator, MeshPlugin, prepare_pippy
@@ -18,6 +19,8 @@ from accelerate_tpu.models.t5 import (
     relative_position_bucket,
     shift_right,
 )
+
+pytestmark = pytest.mark.slow  # compile-heavy: full-lane only (make test_all)
 
 
 def _tiny(layers=2, **kw):
