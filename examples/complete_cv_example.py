@@ -1,0 +1,163 @@
+"""The full-featured CV training loop: checkpointing, resume, and tracking
+on top of ``cv_example.py`` (reference
+``/root/reference/examples/complete_cv_example.py`` — resnet50 with the
+same flags; this zero-egress build reuses the synthetic shape-classifier).
+
+Adds to ``cv_example.py``:
+* ``--checkpointing_steps {N|epoch}`` — periodic ``accelerator.save_state``
+* ``--resume_from_checkpoint DIR`` — ``load_state`` deep resume
+* ``--with_tracking`` — tracker init/log/end (TensorBoard by default)
+* ``--output_dir`` — checkpoint + tracker root
+"""
+
+import argparse
+import os
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.random import set_seed
+
+from cv_example import ShapeDataset, make_model
+from example_utils import PairMetric, SimpleLoader
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        log_with="tensorboard" if args.with_tracking else None,
+        project_dir=args.output_dir,
+    )
+    if hasattr(args.checkpointing_steps, "isdigit"):
+        if args.checkpointing_steps == "epoch":
+            checkpointing_steps = args.checkpointing_steps
+        elif args.checkpointing_steps.isdigit():
+            checkpointing_steps = int(args.checkpointing_steps)
+        else:
+            raise ValueError(
+                f"Argument `checkpointing_steps` must be either a number or `epoch`. "
+                f"`{args.checkpointing_steps}` passed."
+            )
+    else:
+        checkpointing_steps = None
+
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+
+    if args.with_tracking:
+        run = os.path.split(__file__)[-1].split(".")[0]
+        accelerator.init_trackers(run, config)
+
+    metric = PairMetric()
+    set_seed(seed)
+    train_loader = SimpleLoader(
+        ShapeDataset(512, seed=0), batch_size, shuffle=True, drop_last=True
+    )
+    eval_loader = SimpleLoader(ShapeDataset(128, seed=1), EVAL_BATCH_SIZE)
+    model = make_model(seed)
+
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    steps_per_epoch = len(train_loader.dataset) // batch_size
+    lr_scheduler = optax.schedules.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps=10, decay_steps=max(steps_per_epoch * num_epochs, 11)
+    )
+
+    model, optimizer, train_loader, eval_loader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_loader, eval_loader, lr_scheduler
+    )
+
+    starting_epoch = 0
+    overall_step = 0
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resumed from checkpoint: {args.resume_from_checkpoint}")
+        accelerator.load_state(args.resume_from_checkpoint)
+        overall_step = accelerator.step
+        starting_epoch = overall_step // steps_per_epoch
+
+    for epoch in range(starting_epoch, num_epochs):
+        model.train()
+        train_loader.set_epoch(epoch)
+        total_loss = 0.0
+        for step, batch in enumerate(train_loader):
+            outputs = model(**batch)
+            loss = outputs.loss
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+            if args.with_tracking:
+                total_loss += float(loss.item())
+            overall_step += 1
+            accelerator.step = overall_step
+
+            if isinstance(checkpointing_steps, int) and overall_step % checkpointing_steps == 0:
+                output_dir = os.path.join(args.output_dir or ".", f"step_{overall_step}")
+                accelerator.save_state(output_dir)
+
+        model.eval()
+        for step, batch in enumerate(eval_loader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}: accuracy {eval_metric['accuracy']:.4f}")
+        if args.with_tracking:
+            accelerator.log(
+                {
+                    "accuracy": eval_metric["accuracy"],
+                    "train_loss": total_loss / max(steps_per_epoch, 1),
+                    "epoch": epoch,
+                },
+                step=overall_step,
+            )
+
+        if checkpointing_steps == "epoch":
+            output_dir = os.path.join(args.output_dir or ".", f"epoch_{epoch}")
+            accelerator.save_state(output_dir)
+
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Complete CV example.")
+    parser.add_argument(
+        "--mixed_precision", type=str, default=None,
+        choices=["no", "fp16", "bf16", "fp8"],
+        help="Whether to use mixed precision (bf16 is the TPU-native default).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="If passed, will train on the CPU.")
+    parser.add_argument(
+        "--checkpointing_steps", type=str, default=None,
+        help="Whether the various states should be saved at the end of every n steps, "
+        "or 'epoch' for each epoch.",
+    )
+    parser.add_argument(
+        "--resume_from_checkpoint", type=str, default=None,
+        help="If the training should continue from a checkpoint folder.",
+    )
+    parser.add_argument(
+        "--with_tracking", action="store_true",
+        help="Whether to load in all available experiment trackers from the "
+        "environment and use them for logging.",
+    )
+    parser.add_argument(
+        "--output_dir", type=str, default=".",
+        help="Optional save directory where all checkpoint folders will be stored.",
+    )
+    parser.add_argument("--num_epochs", type=int, default=8)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 32}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,3 +32,15 @@ def test_by_feature_is_single_feature_delta(script, markers):
     assert_single_feature_delta(
         os.path.join(EXAMPLES, "by_feature", script), BASES, markers
     )
+
+
+def test_complete_cv_is_cv_plus_services():
+    """The CV path has a freshness twin like NLP: ``complete_cv_example``
+    must stay ``cv_example`` + checkpointing/resume/tracking (reference
+    pairs the same two scripts in ``ExampleDifferenceTests``)."""
+    assert_single_feature_delta(
+        os.path.join(EXAMPLES, "complete_cv_example.py"),
+        [os.path.join(EXAMPLES, "cv_example.py")],
+        ["checkpointing_steps", "resume_from_checkpoint", "with_tracking"],
+        max_novel=90,  # the services block is bigger than one feature delta
+    )
