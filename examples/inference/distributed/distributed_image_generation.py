@@ -1,0 +1,90 @@
+"""Distributed image generation (reference
+``examples/inference/distributed/distributed_image_generation.py`` — SD3
+over prompt batches). Zero-egress analog: a tiny latent-denoising loop
+(iterative refinement, the diffusion control flow) with synthetic weights;
+the distribution pattern is identical — prompts are chunked with
+``split_between_processes``, every process runs its slice, rank 0 gathers.
+
+Run: accelerate-tpu launch --num_cpu_devices 8 examples/inference/distributed/distributed_image_generation.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), *[".."] * 3))
+
+from accelerate_tpu import Accelerator
+
+IMG = 16
+LATENT = 8
+
+
+def build_denoiser(seed: int):
+    """A toy conditional denoiser: (latent, step_embedding, prompt_embedding)
+    -> latent update. Stands in for the SD transformer; jit-friendly."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    params = {
+        "w_in": jax.random.normal(k1, (LATENT * LATENT + 2, 64)) * 0.1,
+        "w_out": jax.random.normal(k2, (64, LATENT * LATENT)) * 0.1,
+    }
+
+    @jax.jit
+    def denoise_step(p, latent, t, prompt_emb):
+        b = latent.shape[0]
+        feats = jnp.concatenate(
+            [latent.reshape(b, -1), jnp.full((b, 1), t), prompt_emb[:, None]], axis=-1
+        )
+        update = jnp.tanh(feats @ p["w_in"]) @ p["w_out"]
+        return latent - 0.1 * update.reshape(b, LATENT, LATENT)
+
+    return params, denoise_step
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--prompts", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--save_dir", type=str, default=None)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    params, denoise_step = build_denoiser(seed=0)
+
+    # "prompts" are scalar embeddings here; real prompts would be encoded
+    # by a text tower first — the distribution pattern is what matters
+    rng = np.random.default_rng(0)
+    prompts = [float(x) for x in rng.normal(size=args.prompts)]
+
+    import jax.numpy as jnp
+
+    with accelerator.split_between_processes(prompts, apply_padding=True) as shard:
+        latents = jnp.asarray(
+            rng.standard_normal((len(shard), LATENT, LATENT)), jnp.float32
+        )
+        emb = jnp.asarray(shard, jnp.float32)
+        for t in range(args.steps, 0, -1):
+            latents = denoise_step(params, latents, t / args.steps, emb)
+        images = np.asarray(jnp.clip(latents, -1, 1))  # [n, 8, 8] "images"
+
+    gathered = accelerator.gather_for_metrics(
+        [img for img in images], use_gather_object=True
+    )[: args.prompts]
+    if accelerator.is_main_process:
+        assert len(gathered) == args.prompts
+        if args.save_dir:
+            os.makedirs(args.save_dir, exist_ok=True)
+            np.save(os.path.join(args.save_dir, "images.npy"), np.stack(gathered))
+        print(
+            f"generated {len(gathered)} images on {accelerator.num_processes} "
+            f"process(es); mean |pixel| = {np.abs(np.stack(gathered)).mean():.4f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

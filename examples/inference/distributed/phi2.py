@@ -1,0 +1,70 @@
+"""Memory-efficient distributed LLM inference (reference
+``examples/inference/distributed/phi2.py`` — phi-2 loaded once with
+``init_empty_weights`` + dispatched, prompts split across ranks).
+Zero-egress analog: the llama slice is materialised shape-only, loaded
+from a synthetic sharded checkpoint under a device map, and each process
+generates for its prompt slice with the KV cache.
+
+Run: accelerate-tpu launch --num_cpu_devices 8 examples/inference/distributed/phi2.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), *[".."] * 3))
+
+from accelerate_tpu import Accelerator, init_empty_weights, load_checkpoint_and_dispatch
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--prompts", type=int, default=6)
+    parser.add_argument("--new_tokens", type=int, default=8)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    config = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, seq=64)
+
+    # write a synthetic checkpoint once (stands in for the downloaded repo)
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        if accelerator.is_main_process:
+            donor = LlamaForCausalLM.from_config(config, seed=0)
+            accelerator.save_model(donor, ckpt_dir)
+        accelerator.wait_for_everyone()
+
+        # the reference's low-memory idiom: shapes first, weights streamed in
+        with init_empty_weights():
+            model = LlamaForCausalLM.from_config(config, seed=0)
+        model = load_checkpoint_and_dispatch(model, ckpt_dir, device_map={"": 0})
+
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(0, 256, size=(8,)).astype(np.int32)
+            for _ in range(args.prompts)
+        ]
+        with accelerator.split_between_processes(prompts, apply_padding=True) as shard:
+            local = [
+                np.asarray(
+                    generate(model, p[None, :], max_new_tokens=args.new_tokens)
+                )[0].tolist()
+                for p in shard
+            ]
+
+        results = accelerator.gather_for_metrics(local, use_gather_object=True)
+        if accelerator.is_main_process:
+            results = results[: args.prompts]
+            assert all(len(r) == 8 + args.new_tokens for r in results)
+            print(
+                f"generated {args.new_tokens} tokens for {len(results)} prompts "
+                f"on {accelerator.num_processes} process(es)"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -243,6 +243,30 @@ def test_split_inference_example():
     assert "next-token predictions" in stdout
 
 
+def test_distributed_inference_task_examples():
+    """The task-shaped distributed-inference quartet (reference ships six
+    Hub-checkpoint scripts; these run the same distribution patterns with
+    synthetic weights)."""
+    d = os.path.join(EXAMPLES, "inference", "distributed")
+    assert "generated 4 images" in _run(
+        os.path.join(d, "distributed_image_generation.py"), "--prompts", "4", "--steps", "4"
+    )
+    assert "synthesised" in _run(
+        os.path.join(d, "distributed_speech_generation.py"),
+        "--chunks", "3", "--codes_per_chunk", "4",
+    )
+    assert "answered" in _run(os.path.join(d, "florence2.py"), "--images", "2")
+    assert "denoised" in _run(os.path.join(d, "stable_diffusion.py"), "--steps", "4")
+
+
+def test_phi2_low_memory_example():
+    stdout = _run(
+        os.path.join(EXAMPLES, "inference", "distributed", "phi2.py"),
+        "--prompts", "3", "--new_tokens", "4",
+    )
+    assert "generated 4 tokens for 3 prompts" in stdout
+
+
 def test_config_yaml_templates_load():
     from accelerate_tpu.commands.config import ClusterConfig
 
