@@ -710,13 +710,18 @@ class DataLoaderDispatcher(DataLoaderShard):
                 data = multihost_utils.broadcast_one_to_all(
                     np.zeros(nbytes, np.uint8), is_source=False
                 )
-                out = np.frombuffer(np.asarray(data).tobytes(), dtype).reshape(shape)
+                # .copy(): frombuffer over bytes yields a READ-ONLY view;
+                # rank 0 yields writable arrays, so without it any in-place
+                # batch mutation would crash only on non-main ranks
+                out = np.frombuffer(np.asarray(data).tobytes(), dtype).reshape(shape).copy()
             else:
+                # .copy() here too: np.asarray over a jax.Array is a
+                # READ-ONLY view, same rank-divergent mutability hazard
                 out = np.asarray(
                     multihost_utils.broadcast_one_to_all(
                         np.zeros(shape, dtype), is_source=False
                     )
-                )
+                ).copy()
             # rank 0 yields its original batch; receivers must rebuild the
             # SAME Python types — a leaf that was a plain int/float/bool on
             # rank 0 comes back as one, not a 0-d array (rank-divergent

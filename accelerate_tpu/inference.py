@@ -141,17 +141,23 @@ class PipelinedModel:
         if batch is None:
             raise ValueError("Could not find batch size from args or kwargs")
         chunks = min(self.num_chunks, batch)
-        pad = (-batch) % chunks
-        if pad:  # wraparound padding so every microbatch is equal-sized
-            args = jax.tree.map(lambda x: _pad0(x, batch, pad), args)
-            kwargs = jax.tree.map(lambda x: _pad0(x, batch, pad), kwargs)
-        mb = (batch + pad) // chunks
+        # equal-sized microbatches with a RAGGED tail chunk (no wraparound
+        # padding): every chunk holds only real rows, so each chunk's own
+        # reductions (a loss mean in finalize) cover real rows exactly —
+        # the reference's pad-and-discard semantics
+        # (`/root/reference/src/accelerate/inference.py:99-122`) without
+        # padded rows ever existing. At most two program shapes compile
+        # (mb and the tail remainder).
+        mb = int(math.ceil(batch / chunks))
 
         outputs = []
+        reals = []
         for m in range(chunks):
-            sl = slice(m * mb, (m + 1) * mb)
-            mb_args = jax.tree.map(lambda x: _slice0(x, sl, batch + pad), args)
-            mb_kwargs = jax.tree.map(lambda x: _slice0(x, sl, batch + pad), kwargs)
+            lo, hi = m * mb, min(batch, (m + 1) * mb)
+            if lo >= hi:
+                break
+            mb_args = jax.tree.map(lambda x: _slice0(x, slice(lo, hi), batch), args)
+            mb_kwargs = jax.tree.map(lambda x: _slice0(x, slice(lo, hi), batch), kwargs)
             plan = self._plan_factory(*mb_args, **mb_kwargs)
             steps = plan["steps"]
             carry = plan["init"]()
@@ -159,14 +165,12 @@ class PipelinedModel:
                 carry = jax.device_put(carry, self.devices[s])
                 carry = self._stage_fn(s, steps)(self._stage_params[s], carry)
             outputs.append(plan["finalize"](carry))
-        # scalars (a loss) average over chunks weighted by REAL rows, so the
-        # wraparound-padded tail chunk doesn't get full weight. (Padded rows
-        # inside that chunk still enter its internal mean — pass
-        # chunk-divisible batches for exact scalar parity.)
-        real = jnp.asarray(
-            [max(0, min(mb, batch - m * mb)) for m in range(chunks)], jnp.float32
-        )
-        weights = real / jnp.sum(real)
+            reals.append(hi - lo)
+        # scalars (a loss) average over chunks weighted by rows; each
+        # chunk's scalar covers exactly its rows, so the weighted mean
+        # equals the full-batch mean.
+        weights = jnp.asarray(reals, jnp.float32)
+        weights = weights / jnp.sum(weights)
 
         def _merge(*xs):
             if jnp.ndim(xs[0]):
@@ -174,22 +178,12 @@ class PipelinedModel:
             return jnp.sum(jnp.stack(xs) * weights)
 
         out = jax.tree.map(_merge, *outputs)  # ModelOutput is a registered pytree
-        if pad:
-            out = jax.tree.map(lambda x: x[:batch] if hasattr(x, "ndim") and x.ndim else x, out)
         return out
 
     forward = __call__
 
     def unwrap(self):
         return self._model
-
-
-def _pad0(x, batch, pad):
-    if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == batch:
-        reps = int(math.ceil(pad / x.shape[0]))
-        filler = jnp.concatenate([x] * reps, axis=0)[:pad]
-        return jnp.concatenate([x, filler], axis=0)
-    return x
 
 
 def _slice0(x, sl, padded_batch):

@@ -462,9 +462,12 @@ def _cost_aware_jit(fn, donate_argnums=(), label=""):
 
     def call(*args):
         if _COLLECT_COSTS:
+            # every leaf participates: truncating the signature would hand
+            # a cached executable mismatched avals if two calls differ only
+            # in later-leaf shapes (shape/dtype tuples are cheap to hash)
             sig = (label, id(fn)) + tuple(
                 (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
-                for l in jax.tree.leaves(args)[:16]
+                for l in jax.tree.leaves(args)
             )
             compiled = _COST_COMPILED.get(sig)
             if compiled is None:

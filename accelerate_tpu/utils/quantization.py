@@ -107,8 +107,12 @@ class Q4Tensor:
     device-map sizing, checkpointing and the streaming executor's
     path-addressed reconstruction all work with zero special-casing — and
     accounted bytes ≈ 0.5/param automatically. Leading dims (e.g. a
-    stacked ``[L]`` layer axis) are preserved on every leaf, so one layer
-    of 4-bit weights slices exactly like fp16 ones."""
+    stacked ``[L]`` layer axis) are preserved on every leaf EXCEPT
+    ``code`` — the fixed 16-entry dequantization codebook is shared by all
+    layers and never carries the stack axis, so dim-0 slicing of a
+    quantized layer stack must slice the other four leaves and pass
+    ``code`` through unchanged (``big_modeling``'s streaming executor does
+    exactly this)."""
 
     def __init__(self, packed, scale_q, scale_offset, scale_scale, code):
         self.packed = packed          # uint8 [..., out/2]
@@ -159,12 +163,32 @@ def _block_for(n: int, requested: int) -> int:
     return b
 
 
+_FP4_WARNED = [False]
+
+
+def _warn_fp4_once():
+    if not _FP4_WARNED[0]:
+        _FP4_WARNED[0] = True
+        import warnings
+
+        warnings.warn(
+            "quant_type='fp4' maps to a linear 16-level int4 code here, not "
+            "bitsandbytes' 4-bit-float code: loaded weights differ "
+            "numerically from the reference's Linear4bit fp4 path",
+            stacklevel=3,
+        )
+
+
 def quantize_array_4bit(w, block_size: int = 64, quant_type: str = "nf4") -> Q4Tensor:
     """Blockwise 4-bit quantization along the last dim: per-block absmax →
     nearest codebook level, indices packed two per byte; the fp32 block
     scales are themselves int8-quantized around a per-row offset (double
     quantization, ~0.53 bytes/param all-in vs bnb's ~0.55)."""
+    # "fp4" is accepted as an alias of the linear int4 code (with a one-time
+    # warning about the numerical difference from bnb's 4-bit-float code)
     code = NF4_CODE if quant_type == "nf4" else INT4_CODE
+    if quant_type == "fp4":
+        _warn_fp4_once()
     w = np.asarray(w, dtype=np.float32)
     if w.shape[-1] % 2:
         raise ValueError(f"last dim {w.shape[-1]} must be even to pack int4 pairs")
@@ -233,10 +257,16 @@ class BnbQuantizationConfig:
     the bnb-specific knobs are accepted and the ones without a TPU meaning
     are ignored with a note in their docstring."""
 
-    load_in_8bit: bool = True
+    #: None = auto (8-bit unless ``load_in_4bit``). Passing an explicit
+    #: value that leaves both flags True or both False raises — exactly
+    #: one mode must be selected, matching the reference's conflict check.
+    load_in_8bit: bool | None = None
     load_in_4bit: bool = False  # blockwise nf4/int4 Q4Tensor storage
     llm_int8_threshold: float = 6.0  # bnb outlier split — no TPU analog, accepted
-    #: 4-bit knobs (reference fields ``dataclasses.py:2365-2440``)
+    #: 4-bit knobs (reference fields ``dataclasses.py:2365-2440``).
+    #: ``"fp4"`` selects a LINEAR 16-level int4 code, not bnb's 4-bit-float
+    #: code — weights load numerically different from the reference's
+    #: Linear4bit fp4 path (a warning is emitted once at quantize time).
     bnb_4bit_quant_type: str = "nf4"  # "nf4" | "fp4" (linear int4 code)
     bnb_4bit_use_double_quant: bool = True  # scales always stored int8+offset
     bnb_4bit_compute_dtype: Any = None  # dequantized matmul dtype (4-bit path)
@@ -247,8 +277,14 @@ class BnbQuantizationConfig:
     quantize_embeddings: bool = False  # override the DEFAULT_SKIP_MODULES guard
 
     def __post_init__(self):
-        if self.load_in_4bit:
-            self.load_in_8bit = False
+        if self.load_in_8bit is not None and bool(self.load_in_8bit) == bool(self.load_in_4bit):
+            raise ValueError(
+                "pass exactly one of load_in_8bit / load_in_4bit (the "
+                "reference raises on the same conflict); explicitly "
+                "disabling both would silently int8-quantize anyway"
+            )
+        if self.load_in_8bit is None:
+            self.load_in_8bit = not self.load_in_4bit
         if self.bnb_4bit_quant_type not in ("nf4", "fp4"):
             raise ValueError(
                 f"bnb_4bit_quant_type must be 'nf4' or 'fp4', got "
@@ -314,7 +350,7 @@ def quantize_model_params(model: Model, config: BnbQuantizationConfig) -> Model:
         quant = lambda leaf: quantize_array_4bit(  # noqa: E731
             leaf,
             block_size=config.bnb_4bit_block_size,
-            quant_type=config.bnb_4bit_quant_type if config.bnb_4bit_quant_type == "nf4" else "int4",
+            quant_type=config.bnb_4bit_quant_type,
         )
     else:
         quant = quantize_array

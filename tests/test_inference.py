@@ -47,7 +47,7 @@ def test_stage_params_are_disjoint_and_placed():
     assert len(owners) == 1
 
 
-def test_microbatching_pads_uneven_batch():
+def test_microbatching_handles_uneven_batch():
     config, model, _ = _model_and_batch(layers=2)
     ids = np.random.default_rng(0).integers(0, 256, size=(5, 16)).astype(np.int32)
     ref = model.apply_fn(model.params, input_ids=ids)["logits"]
@@ -58,6 +58,27 @@ def test_microbatching_pads_uneven_batch():
     out = pipelined(input_ids=ids)
     assert out.logits.shape[0] == 5
     np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_batch_scalar_parity_exact():
+    """Non-chunk-divisible batches: chunks are equal-sized with a RAGGED
+    tail, so every chunk's loss covers only real rows (the reference pads
+    then discards, ``/root/reference/src/accelerate/inference.py:99-122``;
+    same semantics, no padded rows ever exist) — the row-weighted
+    chunk-mean equals the dense full-batch loss (same mean over the same
+    5 rows)."""
+    config, model, _ = _model_and_batch(layers=2)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 256, size=(5, 16)).astype(np.int32)
+    labels = rng.integers(0, 256, size=(5, 16)).astype(np.int32)
+    ref = model.apply_fn(model.params, input_ids=ids, labels=labels)["loss"]
+    pipelined = prepare_pippy(
+        model, example_kwargs={"input_ids": ids, "labels": labels},
+        devices=jax.devices()[:2], num_chunks=4,  # mb=2 → real rows 2,2,1,0
+    )
+    out = pipelined(input_ids=ids, labels=labels)
+    np.testing.assert_allclose(np.asarray(out.loss), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    assert out.logits.shape[0] == 5
 
 
 def test_explicit_split_points():
