@@ -349,9 +349,21 @@ class Accelerator:
                     "TPU executes the real ring"
                 )
                 cp_mode = "allgather"
+        # Megatron-SP (reference dataclasses.py:1916-1919,2112): under
+        # tp>1 the norm/residual-region activations are sequence-sharded
+        # over the SAME tp group — models consult this flag at their
+        # residual sharding constraints (models/llama.py residual_spec)
+        # and GSPMD inserts the all-gather into / reduce-scatter out of
+        # the matmul regions that Megatron codes by hand.
+        megatron_sp = bool(
+            megatron_lm_plugin is not None
+            and getattr(megatron_lm_plugin, "sequence_parallelism", False)
+            and mesh_shape.get("tp", 1) > 1
+        )
         set_attention_context(
             AttentionContext(
-                mesh=self.state.mesh, cp_mode=cp_mode, pipeline_microbatches=pp_microbatches
+                mesh=self.state.mesh, cp_mode=cp_mode,
+                pipeline_microbatches=pp_microbatches, megatron_sp=megatron_sp,
             )
         )
 

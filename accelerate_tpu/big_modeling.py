@@ -349,6 +349,15 @@ class DispatchedModel:
             return value if idx is None else value[idx]
         return jax.device_put(np.asarray(self.tiered.fetch_host_or_disk(p, idx)))
 
+    def _fetch_host_np(self, p, idx):
+        """Host numpy view of an offloaded leaf, or None when the leaf is
+        HBM-resident (nothing to decode host-side there)."""
+        if (idx is not None and (p, idx) in self.tiered.resident_slices) or (
+            p in self.tiered.resident
+        ):
+            return None
+        return np.asarray(self.tiered.fetch_host_or_disk(p, idx))
+
     def _segment_params(self, seg_name, paths):
         """Device arrays for one segment; offloaded leaves H2D-copied
         (async). A ``(path, i)`` entry addresses layer i of a stacked leaf —
@@ -373,15 +382,43 @@ class DispatchedModel:
                     )
                 except KeyError:
                     # 4-bit leaves: all-array children, path-addressed (the
-                    # [16] codebook is per-tensor, never layer-sliced)
-                    out[p] = Q4Tensor(
-                        self._fetch_one(f"{p}.packed", idx),
-                        self._fetch_one(f"{p}.scale_q", idx),
-                        self._fetch_one(f"{p}.scale_offset", idx),
-                        self._fetch_one(f"{p}.scale_scale", idx),
-                        self._fetch_one(f"{p}.code", None),
-                    )
+                    # [16] codebook is per-tensor, never layer-sliced).
+                    # When the packed plane comes off the host/disk tier
+                    # AND the native pshufb decoder built, unpack nibbles →
+                    # int8 codes HERE (on the prefetch thread, host-only
+                    # work) so the segment program runs a straight int8
+                    # GEMM instead of in-jit nibble decoding — the decode
+                    # was the 4-bit offload compute floor.
+                    out[p] = self._fetch_q4(p, idx)
         return out
+
+    def _fetch_q4(self, p, idx):
+        from .native import q4_decode_codes
+        from .utils.quantization import Q4DecodedTensor, Q4Tensor
+
+        packed_np = self._fetch_host_np(f"{p}.packed", idx)
+        if packed_np is not None and packed_np.ndim == 2:
+            # the [16] codebook may be HBM-resident even when the packed
+            # plane is offloaded (per-path device maps) — fall back to a
+            # 16-float device fetch rather than assuming its tier
+            code = self._fetch_host_np(f"{p}.code", None)
+            if code is None:
+                code = np.asarray(self._fetch_one(f"{p}.code", None))
+            c8 = q4_decode_codes(packed_np, np.round(code * 127.0).astype(np.int8))
+            if c8 is not None:
+                return Q4DecodedTensor(
+                    jax.device_put(c8),
+                    self._fetch_one(f"{p}.scale_q", idx),
+                    self._fetch_one(f"{p}.scale_offset", idx),
+                    self._fetch_one(f"{p}.scale_scale", idx),
+                )
+        return Q4Tensor(
+            self._fetch_one(f"{p}.packed", idx),
+            self._fetch_one(f"{p}.scale_q", idx),
+            self._fetch_one(f"{p}.scale_offset", idx),
+            self._fetch_one(f"{p}.scale_scale", idx),
+            self._fetch_one(f"{p}.code", None),
+        )
 
     def _call_streaming(self, segments, *args, **kwargs):
         """segments: list of (name, param_paths, fn) where
@@ -413,19 +450,30 @@ class DispatchedModel:
             key = name if isinstance(name, str) else name[0]
             jit_fn = self._segment_fns.get(key)
             if jit_fn is None:
-                from .utils.quantization import dequantize_tree
+                # quantized leaves enter the compiled segment AS
+                # QTensor/Q4Tensor pytree nodes: the model zoo's dense()
+                # routes int8 weights through an int8 GEMM (activations
+                # row-quantized, bnb Linear8bitLt semantics — the int8
+                # bytes are both what crossed the tiers AND what the
+                # matmul reads) and 4-bit weights through the slab GEMMs;
+                # embedding gathers hit the nodes' __getitem__ (int8 /
+                # packed rows move, scaled after). jnp-function ops on the
+                # nodes fall back through __jax_array__ = full dequant.
+                jit_fn = jax.jit(fn)
+                try:
+                    carry = jit_fn(seg_params, carry)
+                except (TypeError, AttributeError):
+                    # a non-zoo segment fn used bare operators/methods the
+                    # quantized nodes don't implement (`w * 0.5`,
+                    # `w.astype(...)`) — retrace with every quantized leaf
+                    # dequantized up front, the pre-round-4 semantics
+                    from .utils.quantization import dequantize_tree
 
-                def _dequant_then(fn):
-                    # QTensor leaves upcast INSIDE the compiled segment so
-                    # XLA fuses q*scale into the consumer
-                    def wrapped(seg, carry):
-                        return fn(dequantize_tree(seg), carry)
-
-                    return wrapped
-
-                jit_fn = jax.jit(_dequant_then(fn))
+                    jit_fn = jax.jit(lambda seg, c: fn(dequantize_tree(seg), c))
+                    carry = jit_fn(seg_params, carry)
                 self._segment_fns[key] = jit_fn
-            carry = jit_fn(seg_params, carry)
+            else:
+                carry = jit_fn(seg_params, carry)
         return plan["finalize"](carry)
 
     # -- misc ----------------------------------------------------------------

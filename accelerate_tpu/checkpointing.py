@@ -76,11 +76,25 @@ def _path_part(p) -> str:
 
 def save_array_dict(flat: dict[str, np.ndarray], path: str, safe_serialization: bool = True):
     if safe_serialization and is_safetensors_available():
-        # safetensors.flax handles ml_dtypes bfloat16 (the default TPU dtype);
-        # safetensors.numpy's bf16 support is version-dependent
-        from safetensors.flax import save_file
+        # safetensors.NUMPY, deliberately: the flax backend round-trips
+        # every array through jnp.asarray — i.e. through the attached
+        # accelerator, a gratuitous device hop. The numpy backend stays
+        # host-only and handles ml_dtypes bfloat16 natively.
+        # ascontiguousarray is LOAD-BEARING: some TPU backends hand back
+        # host arrays with device-chosen (non-C) strides, and safetensors
+        # serialises the raw buffer without honouring them — silently
+        # interleaving the tensor on disk.
+        from safetensors.numpy import save_file
 
-        save_file(flat, path if path.endswith(".safetensors") else path + ".safetensors")
+        def _c_order(v):
+            v = np.asarray(v)
+            # ascontiguousarray would promote 0-d scalars to shape (1,)
+            if v.ndim == 0 or v.flags["C_CONTIGUOUS"]:
+                return v
+            return np.ascontiguousarray(v)
+
+        out = {k: _c_order(v) for k, v in flat.items()}
+        save_file(out, path if path.endswith(".safetensors") else path + ".safetensors")
         return path + ("" if path.endswith(".safetensors") else ".safetensors")
     np.savez(path + ".npz", **flat)
     return path + ".npz"
@@ -88,7 +102,7 @@ def save_array_dict(flat: dict[str, np.ndarray], path: str, safe_serialization: 
 
 def load_array_dict(path: str) -> dict[str, np.ndarray]:
     if path.endswith(".safetensors"):
-        from safetensors.flax import load_file
+        from safetensors.numpy import load_file
 
         return {k: np.asarray(v) for k, v in load_file(path).items()}
     if path.endswith(".npz"):
@@ -391,7 +405,13 @@ def save_model_weights(accelerator, model, save_directory: str, max_shard_size="
             save_array_dict(shard, os.path.join(save_directory, name), safe_serialization)
             for key in shard:
                 index["weight_map"][key] = name + ext
-        with open(os.path.join(save_directory, "model.index.json"), "w") as f:
+        # HF-convention index name for safetensors
+        # (`model.safetensors.index.json`: what merge-weights and the
+        # device-map checkpoint reader consume — reference
+        # utils/modeling.py:1636-1794 reads the same file); npz shards
+        # keep the legacy `model.index.json` every reader already probes
+        index_name = "model.safetensors.index.json" if ext == ".safetensors" else "model.index.json"
+        with open(os.path.join(save_directory, index_name), "w") as f:
             json.dump(index, f, indent=2)
     accelerator.wait_for_everyone()
 

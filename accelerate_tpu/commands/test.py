@@ -7,8 +7,12 @@ import os
 
 
 #: the bundled assertion-script suite (reference ships test_script plus
-#: test_ops/test_sync/test_distributed_data_loop under the same dir)
-ALL_SCRIPTS = ("test_script.py", "test_ops.py", "test_sync.py", "test_data_loop.py")
+#: test_ops/test_sync/test_distributed_data_loop/test_merge_weights under
+#: the same dir)
+ALL_SCRIPTS = (
+    "test_script.py", "test_ops.py", "test_sync.py", "test_data_loop.py",
+    "test_merge_weights.py",
+)
 
 
 def test_command(args) -> int:

@@ -55,6 +55,15 @@ def _zoo():
         z["t5-11b"] = (T5Config.t5_11b(), lambda c: T5ForConditionalGeneration.from_config(c))
     except ImportError:
         pass
+    try:
+        from .resnet import ResNetConfig, ResNetForImageClassification
+
+        z["resnet50d"] = (
+            ResNetConfig.resnet50d(),
+            lambda c: ResNetForImageClassification.from_config(c),
+        )
+    except ImportError:
+        pass
     return z
 
 
@@ -150,4 +159,8 @@ def model_factory_for_config(config):
         from .t5 import T5ForConditionalGeneration
 
         return lambda c: T5ForConditionalGeneration.from_config(c)
+    if name == "ResNetConfig":
+        from .resnet import ResNetForImageClassification
+
+        return lambda c: ResNetForImageClassification.from_config(c)
     raise ValueError(f"no factory for {name}")

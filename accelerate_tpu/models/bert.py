@@ -26,7 +26,7 @@ from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import rms_norm
 from ..parallel.pipeline import remat_wrap
-from .llama import _constrain
+from .llama import _constrain, residual_spec
 
 
 @dataclass
@@ -120,10 +120,10 @@ def bert_layer_apply(config: BertConfig, layer, x, attention_mask):
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=False)
     x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     y = rms_norm(x, layer["mlp_norm"], c.norm_eps)
     x = x + dense(jax.nn.gelu(dense(y, layer["w_in"])), layer["w_out"])
-    return _constrain(x, P(("dp", "fsdp"), "cp", None))
+    return _constrain(x, residual_spec())
 
 
 def _bert_block(config: BertConfig, attention_mask):
@@ -155,7 +155,7 @@ def bert_apply(
         + params["embed_types"][token_type_ids]
     )
     x = rms_norm(x, params["emb_norm"], c.norm_eps)
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
 
     from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
 

@@ -22,7 +22,7 @@ from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import cached_attention, cross_entropy_loss, write_kv_cache
 from ..parallel.pipeline import remat_wrap
-from .llama import _constrain
+from .llama import _constrain, residual_spec
 
 
 @dataclass
@@ -123,10 +123,10 @@ def gpt2_layer_apply(config: GPT2Config, layer, x, attention_mask, return_kv: bo
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
     x = x + dense(attn.reshape(b, s, h), layer["w_proj"]) + layer["b_proj"]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
     x = x + dense(jax.nn.gelu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]) + layer["b_out"]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     if return_kv:
         return x, (k, v)
     return x
@@ -161,7 +161,7 @@ def gpt2_apply(
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
     x = params["wte"][input_ids] + params["wpe"][positions]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
 
     caches = None
     if use_cache:
@@ -291,7 +291,8 @@ def gpt2_segments(config: GPT2Config):
 
         def head_fn(seg, carry):
             x = layer_norm(carry["x"], seg["ln_f_g"], seg["ln_f_b"], config.layer_norm_eps)
-            return {**carry, "logits": x @ seg["wte"].T}
+            # dense(): a quantized tied head takes the int8-GEMM path
+            return {**carry, "logits": dense(x, seg["wte"].T)}
 
         steps = [("embed", ["wte", "wpe"], embed_fn)]
         for i in range(config.num_hidden_layers):

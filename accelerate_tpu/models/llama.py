@@ -159,12 +159,12 @@ def llama_layer_apply(
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
     x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     # mlp (SwiGLU)
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
     gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
     x = x + dense(gated, layer["w_down"])
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     if return_kv:
         return x, (k, v)
     return x
@@ -186,6 +186,24 @@ def _constrain(x, spec):
         return jax.lax.with_sharding_constraint(x, spec)
     except Exception:
         return x
+
+
+def residual_spec() -> P:
+    """Spec for norm/residual-region activations ``[b, s, h]``: batch over
+    dp/fsdp, sequence over cp — and ALSO over tp under Megatron-style
+    sequence parallelism (``MegatronLMPlugin(sequence_parallelism=True)``
+    with tp>1; reference forwards the flag to Megatron at
+    ``utils/dataclasses.py:1916-1919,2112``, where LayerNorm/dropout
+    activations shard along sequence within the TP group). Between the
+    matmul regions (which are head/ff-sharded on tp, full-sequence) GSPMD
+    inserts the all-gather in / reduce-scatter out that Megatron's fused
+    kernels code by hand, and per-device activation bytes in the norm
+    regions shrink by the tp extent."""
+    from ..ops.attention import get_attention_context
+
+    if get_attention_context().megatron_sp:
+        return P(("dp", "fsdp"), ("cp", "tp"), None)
+    return P(("dp", "fsdp"), "cp", None)
 
 
 def _pipeline_mesh():
@@ -257,7 +275,7 @@ def llama_apply(
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
     x = params["embed_tokens"][input_ids]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
 
     if use_cache:
         max_cache = int(max_cache_len or c.max_position_embeddings)
@@ -399,7 +417,8 @@ def llama_segments(config: LlamaConfig):
             head = seg.get("lm_head")
             if head is None:
                 head = seg["embed_tokens"].T
-            return {**carry, "logits": x @ head}
+            # dense(): quantized heads take the int8-GEMM / fused-LUT path
+            return {**carry, "logits": dense(x, head)}
 
         steps = [("embed", ["embed_tokens"], embed_fn)]
         for i in range(config.num_hidden_layers):

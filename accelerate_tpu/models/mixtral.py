@@ -34,7 +34,7 @@ from ..ops.attention import attention
 from ..ops.fp8 import dense
 from ..ops.layers import apply_rope, cross_entropy_loss, rms_norm, rope_frequencies
 from ..parallel.pipeline import remat_wrap
-from .llama import _constrain
+from .llama import _constrain, residual_spec
 
 
 @dataclass
@@ -185,11 +185,11 @@ def mixtral_layer_apply(
     k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
     attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
     x = x + dense(attn.reshape(b, s, nh * hd), layer["wo"])
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
     moe_out, aux = moe_ffn(config, layer, y)
     x = x + moe_out
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
     if return_kv:
         return x, aux, (k, v)
     return x, aux
@@ -236,7 +236,7 @@ def mixtral_apply(
         return _mixtral_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
 
     x = params["embed_tokens"][input_ids]
-    x = _constrain(x, P(("dp", "fsdp"), "cp", None))
+    x = _constrain(x, residual_spec())
 
     caches = None
     if use_cache:

@@ -482,8 +482,12 @@ def t5_segments(config: T5Config):
             x = rms_norm(carry["x"], seg["decoder.final_norm"], c.layer_norm_epsilon)
             head = seg.get("lm_head")
             if head is None:
-                head = seg["shared"].T * (c.hidden_size**-0.5)
-            return {**carry, "logits": x @ head}
+                # scale x instead of the table: (x*s) @ W == x @ (W*s), and
+                # a quantized tied head stays a QTensor for dense()'s
+                # int8-GEMM path
+                x = x * (c.hidden_size**-0.5)
+                head = seg["shared"].T
+            return {**carry, "logits": dense(x, head)}
 
         steps = [("enc_embed", ["shared", "encoder.rel_bias"], enc_embed_fn)]
         for i in range(c.num_layers):

@@ -1,0 +1,72 @@
+"""Native helpers for the host-side data path (SURVEY: "native code is
+allowed and expected" for the runtime around the XLA compute path). Each
+helper compiles lazily from the vendored C source with the system
+compiler and degrades gracefully — callers MUST handle a None export."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_Q4_LIB = None
+_Q4_TRIED = False
+
+
+def _build_q4decode():
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "q4decode.c")
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "accelerate_tpu",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    lib_path = os.path.join(cache_dir, "libq4decode.so")
+    if not (
+        os.path.exists(lib_path)
+        and os.path.getmtime(lib_path) >= os.path.getmtime(src)
+    ):
+        with tempfile.NamedTemporaryFile(
+            suffix=".so", dir=cache_dir, delete=False
+        ) as tmp:
+            tmp_path = tmp.name
+        cmd = [
+            os.environ.get("CC", "cc"), "-O3", "-march=native", "-shared",
+            "-fPIC", src, "-o", tmp_path,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp_path, lib_path)  # atomic vs concurrent builders
+    lib = ctypes.CDLL(lib_path)
+    lib.q4_decode_codes.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_int8),
+    ]
+    lib.q4_decode_codes.restype = None
+    return lib
+
+
+def q4_decode_codes(packed: np.ndarray, lut16: np.ndarray):
+    """packed uint8 [..., n] → int8 code values [..., 2n] via the native
+    pshufb LUT; returns None when the native library is unavailable (no
+    compiler / non-x86 without the scalar build succeeding)."""
+    global _Q4_LIB, _Q4_TRIED
+    if _Q4_LIB is None:
+        if _Q4_TRIED:
+            return None
+        _Q4_TRIED = True
+        try:
+            _Q4_LIB = _build_q4decode()
+        except Exception:
+            return None
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    lut = np.ascontiguousarray(lut16, dtype=np.int8)
+    out = np.empty(packed.shape[:-1] + (packed.shape[-1] * 2,), dtype=np.int8)
+    _Q4_LIB.q4_decode_codes(
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        packed.size,
+        lut.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+    )
+    return out

@@ -48,6 +48,10 @@ class AttentionContext:
     #: (a new Accelerator swaps mesh + schedule depth together instead of
     #: leaving a stale microbatch global paired with a fresh mesh).
     pipeline_microbatches: int = 0
+    #: Megatron-style sequence parallelism: norm/residual-region
+    #: activations additionally sequence-shard over the tp axis
+    #: (models/llama.py ``residual_spec``)
+    megatron_sp: bool = False
 
 
 _current = AttentionContext()

@@ -100,8 +100,25 @@ fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
 
 def dense(x, w):
     """Dense projection used by the model zoo: plain ``x @ w`` normally,
-    the scaled-fp8 matmul inside :func:`fp8_autocast`. ``x [..., K]``,
-    ``w [K, N]``."""
+    the scaled-fp8 matmul inside :func:`fp8_autocast`, and the quantized
+    fast paths when ``w`` is a quantized leaf (the streaming offload
+    executor feeds segment programs int8/4-bit weights directly —
+    ``big_modeling.py`` ``_call_streaming``). ``x [..., K]``, ``w [K, N]``."""
+    from ..utils.quantization import (
+        Q4DecodedTensor, Q4DecodedTransposed, Q4Transposed, Q4Tensor, QTensor,
+        int8_matmul, q4_decoded_matmul, q4_decoded_matmul_t, q4_matmul, q4_matmul_t,
+    )
+
+    if isinstance(w, QTensor):
+        return int8_matmul(x, w)
+    if isinstance(w, Q4Tensor):
+        return q4_matmul(x, w)
+    if isinstance(w, Q4Transposed):
+        return q4_matmul_t(x, w.inner)
+    if isinstance(w, Q4DecodedTensor):
+        return q4_decoded_matmul(x, w)
+    if isinstance(w, Q4DecodedTransposed):
+        return q4_decoded_matmul_t(x, w.inner)
     if not _FP8_STATE["active"]:
         return x @ w
     lead = x.shape[:-1]

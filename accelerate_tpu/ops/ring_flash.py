@@ -40,10 +40,18 @@ def _merge(o_run, lse_run, o_c, lse_c):
     return o_run * w_run + o_c.astype(jnp.float32) * w_c, lse_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _ring_flash_bhsd(q, k, v, bias, axis_name, scale, causal, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _ring_flash_bhsd(
+    q, k, v, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret
+):
+    """``idxf``: this shard's ring position as an f32 ``[1]`` DATA array
+    (exact for any real ring size). Plumbed as a differentiable arg with a
+    zero cotangent because (a) custom_vjp nondiff args must be static and
+    (b) ``jax.lax.axis_index`` cannot be used here — inside a nested
+    manual region (cp attention in a GPipe stage body) its lowering claims
+    the parent's manual axes and the MLIR verifier rejects the program."""
     o, _ = _ring_fwd_impl(
-        q, k, v, bias, axis_name, scale, causal, block_q, block_kv, interpret
+        q, k, v, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret
     )
     return o
 
@@ -70,9 +78,13 @@ def _chunk_fwd(q, k_cur, v_cur, bias_cur, src, idx, *, scale, causal, bq, bkv, i
     )
 
 
-def _ring_fwd_impl(q, k, v, bias, axis_name, scale, causal, block_q, block_kv, interpret):
+def _ring_fwd_impl(q, k, v, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret):
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (
+        idxf.reshape(()).astype(jnp.int32)
+        if idxf is not None
+        else jax.lax.axis_index(axis_name)
+    )
     b, h, sq, d = q.shape
     o = jnp.zeros((b, h, sq, d), jnp.float32)
     lse = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
@@ -92,17 +104,21 @@ def _ring_fwd_impl(q, k, v, bias, axis_name, scale, causal, block_q, block_kv, i
     return o.astype(q.dtype), lse
 
 
-def _ring_flash_fwd(q, k, v, bias, axis_name, scale, causal, block_q, block_kv, interpret):
+def _ring_flash_fwd(q, k, v, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret):
     o, lse = _ring_fwd_impl(
-        q, k, v, bias, axis_name, scale, causal, block_q, block_kv, interpret
+        q, k, v, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret
     )
-    return o, (q, k, v, bias, o, lse)
+    return o, (q, k, v, bias, idxf, o, lse)
 
 
 def _ring_flash_bwd(axis_name, scale, causal, block_q, block_kv, interpret, res, do):
-    q, k, v, bias, o, lse = res
+    q, k, v, bias, idxf, o, lse = res
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (
+        idxf.reshape(()).astype(jnp.int32)
+        if idxf is not None
+        else jax.lax.axis_index(axis_name)
+    )
     b, h, sq, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -150,6 +166,7 @@ def _ring_flash_bwd(axis_name, scale, causal, block_q, block_kv, interpret, res,
     return (
         dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype),
         jnp.zeros_like(bias),
+        None if idxf is None else jnp.zeros_like(idxf),
     )
 
 
@@ -168,6 +185,7 @@ def ring_flash_attention_local(
     block_q: int = 512,
     block_kv: int = 1024,
     interpret: bool | None = None,
+    cp_index: jax.Array | None = None,
 ) -> jax.Array:
     """Ring attention body with flash-kernel chunks (call inside shard_map
     over ``axis_name``; drop-in for ``ring_attention_local``)."""
@@ -187,7 +205,8 @@ def ring_flash_attention_local(
     valid = _pad_to(kv_valid.astype(bool), skv_p, 1)
     bias = jnp.where(valid, 0.0, NEG_INF).astype(jnp.float32)[:, None, None, :]
 
+    idxf = None if cp_index is None else cp_index.astype(jnp.float32)
     o = _ring_flash_bhsd(
-        qt, kt, vt, bias, axis_name, scale, causal, block_q, block_kv, interpret
+        qt, kt, vt, bias, idxf, axis_name, scale, causal, block_q, block_kv, interpret
     )
     return o[:, :, :s_loc, :].transpose(0, 2, 1, 3)

@@ -83,23 +83,35 @@ def ring_attention_local(
     causal: bool = True,
     scale: float | None = None,
     use_flash: bool | None = None,
+    cp_index: jax.Array | None = None,
 ) -> jax.Array:
     """Ring attention body (call inside shard_map over ``axis_name``).
 
     On TPU the per-chunk compute runs the Mosaic flash kernel with a
     whole-ring custom VJP (``ops/ring_flash.py``) — O(s) memory and
     MXU-tiled chunk attention; elsewhere (and as the numerical oracle) the
-    einsum online-softmax body below."""
+    einsum online-softmax body below.
+
+    ``cp_index`` (a ``[1]`` array holding this shard's ring position,
+    plumbed in as data by :func:`context_parallel_attention`) replaces
+    ``jax.lax.axis_index``: inside a NESTED manual region (cp attention in
+    a GPipe 'pp' stage body) the axis_index lowering claims the parent's
+    manual axes and the verifier rejects it."""
     if use_flash is None:
         use_flash = jax.devices()[0].platform == "tpu"
     if use_flash:
         from ..ops.ring_flash import ring_flash_attention_local
 
         return ring_flash_attention_local(
-            q, k, v, kv_valid, axis_name=axis_name, causal=causal, scale=scale
+            q, k, v, kv_valid, axis_name=axis_name, causal=causal, scale=scale,
+            cp_index=cp_index,
         )
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (
+        cp_index.reshape(()).astype(jnp.int32)
+        if cp_index is not None
+        else jax.lax.axis_index(axis_name)
+    )
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
 
@@ -134,6 +146,7 @@ def ulysses_attention_local(
     causal: bool = True,
     scale: float | None = None,
     use_flash: bool | None = None,
+    cp_index: jax.Array | None = None,  # unused: no per-shard offsets here
 ) -> jax.Array:
     """Ulysses body: all_to_all seq↔head reshard around dense local attention."""
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
@@ -153,12 +166,17 @@ def ulysses_attention_local(
 
 
 def allgather_attention_local(
-    q, k, v, kv_valid, *, axis_name="cp", causal=True, scale=None, use_flash=None
+    q, k, v, kv_valid, *, axis_name="cp", causal=True, scale=None, use_flash=None,
+    cp_index=None,
 ):
     """Baseline: gather all KV chunks, run dense attention on the local Q
     chunk with the right global offset."""
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (
+        cp_index.reshape(()).astype(jnp.int32)
+        if cp_index is not None
+        else jax.lax.axis_index(axis_name)
+    )
     b, s_loc, h, d = q.shape
     kg = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)
     vg = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
@@ -240,14 +258,45 @@ def context_parallel_attention(
     mask_spec = P(batch_entry, cp_axis)
     body = _LOCAL_BODIES[mode]
 
+    # claim ONLY the axes this shard_map actually uses: every other mesh
+    # axis stays auto, which is what lets the cp attention nest inside the
+    # GPipe stage body (gpipe's shard_map is manual over 'pp' alone — a
+    # nested map claiming 'pp' again would be rejected)
+    used: set = {cp_axis}
+    for entry in (batch_entry, head_entry):
+        if entry is None:
+            continue
+        used.update(entry if isinstance(entry, tuple) else (entry,))
+
+    # when tracing inside another manual region (the GPipe stage body is
+    # shard_map'd over 'pp'), the nested map must be built on the CURRENT
+    # abstract mesh — the one where 'pp' is already Manual — not the
+    # concrete mesh, or jax rejects the mismatch
+    mesh_arg = mesh
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if getattr(am, "shape", None):
+            mesh_arg = am
+    except Exception:
+        pass
+
+    # this shard's ring position as DATA (a cp-sharded iota): inside a
+    # nested manual region jax.lax.axis_index's lowering claims the
+    # parent's manual axes, so the bodies take the index as an argument
+    cp_pos = jnp.arange(cp_extent, dtype=jnp.float32)
+
     @functools.partial(
         shard_map,
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        mesh=mesh_arg,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, P(cp_axis)),
         out_specs=qkv_spec,
+        axis_names=used,
         check_vma=False,
     )
-    def _sharded(q_, k_, v_, valid_):
-        return body(q_, k_, v_, valid_, axis_name=cp_axis, causal=causal, scale=scale)
+    def _sharded(q_, k_, v_, valid_, cp_pos_):
+        return body(
+            q_, k_, v_, valid_, axis_name=cp_axis, causal=causal, scale=scale,
+            cp_index=cp_pos_,
+        )
 
-    return _sharded(q, k, v, segment_mask)
+    return _sharded(q, k, v, segment_mask, cp_pos)

@@ -73,14 +73,11 @@ def remat_wrap(body, remat):
 
 
 def validate_pipeline_axes(mesh_shape: dict) -> None:
-    """Single owner of the pp/cp composition rule (used both at
-    ``Accelerator`` construction and at trace time)."""
-    if mesh_shape.get("pp", 1) > 1 and mesh_shape.get("cp", 1) > 1:
-        raise ValueError(
-            "pp and cp mesh axes cannot both be > 1: context-parallel "
-            "attention shards the sequence under its own shard_map, which "
-            "does not compose with the GPipe stage loop"
-        )
+    """pp×cp compose since round 4: the cp attention's shard_map claims
+    only its own axes (``parallel/context.py`` passes ``axis_names``), so
+    it nests inside the GPipe stage body whose shard_map is manual over
+    ``pp`` alone. Kept as the single owner of any future composition
+    rule; currently every combination is accepted."""
 
 
 def active_pipeline_mesh():

@@ -69,6 +69,122 @@ def check_accumulation_matches_full_batch(accelerator_factory):
     return full
 
 
+def _closed_form_grads(a, b, x, y):
+    """d/d{a,b} of mean((a·x + b − y)²) — the oracle every grad check
+    compares against (the reference's ``test_sync.py`` asserts
+    per-parameter ``.grad`` values the same way)."""
+    r = a * x + b - y
+    return {"a": float(np.mean(2 * r * x)), "b": float(np.mean(2 * r))}
+
+
+def _grads(opt):
+    return {k: float(np.asarray(v)) for k, v in opt.grads.items()}
+
+
+def _grad_rtol(acc) -> float:
+    # the launcher may configure bf16 compute (ACCELERATE_MIXED_PRECISION):
+    # closed-form comparisons then see bf16's ~2-3 decimal digits, and
+    # accumulated microbatch grads add one more rounding
+    return 1e-2 if getattr(acc, "mixed_precision", None) in ("bf16", "fp16") else 1e-4
+
+
+def check_grads_synced_across_shards(accelerator_factory):
+    """Per-parameter gradients with the batch SHARDED over the mesh equal
+    the closed-form full-batch gradients — the in-step psum really is the
+    reference's DDP allreduce (its ``test_distributed_sync``)."""
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = accelerator_factory()
+    model, opt = acc.prepare(RegressionModel(a=0.5, b=-1.0), optax.sgd(0.1))
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(16,)).astype(np.float32)
+    y = (2 * x + 3).astype(np.float32)
+    out = model(x=x, y=y)
+    acc.backward(out.loss)
+    got = _grads(opt)
+    want = _closed_form_grads(0.5, -1.0, x, y)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=_grad_rtol(acc), err_msg=k)
+    opt.zero_grad()
+    acc.print("sharded-batch grad sync ok")
+
+
+def check_per_param_grads_not_synced_then_synced(accelerator_factory):
+    """The reference's core matrix (``test_sync.py:29-42``
+    ``check_model_parameters`` + per-``p.grad`` asserts): mid-accumulation
+    the accumulated grads hold ONLY the microbatches seen so far (scaled
+    by 1/k), and at the boundary they equal the full-batch grads."""
+    import optax
+
+    from accelerate_tpu import GradientAccumulationPlugin
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2)
+    )
+    model, opt = acc.prepare(RegressionModel(a=0.25, b=0.0), optax.sgd(0.1))
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(8,)).astype(np.float32)
+    y = (2 * x + 3).astype(np.float32)
+
+    with acc.accumulate(model):
+        out = model(x=x[:4], y=y[:4])
+        acc.backward(out.loss)
+        opt.step()  # non-boundary: must not apply
+        opt.zero_grad()  # no-op while accumulating
+    half = _grads(opt)
+    want_half = _closed_form_grads(0.25, 0.0, x[:4], y[:4])
+    for k in want_half:
+        np.testing.assert_allclose(half[k], want_half[k] / 2, rtol=_grad_rtol(acc))
+
+    with acc.accumulate(model):
+        out = model(x=x[4:], y=y[4:])
+        acc.backward(out.loss)
+        boundary = _grads(opt)
+        want_full = _closed_form_grads(0.25, 0.0, x, y)
+        for k in want_full:
+            np.testing.assert_allclose(boundary[k], want_full[k], rtol=_grad_rtol(acc))
+        opt.step()
+        opt.zero_grad()
+    assert opt.grads is None, "grads survived the boundary zero_grad"
+    acc.print("per-parameter accumulation grads ok")
+
+
+def check_scheduler_advances_only_on_boundaries(accelerator_factory):
+    """×num_processes stepping only on real optimizer steps (reference
+    ``test_sync`` drives scheduler+optimizer through the accumulation
+    matrix; semantics pinned at ``scheduler.py:54-82``)."""
+    import optax
+
+    from accelerate_tpu import GradientAccumulationPlugin
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2)
+    )
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=lambda step: 0.1 / (1 + step))
+    model, opt, sched = acc.prepare(
+        RegressionModel(a=0.0, b=0.0), tx, (lambda step: 0.1 / (1 + step))
+    )
+    x = np.asarray([1.0, 2.0], np.float32)
+    y = np.asarray([5.0, 7.0], np.float32)
+    for i in range(4):  # two full accumulation windows
+        with acc.accumulate(model):
+            out = model(x=x, y=y)
+            acc.backward(out.loss)
+            opt.step()
+            sched.step()
+            opt.zero_grad()
+    num = AcceleratorState().num_processes or 1
+    assert sched._step_count == 2 * num, (
+        f"scheduler advanced {sched._step_count} times, expected 2 boundaries x {num}"
+    )
+    acc.print("scheduler boundary stepping ok")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -77,9 +193,15 @@ def main():
 
     from accelerate_tpu.state import AcceleratorState, GradientState
 
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
+    def fresh(**kw):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        return Accelerator(**kw)
+
     check_accumulation_matches_full_batch(lambda **kw: Accelerator(**kw))
+    check_grads_synced_across_shards(fresh)
+    check_per_param_grads_not_synced_then_synced(fresh)
+    check_scheduler_advances_only_on_boundaries(fresh)
     print("ALL_SYNC_OK")
 
 

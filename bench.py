@@ -36,23 +36,27 @@ def _bench_config(platform: str, remat="dots_saveable", seq: int = 1024):
 
     if platform == "cpu":  # smoke-test sizing
         return LlamaConfig.tiny(vocab_size=512, hidden_size=128, layers=2, heads=4, seq=128), 4, 128
-    # ~470M-param slice of the llama2 architecture; fits one v5e chip with
-    # adam state in fp32. At seq 1024, bsz=8 + the dots_saveable checkpoint
-    # policy (matmul outputs resident, elementwise recomputed) beats both
-    # bsz=4/remat=False (+5%) and bsz=8/full-remat (+7%) on v5e; larger
-    # batches OOM (dots_saveable temps scale linearly) and full remat at
-    # bsz 16 is 10% slower — measured in benchmarks/sweep_bsz.py. The
-    # long-context rows keep tokens/step constant (8192) so the seq axis
-    # isolates the attention/flash scaling.
+    # ~700M-param llama-architecture slice (hidden 1536, 12 heads × 128,
+    # ff 4h, 16 layers); the largest credible-aspect-ratio slice whose
+    # fp32 adam state fits one v5e chip. Widening from the r3 config
+    # (hidden 1024 × 24 layers) raised MFU 0.434 → 0.593 at seq 1024 —
+    # the wider matmuls amortise MXU tiles far better, which dominates
+    # every other lever tried (fp8 routing is a 0.87x LOSS on v5e — no
+    # native fp8 MXU, see the fp8_vs_bf16 bench row; h2048/8-layer
+    # measures 0.638 but its 8-layer depth is not a shape anyone trains).
+    # Sweep: benchmarks/sweep_mfu.py. The dots_saveable checkpoint policy
+    # (matmul outputs resident, elementwise recomputed) still beats full
+    # remat; the long-context rows keep tokens/step constant (8192) so
+    # the seq axis isolates attention/flash scaling.
     bsz = max(8 * 1024 // seq, 1)
     return (
         LlamaConfig(
             vocab_size=32000,
-            hidden_size=1024,
-            intermediate_size=4096,
-            num_hidden_layers=24,
-            num_attention_heads=16,
-            num_key_value_heads=16,
+            hidden_size=1536,
+            intermediate_size=6144,
+            num_hidden_layers=16,
+            num_attention_heads=12,
+            num_key_value_heads=12,
             max_position_embeddings=seq,
             remat=remat,
         ),
@@ -152,6 +156,13 @@ def _forced_seq() -> int:
     return int(sys.argv[4]) if len(sys.argv) > 4 else 1024
 
 
+def _forced_precision() -> str:
+    """argv[5]: mixed-precision mode for the framework step ("bf16"
+    default; "fp8" routes the zoo's dense projections through the scaled
+    float8 matmuls for the fp8-vs-bf16 row)."""
+    return sys.argv[5] if len(sys.argv) > 5 else "bf16"
+
+
 def _time_with_remat_policy(build_and_time, jax):
     """Run a (time, aux) builder under the remat policy: the forced setting
     if given, else prefer the dots_saveable policy. Either way, an OOM
@@ -185,7 +196,7 @@ def _mode_framework(platform: str) -> None:
         batch = _make_batch(config, bsz, seq)
         AcceleratorState._reset_state(reset_partial_state=True)
         GradientState._reset_state()
-        accelerator = Accelerator(mixed_precision="bf16")
+        accelerator = Accelerator(mixed_precision=_forced_precision())
         model, opt = accelerator.prepare(
             LlamaForCausalLM.from_config(config, seed=0), optax.adamw(1e-4)
         )
@@ -300,9 +311,14 @@ def _mode_attn(platform: str) -> None:
 def _mode_mrpc(platform: str) -> None:
     """GLUE-MRPC-style steps/s: the `examples/nlp_example.py` training loop
     (same tokenizer/dataset/model builders) timed on the attached chip —
-    BASELINE.md row #1 as a driver-captured artifact."""
+    BASELINE.md row #1 as a driver-captured artifact. On TPU the model is
+    the reference's actual shape — BERT-base (12L/768h, ~108M params,
+    `bert-base-cased` at `/root/reference/examples/nlp_example.py:91`) at
+    the reference's XLA pad-to-128 sequence length; zero egress only
+    excuses the dataset/tokenizer, not the model shape."""
     import os
 
+    import jax
     import numpy as np
     import optax
 
@@ -313,12 +329,16 @@ def _mode_mrpc(platform: str) -> None:
     from accelerate_tpu.state import AcceleratorState, GradientState
     from accelerate_tpu.utils.random import set_seed
 
+    full = platform == "tpu"
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     accelerator = Accelerator(mixed_precision="bf16" if platform == "tpu" else None)
     set_seed(42)
-    train_loader, _, tokenizer = get_dataloaders(accelerator, 16, 32)
-    model = build_model(tokenizer, seed=42)
+    train_loader, _, tokenizer = get_dataloaders(
+        accelerator, 16, 32, max_length=128 if full else 48
+    )
+    model = build_model(tokenizer, seed=42, full_size=full)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(model.params))
     optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=1e-3)
     model, optimizer, train_loader = accelerator.prepare(model, optimizer, train_loader)
 
@@ -345,6 +365,58 @@ def _mode_mrpc(platform: str) -> None:
     float(np.asarray(last.force()))
     t = time.perf_counter() - t0
     print(f"BENCH_MRPC {n / t:.3f}")
+    print(f"BENCH_MRPC_PARAMS {n_params}")
+
+
+def _mode_cv(platform: str) -> None:
+    """CV BASELINE row: the `examples/cv_example.py` training loop at the
+    reference's exact model/shape — resnet50d, batch 64, 224×224 images
+    (`/root/reference/examples/cv_example.py:121,206`); synthetic image
+    tensors stand in for the image-folder dataset (zero egress), the
+    model and step are the real thing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.mesh import data_sharding
+    from accelerate_tpu.models.resnet import ResNetConfig, ResNetForImageClassification
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    if platform == "cpu":
+        config, bsz, size = ResNetConfig.tiny(), 8, 32
+    else:
+        config, bsz, size = ResNetConfig.resnet50d(num_classes=1000), 64, 224
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(mixed_precision="bf16" if platform == "tpu" else None)
+    model, opt = accelerator.prepare(
+        ResNetForImageClassification.from_config(config, seed=0), optax.adam(3e-2 / 25)
+    )
+    n_params = sum(int(x.size) for x in jax.tree.leaves(model.params))
+    rng = np.random.default_rng(0)
+    sharding = data_sharding(accelerator.mesh)
+    batch = {
+        "pixel_values": jax.device_put(
+            jnp.asarray(rng.standard_normal((bsz, size, size, 3)), jnp.float32), sharding
+        ),
+        "labels": jax.device_put(
+            jnp.asarray(rng.integers(0, config.num_classes, bsz), jnp.int32), sharding
+        ),
+    }
+
+    def step():
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        return out.loss.force()
+
+    n = 20 if platform == "tpu" else 3
+    t = _timed_steps(step, n_warmup=2, n_steps=n) / n
+    print(f"BENCH_CV {1.0 / t:.3f}")
+    print(f"BENCH_CV_PARAMS {n_params}")
 
 
 def _mode_offload(platform: str) -> None:
@@ -360,7 +432,7 @@ def _mode_offload(platform: str) -> None:
 
     jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmarks.big_model_inference.bench_offload import _drop_page_cache, run_config
+    from benchmarks.big_model_inference.bench_offload import _drop_page_cache, run_configs
 
     # raw storage bandwidth on THIS box, so the effective-stream number has
     # its denominator in the artifact (the reference's 3.54 GB/s row was
@@ -380,13 +452,17 @@ def _mode_offload(platform: str) -> None:
     os.remove(raw_path)
     print(f"BENCH_DISKRAW {raw_gbps:.3f}")
 
-    for key, tag, quantize in (
-        ("BENCH_OFFLOAD_FP32", "fp32_disk", False),
-        ("BENCH_OFFLOAD_INT8", "int8_disk", True),
+    keys = {
+        "fp32_disk": "BENCH_OFFLOAD_FP32",
+        "int8_disk": "BENCH_OFFLOAD_INT8",
+        "nf4_disk": "BENCH_OFFLOAD_NF4",
+    }
+    for r in run_configs(
+        [("fp32_disk", False), ("int8_disk", True), ("nf4_disk", "nf4")],
+        layers=12, hidden=1024, tokens=3,
     ):
-        r = run_config(tag, quantize, layers=12, hidden=1024, tokens=3)
         print(
-            f"{key} {tag} {r['s_per_token']} "
+            f"{keys[r['config']]} {r['config']} {r['s_per_token']} "
             f"{r['effective_stream_gb_per_s']} {r['model_bytes']} {int(r['cold_cache'])}"
         )
 
@@ -481,13 +557,40 @@ def main():
     flops_per_step = _train_flops_per_step(n_params, config, bsz, seq)
     mfu = flops_per_step / t_framework / (_peak_flops(device_kind) * n_dev)
 
-    # ---- extra rows (all best-effort): long context, MRPC, disk offload
+    # ---- extra rows (all best-effort): long context, fp8, MRPC, cv, offload
     extra_rows = []
     if platform == "tpu":
         for s in (2048, 4096):
             row = _seq_row(platform, device_kind, n_dev, s)
             if row:
                 extra_rows.append(row)
+        try:
+            # fp8 vs bf16, SAME program variant (full remat: the f8
+            # custom-vjp residuals exceed HBM under dots_saveable)
+            fp8 = _run_subprocess(
+                "framework", platform, attempts=2, extra_args=("1", "1024", "fp8")
+            )
+            b16 = _run_subprocess(
+                "framework", platform, attempts=2, extra_args=("1", "1024", "bf16")
+            )
+            ratio = float(b16["BENCH_RESULT"][0]) / float(fp8["BENCH_RESULT"][0])
+            extra_rows.append(
+                {
+                    "metric": "fp8_vs_bf16_train_step_speedup",
+                    "value": round(ratio, 4),
+                    "unit": "x",
+                    "note": "scaled-float8 dense projections (ops/fp8.py, "
+                    "TE HYBRID recipe) vs bf16, same model/remat. v5e has "
+                    "no native fp8 MXU — the f8 operands upcast to bf16, so "
+                    "the quantize overhead makes this <1.0 here; the recipe "
+                    "pays on fp8-capable generations (v6e+) and in f8 "
+                    "activation-residual memory. Reference ships fp8 "
+                    "benches without recorded results "
+                    "(benchmarks/fp8/transformer_engine/)",
+                }
+            )
+        except Exception:
+            pass
     try:
         mrpc = _run_subprocess("mrpc", platform, attempts=2)
         extra_rows.append(
@@ -495,7 +598,26 @@ def main():
                 "metric": "mrpc_train_steps_per_sec",
                 "value": float(mrpc["BENCH_MRPC"][0]),
                 "unit": "steps/s",
-                "note": "examples/nlp_example.py loop (BASELINE row #1)",
+                "n_params": int(mrpc.get("BENCH_MRPC_PARAMS", ["0"])[0]),
+                "note": "examples/nlp_example.py loop (BASELINE row #1) at "
+                "the reference's model shape: BERT-base 12L/768h (~108M "
+                "params, nlp_example.py:91), batch 16, pad-to-128 collate",
+            }
+        )
+    except Exception:
+        pass
+    try:
+        cv = _run_subprocess("cv", platform, attempts=2)
+        extra_rows.append(
+            {
+                "metric": "cv_train_steps_per_sec",
+                "value": float(cv["BENCH_CV"][0]),
+                "unit": "steps/s",
+                "n_params": int(cv.get("BENCH_CV_PARAMS", ["0"])[0]),
+                "note": "examples/cv_example.py loop (BASELINE row: "
+                "ResNet-style data-parallel) at the reference's shape — "
+                "resnet50d, batch 64, 224x224 "
+                "(reference cv_example.py:121,206); synthetic images",
             }
         )
     except Exception:
@@ -503,7 +625,7 @@ def main():
     try:
         off = _run_subprocess("offload", platform, attempts=2)
         disk_raw = float(off.get("BENCH_DISKRAW", ["0"])[0]) or None
-        for key in ("BENCH_OFFLOAD_FP32", "BENCH_OFFLOAD_INT8"):
+        for key in ("BENCH_OFFLOAD_FP32", "BENCH_OFFLOAD_INT8", "BENCH_OFFLOAD_NF4"):
             if key not in off:
                 continue
             tag, s_tok, gbps, nbytes, cold = off[key]
@@ -517,9 +639,17 @@ def main():
             )
             if tag.startswith("int8"):
                 note += (
-                    "; the int8 row moves 4x fewer bytes but is "
-                    "dequant-COMPUTE-bound on this CPU measurement backend "
-                    "(on TPU the q*scale upcast fuses into the matmul)"
+                    "; int8 moves 4x fewer bytes AND computes as an int8 "
+                    "GEMM (oneDNN/MXU — bnb Linear8bitLt semantics), so "
+                    "s/token beats fp32's"
+                )
+            if tag.startswith("nf4"):
+                note += (
+                    "; nf4 moves 7.7x fewer bytes; nibbles decode to int8 "
+                    "codes via the native AVX2 pshufb decoder on the host "
+                    "fetch path (accelerate_tpu/native/q4decode.c) and the "
+                    "matmul runs as per-block int8 GEMMs, so s/token beats "
+                    "fp32's"
                 )
             extra_rows.append(
                 {
@@ -561,7 +691,7 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
-        "probe", "framework", "raw", "attn", "mrpc", "offload"
+        "probe", "framework", "raw", "attn", "mrpc", "cv", "offload"
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -570,6 +700,7 @@ if __name__ == "__main__":
             "raw": _mode_raw,
             "attn": _mode_attn,
             "mrpc": _mode_mrpc,
+            "cv": _mode_cv,
             "offload": _mode_offload,
         }
         dispatch[mode](platform)

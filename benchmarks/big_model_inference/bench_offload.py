@@ -43,12 +43,10 @@ def _drop_page_cache() -> bool:
         return False
 
 
-def run_config(tag: str, quantize: bool, layers: int, hidden: int, tokens: int) -> dict:
-    import jax
-    import numpy as np
+def _build_config(tag: str, quantize, layers: int, hidden: int):
+    import time as _time
 
     from accelerate_tpu.big_modeling import dispatch_model
-    from accelerate_tpu.generation import generate
     from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
     from accelerate_tpu.utils.quantization import BnbQuantizationConfig, quantize_model_params
 
@@ -57,50 +55,85 @@ def run_config(tag: str, quantize: bool, layers: int, hidden: int, tokens: int) 
         num_hidden_layers=layers, num_attention_heads=16, num_key_value_heads=16,
         max_position_embeddings=256, remat=False,
     )
-    t0 = time.perf_counter()
+    t0 = _time.perf_counter()
     model = LlamaForCausalLM.from_config(config, seed=0)
-    if quantize:
+    if quantize == "nf4":
+        model = quantize_model_params(
+            model,
+            BnbQuantizationConfig(load_in_4bit=True, quantize_embeddings=True),
+        )
+    elif quantize:  # int8 (True kept for backward compat)
         model = quantize_model_params(
             model, BnbQuantizationConfig(quantize_embeddings=True)
         )
     offload_dir = tempfile.mkdtemp(prefix=f"bench_offload_{tag}_")
+    dispatched = dispatch_model(model, {"": "disk"}, offload_dir=offload_dir)
+    load_s = _time.perf_counter() - t0
+    bytes_on_disk = sum(
+        os.path.getsize(os.path.join(offload_dir, f))
+        for f in os.listdir(offload_dir)
+        if f.endswith(".dat")
+    )
+    return {
+        "tag": tag, "dispatched": dispatched, "dir": offload_dir,
+        "load_s": load_s, "bytes": bytes_on_disk, "per_token": [],
+    }
+
+
+def run_configs(config_list, layers: int, hidden: int, tokens: int) -> list[dict]:
+    """Measure every configuration INTERLEAVED per token (fp32 token,
+    int8 token, nf4 token, repeat): on a shared 1-core host any ambient
+    CPU load then hits each configuration nearly equally instead of
+    poisoning whichever ran while the neighbour was busy."""
+    import numpy as np
+
+    from accelerate_tpu.generation import generate
+
+    # short prompt: the reference's s/token regime (OPT-30B decode,
+    # README.md:36-37) is WEIGHT-MOVEMENT-bound — 120 GB per token
+    # against a trivial prompt's matmuls. A long prompt on this 1-core
+    # measurement host would instead measure prefill compute, which the
+    # effective-stream metric deliberately excludes.
+    ids = np.random.default_rng(0).integers(0, 32000, size=(1, 8)).astype(np.int32)
+    built = [_build_config(tag, quantize, layers, hidden) for tag, quantize in config_list]
     try:
-        dispatched = dispatch_model(model, {"": "disk"}, offload_dir=offload_dir)
-        load_s = time.perf_counter() - t0
-
-        bytes_on_disk = sum(
-            os.path.getsize(os.path.join(offload_dir, f))
-            for f in os.listdir(offload_dir)
-            if f.endswith(".dat")
-        )
-
-        ids = np.random.default_rng(0).integers(0, 32000, size=(1, 32)).astype(np.int32)
-        # warmup: one token (compiles every segment fn)
-        generate(dispatched, ids, max_new_tokens=1)
-        # each measured token starts cold-cache so its disk read is real
-        # (same input each time → identical shapes, compile stays cached)
-        per_token = []
+        for b in built:  # warmup: one token (compiles every segment fn)
+            generate(b["dispatched"], ids, max_new_tokens=1)
         cold = True
         for _ in range(tokens):
-            cold = _drop_page_cache() and cold
-            t0 = time.perf_counter()
-            generate(dispatched, ids, max_new_tokens=1)
-            per_token.append(time.perf_counter() - t0)
-        s_per_token = sum(per_token) / len(per_token)
-
-        bw = bytes_on_disk / s_per_token
-        return {
-            "config": tag,
-            "load_s": round(load_s, 2),
-            "model_bytes": bytes_on_disk,
-            "cold_cache": cold,
-            "s_per_token": round(s_per_token, 4),
-            "effective_stream_gb_per_s": round(bw / 1e9, 3),
-            "reference_opt30b_row_gb_per_s": 3.54,
-            "beats_reference_row": bw / 1e9 > 3.54,
-        }
+            for b in built:
+                # each measured token starts cold-cache so its disk read
+                # is real (same input → identical shapes, compile cached)
+                cold = _drop_page_cache() and cold
+                t0 = time.perf_counter()
+                generate(b["dispatched"], ids, max_new_tokens=1)
+                b["per_token"].append(time.perf_counter() - t0)
+        results = []
+        for b in built:
+            # median, not mean: one ambient-load spike shouldn't own a row
+            s_per_token = float(np.median(b["per_token"]))
+            bw = b["bytes"] / s_per_token
+            results.append(
+                {
+                    "config": b["tag"],
+                    "load_s": round(b["load_s"], 2),
+                    "model_bytes": b["bytes"],
+                    "cold_cache": cold,
+                    "s_per_token": round(s_per_token, 4),
+                    "effective_stream_gb_per_s": round(bw / 1e9, 3),
+                    "reference_opt30b_row_gb_per_s": 3.54,
+                    "beats_reference_row": bw / 1e9 > 3.54,
+                }
+            )
+        return results
     finally:
-        shutil.rmtree(offload_dir, ignore_errors=True)
+        for b in built:
+            shutil.rmtree(b["dir"], ignore_errors=True)
+
+
+def run_config(tag: str, quantize, layers: int, hidden: int, tokens: int) -> dict:
+    """Single-configuration entry kept for direct CLI use."""
+    return run_configs([(tag, quantize)], layers, hidden, tokens)[0]
 
 
 def main():
@@ -122,8 +155,10 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    for tag, quantize in (("fp32_disk", False), ("int8_disk", True)):
-        result = run_config(tag, quantize, args.layers, args.hidden, args.tokens)
+    for result in run_configs(
+        [("fp32_disk", False), ("int8_disk", True), ("nf4_disk", "nf4")],
+        args.layers, args.hidden, args.tokens,
+    ):
         print(json.dumps(result))
 
 

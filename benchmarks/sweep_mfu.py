@@ -1,0 +1,124 @@
+"""Model-shape / precision sweep behind the flagship bench config.
+
+Times the hand-fused raw-jit train step (the same program ``bench.py``'s
+``raw`` mode measures) for candidate Llama-architecture slices on the
+attached chip, one subprocess per config (clean HBM). Round-4 findings on
+TPU v5e that picked the current flagship (hidden 1536 / 16 layers):
+
+    ctl_1024   (h1024 ff4096 L24, r3 flagship)  mfu 0.434
+    h1536_L16  (h1536 ff6144 L16, 702M)         mfu 0.593   <- flagship
+    h2048_L8   (h2048 ff8192 L12→L8, 668M)      mfu 0.638   (too shallow)
+    h1536_L16 @seq2048 bsz4                     mfu 0.568
+    h1536_L16 @seq4096 bsz2                     mfu 0.547
+    h1536_L16 fp8 dense (full remat both)       0.87x bf16  (no native
+                                                 fp8 MXU on v5e)
+
+Run: ``python benchmarks/sweep_mfu.py`` (all configs) or
+``python benchmarks/sweep_mfu.py <name>`` (one config, in-process).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+CONFIGS = {
+    # name: (hidden, ff, layers, heads, seq, bsz, dense_mode)
+    "ctl_1024": (1024, 4096, 24, 16, 1024, 8, "bf16"),
+    "h1536_L16": (1536, 6144, 16, 12, 1024, 8, "bf16"),
+    "h2048_L8": (2048, 8192, 8, 16, 1024, 8, "bf16"),
+    "h1536_L16_s2048": (1536, 6144, 16, 12, 2048, 4, "bf16"),
+    "h1536_L16_s4096": (1536, 6144, 16, 12, 4096, 2, "bf16"),
+    # fp8 comparisons run under FULL remat (the f8 custom-vjp residuals
+    # exceed HBM under dots_saveable); suffix _rT forces it
+    "h1536L16_bf16_rT": (1536, 6144, 16, 12, 1024, 8, "bf16"),
+    "h1536L16_f8_rT": (1536, 6144, 16, 12, 1024, 8, "f8"),
+}
+
+
+def child(name: str) -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.ops.fp8 import fp8_autocast
+
+    h, ff, L, nh, seq, bsz, dense_mode = CONFIGS[name]
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=h, intermediate_size=ff,
+        num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nh,
+        max_position_embeddings=seq,
+        remat=(True if name.endswith("_rT") else "dots_saveable"),
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32000, size=(bsz, seq)).astype(np.int32)
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    tx = optax.adamw(1e-4)
+    params = model.params
+    opt_state = tx.init(params)
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids)}
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+
+    def loss_fn(p, b):
+        p16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            p,
+        )
+        if dense_mode == "f8":
+            with fp8_autocast(enabled=True):
+                return model.apply_fn(p16, **b)["loss"].astype(jnp.float32)
+        return model.apply_fn(p16, **b)["loss"].astype(jnp.float32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, s, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    state = {"p": params, "s": opt_state}
+
+    def step():
+        state["p"], state["s"], loss = train_step(state["p"], state["s"], batch)
+        return loss
+
+    for _ in range(2):
+        last = step()
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        last = step()
+    lv = float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 10
+    tokens = bsz * seq
+    attn = 6.0 * L * tokens * seq * h
+    flops = 6.0 * n_params * tokens + attn
+    print(
+        f"RESULT {name} t={t:.4f}s tok/s={tokens / t:.0f} "
+        f"mfu={flops / t / 197e12:.4f} n_params={n_params} loss={lv:.3f}"
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        child(sys.argv[1])
+        sys.exit(0)
+    for name in CONFIGS:
+        r = subprocess.run(
+            [sys.executable, __file__, name], capture_output=True, text=True, timeout=1800
+        )
+        out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+        print(
+            out[0]
+            if out
+            else f"RESULT {name} FAILED rc={r.returncode}\n{r.stderr[-800:]}"
+        )
+        sys.stdout.flush()

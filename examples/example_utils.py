@@ -92,26 +92,42 @@ class SimpleLoader:
         self.collate_fn = None
 
 
-def get_dataloaders(accelerator, batch_size: int = 16, eval_batch_size: int = 32):
+def get_dataloaders(
+    accelerator, batch_size: int = 16, eval_batch_size: int = 32,
+    max_length: int = MAX_LENGTH,
+):
     """Tokenize the vendored corpus and build train/eval loaders (reference
-    ``get_dataloaders`` ``examples/nlp_example.py:47``)."""
+    ``get_dataloaders`` ``examples/nlp_example.py:47``). ``max_length=128``
+    reproduces the reference's XLA pad-to-128 collate
+    (``examples/nlp_example.py:81``)."""
     train_rows = read_split("train")
     with accelerator.main_process_first():
         tokenizer = WordTokenizer(train_rows)
-        train = ParaphraseDataset(train_rows, tokenizer)
-        dev = ParaphraseDataset(read_split("dev"), tokenizer)
+        train = ParaphraseDataset(train_rows, tokenizer, max_length=max_length)
+        dev = ParaphraseDataset(read_split("dev"), tokenizer, max_length=max_length)
     train_loader = SimpleLoader(train, batch_size, shuffle=True, drop_last=True)
     eval_loader = SimpleLoader(dev, eval_batch_size)
     return train_loader, eval_loader, tokenizer
 
 
-def build_model(tokenizer, seed: int = 42):
+def build_model(tokenizer, seed: int = 42, full_size: bool = False):
+    """``full_size=True`` builds the BERT-base shape the reference trains
+    (``bert-base-cased``: 12 layers, hidden 768, ~108M params —
+    ``examples/nlp_example.py:91``); the embedding table is padded to the
+    bert-base-cased vocab (28996) so the parameter count is honest even
+    though the vendored word tokenizer uses fewer rows. The default tiny
+    shape keeps example CI fast."""
     from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
 
-    config = BertConfig.tiny(
-        vocab_size=tokenizer.vocab_size, hidden_size=128, layers=2, heads=4,
-        seq=MAX_LENGTH, num_labels=2,
-    )
+    if full_size:
+        config = BertConfig(
+            vocab_size=max(28996, tokenizer.vocab_size), num_labels=2
+        )
+    else:
+        config = BertConfig.tiny(
+            vocab_size=tokenizer.vocab_size, hidden_size=128, layers=2, heads=4,
+            seq=MAX_LENGTH, num_labels=2,
+        )
     return BertForSequenceClassification.from_config(config, seed=seed)
 
 

@@ -202,6 +202,17 @@ def test_launched_sync_script():
 
 
 @pytest.mark.slow
+def test_launched_merge_weights_script():
+    """Sharded save → merge-weights → reload proof rides OUR launcher at
+    any device count (reference ``test_merge_weights.py:161``)."""
+    from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
+
+    cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_merge_weights"]
+    out = execute_subprocess_async(cmd)
+    assert "ALL_MERGE_OK" in out.stdout
+
+
+@pytest.mark.slow
 def test_launched_data_loop_script():
     from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
 

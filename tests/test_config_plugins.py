@@ -166,6 +166,54 @@ def test_megatron_plugin_lowers_to_mesh_axes():
     assert shape["cp"] == 1
 
 
+def test_megatron_sp_shards_residual_activations_on_tp():
+    """Under tp>1 + sequence_parallelism=True the norm/residual-region
+    activations are sequence-sharded over the tp group (Megatron-SP,
+    reference ``utils/dataclasses.py:1916-1919,2112``): residual_spec()
+    carries tp on the sequence dim, a compiled forward actually lays the
+    constrained activation out that way, and the numerics are unchanged
+    vs plain TP."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.models.llama import _constrain, residual_spec
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import MegatronLMPlugin
+
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16)).astype(np.int32)
+
+    def run(sp: bool):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc = Accelerator(
+            megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, sequence_parallelism=sp)
+        )
+        spec = residual_spec()
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0)
+        sharded = jax.jit(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(acc.mesh, residual_spec())
+            )
+        )(jnp.zeros((8, 16, 64)))
+        prepared = acc.prepare(model)
+        logits = np.asarray(prepared(input_ids=ids).logits.force())
+        return spec, sharded.sharding, logits
+
+    spec_sp, sharding_sp, logits_sp = run(True)
+    assert spec_sp == P(("dp", "fsdp"), ("cp", "tp"), None)
+    # the compiled layout really shards the sequence dim over tp
+    assert isinstance(sharding_sp, NamedSharding)
+    assert sharding_sp.spec[1] in (("cp", "tp"), "tp") or "tp" in tuple(
+        np.atleast_1d(sharding_sp.spec[1])
+    )
+    spec_tp, sharding_tp, logits_tp = run(False)
+    assert spec_tp == P(("dp", "fsdp"), "cp", None)
+    np.testing.assert_allclose(logits_sp, logits_tp, rtol=2e-5, atol=2e-5)
+
+
 def test_megatron_pp_maps_to_pipeline_axis():
     """pp_degree lowers onto the pp mesh axis (GPipe schedule) the way
     tp_degree lowers onto tp (reference delegates both to Megatron,
