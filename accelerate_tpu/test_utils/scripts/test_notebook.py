@@ -1,0 +1,73 @@
+"""Launched assertion script: ``notebook_launcher`` semantics (reference
+``test_utils/scripts/test_notebook.py:118`` proves its launcher through the
+same path). Checks, in order:
+
+1. a training function launched via ``notebook_launcher`` actually trains
+   (loss decreases) on every attached device;
+2. the mixed-precision env contract is applied for the function's lifetime
+   and cleaned up after;
+3. the pre-initialized-state canary raises (the reference's "restart your
+   notebook" guard, ``launchers.py:165-255`` there).
+
+Run via
+
+    accelerate-tpu launch --num_cpu_devices 8 -m accelerate_tpu.test_utils.scripts.test_notebook
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def train_fn():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel
+
+    assert os.environ.get("ACCELERATE_MIXED_PRECISION") == "no"
+    accelerator = Accelerator()
+    model, opt = accelerator.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.05))
+    x = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    y = 2 * x + 3
+    losses = []
+    for _ in range(6):
+        out = model(x=x, y=y)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(np.asarray(out.loss.force())))
+    assert losses[-1] < losses[0], f"no learning under notebook_launcher: {losses}"
+    return losses[-1]
+
+
+def main():
+    from accelerate_tpu.launchers import notebook_launcher
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    final = notebook_launcher(train_fn, ())
+    assert final is not None
+    assert "ACCELERATE_MIXED_PRECISION" not in os.environ, "env not cleaned up"
+    print("notebook_launcher training ok")
+
+    # the state-already-initialized canary: train_fn built an Accelerator,
+    # so a second launch in this process must refuse with the
+    # restart-your-notebook guidance
+    try:
+        notebook_launcher(train_fn, ())
+    except ValueError as e:
+        assert "restart" in str(e).lower()
+        print("pre-initialized canary ok")
+    else:
+        raise AssertionError("notebook_launcher did not refuse a reused process")
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    assert not PartialState._shared_state
+    print("ALL_NOTEBOOK_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -623,7 +623,7 @@ def _q4_transposed_core(x, scales, V, H, row_codes, dtype):
         ).astype(jnp.float32)
         return out * sx / 127.0
 
-    group = max(1, _any_divisor(nb, max(1, 4096 // max(blk, 1))))
+    group = max(1, _block_for(nb, max(1, 4096 // max(blk, 1))))
     if group < nb:
         ngroups = nb // group
         cg = row_codes("chunked").reshape(ngroups, group, blk, H)
@@ -670,14 +670,6 @@ def q4_decoded_matmul_t(x, d: Q4DecodedTensor):
     )
 
 
-def _any_divisor(n: int, target: int) -> int:
-    """Largest divisor of ``n`` that is <= target."""
-    for c in range(min(target, n), 0, -1):
-        if n % c == 0:
-            return c
-    return 1
-
-
 def _even_chunk(n: int, target: int) -> int:
     """Largest even divisor of ``n`` that is <= target (or ``n`` itself
     when nothing smaller divides it evenly)."""
@@ -693,12 +685,15 @@ def dequantize_tree(params, dtype=jnp.float32):
     def _deq(l):
         if isinstance(l, Q4Tensor):
             return dequantize_array_4bit(l, dtype)
+        if isinstance(l, Q4DecodedTensor):
+            return l.dequantize(dtype)
         if isinstance(l, QTensor):
             return dequantize_array(l, dtype)
         return l
 
     return jax.tree.map(
-        _deq, params, is_leaf=lambda l: isinstance(l, (QTensor, Q4Tensor))
+        _deq, params,
+        is_leaf=lambda l: isinstance(l, (QTensor, Q4Tensor, Q4DecodedTensor)),
     )
 
 

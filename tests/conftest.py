@@ -9,16 +9,23 @@ test_utils/testing.py``) but inside one process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-if "collective_call_terminate_timeout" not in flags:
-    # single-core machines time-slice all 8 device threads: a heavy program
-    # can exceed XLA CPU's default 40s collective rendezvous window, which
-    # ABORTS the process. Give the scheduler room.
-    flags = (flags + " --xla_cpu_collective_call_terminate_timeout_seconds=600").strip()
-os.environ["XLA_FLAGS"] = flags
+#: ``ACCELERATE_TEST_BACKEND=tpu`` runs the suite against the attached
+#: real backend instead of the virtual CPU mesh (the reference's
+#: ``get_backend`` override) — that is the lane where ``require_tpu``
+#: tests (e.g. the bf16-over-ICI GPipe smoke) actually execute.
+_TEST_BACKEND = os.environ.get("ACCELERATE_TEST_BACKEND", "cpu").lower()
+
+if _TEST_BACKEND == "cpu":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if "collective_call_terminate_timeout" not in flags:
+        # single-core machines time-slice all 8 device threads: a heavy
+        # program can exceed XLA CPU's default 40s collective rendezvous
+        # window, which ABORTS the process. Give the scheduler room.
+        flags = (flags + " --xla_cpu_collective_call_terminate_timeout_seconds=600").strip()
+    os.environ["XLA_FLAGS"] = flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -27,7 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # update below always wins as long as it runs before backend init.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _TEST_BACKEND == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
