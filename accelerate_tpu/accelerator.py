@@ -167,6 +167,8 @@ class Accelerator:
     """Create once, ``prepare()`` your objects, train (reference
     ``Accelerator`` class ``accelerator.py:162``)."""
 
+    _os_kernel_checked = False  # one warning per process, not per instance
+
     def __init__(
         self,
         device_placement: bool = True,
@@ -385,6 +387,13 @@ class Accelerator:
         self.device_placement = device_placement
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self.rng_types = rng_types or ["python", "numpy", "jax"]
+
+        # one-time old-kernel warning (reference accelerator.py:544)
+        if not Accelerator._os_kernel_checked:
+            Accelerator._os_kernel_checked = True
+            from .utils.other import check_os_kernel
+
+            check_os_kernel()
 
         # fp16 → static loss scale (no dynamic GradScaler needed on TPU)
         self._loss_scale = None

@@ -11,7 +11,7 @@ import os
 #: the same dir)
 ALL_SCRIPTS = (
     "test_script.py", "test_ops.py", "test_sync.py", "test_data_loop.py",
-    "test_merge_weights.py", "test_notebook.py",
+    "test_merge_weights.py", "test_notebook.py", "test_performance.py",
 )
 
 

@@ -3,6 +3,31 @@
 from __future__ import annotations
 
 
+def check_os_kernel():
+    """Warn on Linux kernels below 5.5 (reference ``utils/other.py:316``,
+    called once at ``Accelerator`` init ``accelerator.py:544`` — old
+    kernels degrade host data-path performance, which on TPU hurts the
+    input pipeline and the host↔HBM offload tiers)."""
+    import platform
+    import re
+    import warnings
+
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    m = re.search(r"(\d+\.\d+\.\d+)", info.release)
+    if not m:
+        return
+    version = tuple(int(p) for p in m.group(1).split("."))
+    if version < (5, 5, 0):
+        warnings.warn(
+            f"Detected Linux kernel {m.group(1)}, below the recommended "
+            "minimum of 5.5.0; processes may hang or degrade (reference "
+            "issue #1929). Consider upgrading.",
+            UserWarning,
+        )
+
+
 def convert_bytes(size: float) -> str:
     """Human-readable byte size (reference ``utils/other.py:306``)."""
     for unit in ("bytes", "KB", "MB", "GB", "TB"):

@@ -213,6 +213,17 @@ def test_launched_merge_weights_script():
 
 
 @pytest.mark.slow
+def test_launched_performance_script():
+    """Per-config quality bars (plain/fsdp/deepspeed/bf16) ride OUR
+    launcher (reference ``external_deps/test_performance.py``)."""
+    from accelerate_tpu.test_utils import DEFAULT_LAUNCH_COMMAND, execute_subprocess_async
+
+    cmd = DEFAULT_LAUNCH_COMMAND + ["-m", "accelerate_tpu.test_utils.scripts.test_performance"]
+    out = execute_subprocess_async(cmd)
+    assert "ALL_PERFORMANCE_OK" in out.stdout
+
+
+@pytest.mark.slow
 def test_launched_notebook_script():
     """notebook_launcher's training + pre-initialized-canary proof rides
     OUR launcher (reference ``test_notebook.py:118``)."""

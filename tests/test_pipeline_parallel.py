@@ -82,15 +82,18 @@ def test_pp_bf16_over_ici_on_real_tpu():
     if jax.devices()[0].platform != "tpu" or jax.device_count() < 2:
         pytest.skip("needs >=2 real TPU devices (ACCELERATE_TEST_BACKEND=tpu)")
     _reset()
+    fsdp = jax.device_count() // 2
     acc = Accelerator(
-        mesh_plugin=MeshPlugin(pp=2, fsdp=jax.device_count() // 2),
+        mesh_plugin=MeshPlugin(pp=2, fsdp=fsdp),
         mixed_precision="bf16",
     )
     model, opt = acc.prepare(
         LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=4), seed=0),
         optax.adamw(1e-3),
     )
-    ids = np.random.default_rng(0).integers(0, 256, size=(8, 32)).astype(np.int32)
+    # batch must shard over the fsdp extent on any slice size
+    rows = max(8, 2 * fsdp)
+    ids = np.random.default_rng(0).integers(0, 256, size=(rows, 32)).astype(np.int32)
     out = model(input_ids=ids, labels=ids)
     acc.backward(out.loss)
     opt.step()
@@ -422,10 +425,13 @@ def test_llama_pipeline_rejects_indivisible_stage_split():
             llama_apply(c, params, ids, labels=ids)
 
 
-def test_llama_pipeline_composes_with_cp_grad_parity():
-    """pp=2 × cp=2 (ring attention inside each GPipe stage body) matches
-    the dense single-logical-device loss AND gradients — the long-context
-    flagship combination VERDICT r3 weak-8 asked for."""
+@pytest.mark.parametrize("cp_mode", ["ring", "ulysses"])
+def test_llama_pipeline_composes_with_cp_grad_parity(cp_mode):
+    """pp=2 × cp=2 (context-parallel attention nested inside each GPipe
+    stage body) matches the dense single-logical-device loss AND
+    gradients, for both the ring (ppermute KV) and Ulysses (all_to_all)
+    formulations — the long-context flagship combination VERDICT r3
+    weak-8 asked for."""
     c = LlamaConfig.tiny(layers=2, hidden_size=32, heads=2, seq=64)
     params = init_llama_params(jax.random.PRNGKey(0), c)
     ids = _batch(b=8, s=32)
@@ -435,7 +441,7 @@ def test_llama_pipeline_composes_with_cp_grad_parity():
 
     loss_d, grads_d = jax.value_and_grad(loss_fn)(params)
     mesh = build_mesh(MeshPlugin(dp=2, pp=2, cp=2))
-    with attention_context(mesh=mesh, cp_mode="ring"), jax.set_mesh(mesh):
+    with attention_context(mesh=mesh, cp_mode=cp_mode), jax.set_mesh(mesh):
         loss_p, grads_p = jax.jit(jax.value_and_grad(loss_fn))(params)
         loss_p = float(loss_p)
     assert abs(loss_p - float(loss_d)) < 1e-4
