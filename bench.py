@@ -459,7 +459,7 @@ def _mode_offload(platform: str) -> None:
     }
     for r in run_configs(
         [("fp32_disk", False), ("int8_disk", True), ("nf4_disk", "nf4")],
-        layers=12, hidden=1024, tokens=3,
+        layers=12, hidden=1024, tokens=5,
     ):
         print(
             f"{keys[r['config']]} {r['config']} {r['s_per_token']} "

@@ -268,6 +268,25 @@ def test_4bit_streaming_offload_matches_resident(tmp_path):
     assert agree > 0.9, f"argmax agreement {agree:.3f}"
 
 
+def test_4bit_streaming_without_native_decoder(monkeypatch):
+    """Hosts where the native pshufb decoder cannot build (no compiler /
+    non-x86 scalar build failure) must stream 4-bit models through the
+    in-jit Q4Tensor path with the same results."""
+    import accelerate_tpu.native as native
+
+    monkeypatch.setattr(native, "q4_decode_codes", lambda *a, **k: None)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=64, layers=2, heads=4, seq=32)
+    q = quantize_model_params(
+        LlamaForCausalLM.from_config(cfg, seed=0),
+        BnbQuantizationConfig(load_in_4bit=True),
+    )
+    ids = np.random.default_rng(4).integers(0, 128, size=(2, 16)).astype(np.int32)
+    resident = np.asarray(q.apply_fn(q.params, input_ids=ids)["logits"])
+    out = np.asarray(cpu_offload(q)(input_ids=ids)["logits"])
+    rel = np.max(np.abs(out - resident)) / max(np.abs(resident).max(), 1e-6)
+    assert rel < 0.06, f"no-native streaming drifted {rel:.4f}"
+
+
 def test_4bit_quarters_device_map_accounting():
     cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=64, layers=2, heads=4, seq=32)
     fp32 = LlamaForCausalLM.from_config(cfg, seed=0)
