@@ -68,6 +68,45 @@ def test_pad_across_processes(accelerator):
     accelerator.print("pad_across_processes ok")
 
 
+def test_broadcast_object_list(accelerator):
+    from accelerate_tpu import operations as ops
+
+    payload = [{"rank": accelerator.process_index}, "marker", 7]
+    out = ops.broadcast_object_list(list(payload), from_process=0)
+    assert out == [{"rank": 0}, "marker", 7], out
+    accelerator.print("broadcast_object_list ok")
+
+
+def test_copy_tensor_to_devices(accelerator):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    t = jnp.arange(4, dtype=jnp.float32) * (accelerator.process_index + 1)
+    copied = ops.copy_tensor_to_devices(t)
+    # every device holds process 0's values (reference test_ops
+    # ``test_copy_tensor_to_devices``)
+    np.testing.assert_array_equal(
+        np.asarray(copied), np.arange(4, dtype=np.float32)
+    )
+    assert len(copied.sharding.device_set) == jax.device_count()
+    accelerator.print("copy_tensor_to_devices ok")
+
+
+def test_slice_and_concatenate(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import operations as ops
+
+    t = {"a": jnp.arange(8, dtype=jnp.float32)}
+    sl = ops.slice_tensors(t, slice(2, 5))
+    np.testing.assert_array_equal(np.asarray(sl["a"]), [2.0, 3.0, 4.0])
+    cat = ops.concatenate([t, t])
+    assert np.asarray(cat["a"]).shape == (16,)
+    accelerator.print("slice/concatenate ok")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -75,8 +114,11 @@ def main():
     test_gather(accelerator)
     test_gather_object(accelerator)
     test_broadcast(accelerator)
+    test_broadcast_object_list(accelerator)
     test_reduce(accelerator)
     test_pad_across_processes(accelerator)
+    test_copy_tensor_to_devices(accelerator)
+    test_slice_and_concatenate(accelerator)
     accelerator.print("ALL_OPS_OK")
 
 
