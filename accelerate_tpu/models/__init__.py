@@ -64,6 +64,15 @@ def _zoo():
         )
     except ImportError:
         pass
+    try:
+        from .vit import ViTConfig, ViTForImageClassification
+
+        z["vit-base-patch16-224"] = (
+            ViTConfig.vit_b16(),
+            lambda c: ViTForImageClassification.from_config(c),
+        )
+    except ImportError:
+        pass
     return z
 
 
@@ -163,4 +172,8 @@ def model_factory_for_config(config):
         from .resnet import ResNetForImageClassification
 
         return lambda c: ResNetForImageClassification.from_config(c)
+    if name == "ViTConfig":
+        from .vit import ViTForImageClassification
+
+        return lambda c: ViTForImageClassification.from_config(c)
     raise ValueError(f"no factory for {name}")

@@ -155,13 +155,21 @@ def _bottleneck_d(config, block, x, stride):
     return _constrain(out, P(("dp", "fsdp"), None, None, "tp"))
 
 
+def to_nhwc(pixel_values, in_channels: int):
+    """Normalise image input to NHWC: append a channel dim to grayscale
+    ``[b, h, w]`` and accept torch's NCHW layout (shared by every image
+    model in the zoo)."""
+    x = jnp.asarray(pixel_values)
+    if x.ndim == 3:
+        x = x[..., None]
+    if x.shape[-1] != in_channels and x.shape[1] == in_channels:
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
 def resnet_apply(config: ResNetConfig, params, pixel_values=None, labels=None, **kw):
     c = config
-    x = jnp.asarray(pixel_values)
-    if x.ndim == 3:  # [b, h, w] grayscale → channel dim
-        x = x[..., None]
-    if x.shape[-1] != c.in_channels and x.shape[1] == c.in_channels:
-        x = jnp.moveaxis(x, 1, -1)  # accept torch's NCHW
+    x = to_nhwc(pixel_values, c.in_channels)
     s = params["stem"]
     x = _conv(x, s["conv1"], stride=2)
     x = jax.nn.relu(_bn(x, s["g1_gamma"], s["g1_beta"], c.bn_eps))
