@@ -462,15 +462,38 @@ class DispatchedModel:
                 jit_fn = jax.jit(fn)
                 try:
                     carry = jit_fn(seg_params, carry)
-                except (TypeError, AttributeError):
+                except (TypeError, AttributeError) as first_err:
                     # a non-zoo segment fn used bare operators/methods the
                     # quantized nodes don't implement (`w * 0.5`,
                     # `w.astype(...)`) — retrace with every quantized leaf
-                    # dequantized up front, the pre-round-4 semantics
-                    from .utils.quantization import dequantize_tree
+                    # dequantized up front, the pre-round-4 semantics. Only
+                    # quantized leaves justify the retry: a plain-fp32
+                    # segment raising TypeError is a genuine user bug whose
+                    # traceback must not be swallowed by a retrace.
+                    from .utils.quantization import (
+                        Q4DecodedTensor,
+                        Q4Tensor,
+                        QTensor,
+                        dequantize_tree,
+                    )
 
+                    q_types = (QTensor, Q4Tensor, Q4DecodedTensor)
+                    has_quant = any(
+                        isinstance(leaf, q_types)
+                        for leaf in jax.tree.leaves(
+                            seg_params, is_leaf=lambda x: isinstance(x, q_types)
+                        )
+                    )
+                    if not has_quant:
+                        raise
                     jit_fn = jax.jit(lambda seg, c: fn(dequantize_tree(seg), c))
-                    carry = jit_fn(seg_params, carry)
+                    try:
+                        carry = jit_fn(seg_params, carry)
+                    except (TypeError, AttributeError):
+                        # the dequantized retry failed the same way — the
+                        # quantized nodes were a red herring; surface the
+                        # ORIGINAL failure with its traceback
+                        raise first_err from None
                 self._segment_fns[key] = jit_fn
             else:
                 carry = jit_fn(seg_params, carry)

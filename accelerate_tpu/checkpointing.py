@@ -38,6 +38,7 @@ SCHEDULER_NAME = "scheduler"
 SAMPLER_NAME = "sampler"
 RNG_STATE_NAME = "random_states"
 CUSTOM_STATES_NAME = "custom_checkpoint"
+SCALER_NAME = "scaler"  # reference saves GradScaler state as scaler.pt
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +260,11 @@ def save_accelerator_state(
     # sampler/dataloader state_dicts, ``checkpointing.py:116-143``)
     dl_states = [dl.state_dict() for dl in accelerator._dataloaders]
     custom_states = [obj.state_dict() for obj in accelerator._custom_objects]
+    scaler_state = (
+        accelerator._loss_scale.state_dict()
+        if getattr(accelerator, "_loss_scale", None) is not None
+        else None
+    )
     meta = {"step": accelerator.step, "iteration": accelerator.save_iteration}
     rng_state = _collect_rng_state()
     is_main = accelerator.is_main_process
@@ -283,6 +289,9 @@ def save_accelerator_state(
             for i, state in enumerate(custom_states):
                 with open(os.path.join(output_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "wb") as f:
                     pickle.dump(state, f)
+            if scaler_state is not None:
+                with open(os.path.join(output_dir, f"{SCALER_NAME}.bin"), "wb") as f:
+                    pickle.dump(scaler_state, f)
             with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
                 json.dump(meta, f)
         # per-process RNG bundle (every process writes its own, like the
@@ -353,6 +362,10 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     for i, obj in enumerate(accelerator._custom_objects):
         with open(os.path.join(input_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "rb") as f:
             obj.load_state_dict(pickle.load(f))
+    scaler_file = os.path.join(input_dir, f"{SCALER_NAME}.bin")
+    if getattr(accelerator, "_loss_scale", None) is not None and os.path.exists(scaler_file):
+        with open(scaler_file, "rb") as f:
+            accelerator._loss_scale.load_state_dict(pickle.load(f))
     state_file = os.path.join(input_dir, "accelerator_state.json")
     if os.path.exists(state_file):
         with open(state_file) as f:

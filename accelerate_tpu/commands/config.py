@@ -57,6 +57,7 @@ class ClusterConfig:
     context_parallel_mode: str | None = None  # ring | ulysses | allgather
     debug: bool = False
     num_cpu_devices: int = 0  # >0 → virtual CPU mesh (testing)
+    max_restarts: int = 0  # launch fault tolerance: re-exec + auto-resume
     downcast_bf16: bool = False
     tpu_name: str | None = None
     tpu_zone: str | None = None
@@ -187,7 +188,8 @@ def get_cluster_input() -> ClusterConfig:
             ),
             "min_num_params": _ask("Minimum parameter count to shard a tensor?", 0, int),
             "activation_checkpointing": _ask("Use activation checkpointing?", False, bool),
-            "cpu_offload": _ask("Offload optimizer state to host memory?", False, bool),
+            # key name matches the env var the plugin reads (FSDP_OFFLOAD_PARAMS)
+            "offload_params": _ask("Offload optimizer state to host memory?", False, bool),
         }
     elif _ask("Use a DeepSpeed-style ZeRO config instead?", False, bool):
         cfg.use_deepspeed = True

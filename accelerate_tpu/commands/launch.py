@@ -58,6 +58,15 @@ def launch_command_parser(subparsers=None):
     p.add_argument("--pod", action="store_true", help="fan out over TPU pod workers via gcloud ssh")
     p.add_argument("--tpu_name", default=None)
     p.add_argument("--tpu_zone", default=None)
+    # fault tolerance (SURVEY §5: TPU-native analog of torchrun's elastic
+    # agent, reference launchers.py:231-245 — re-exec on crash, resume from
+    # the latest checkpoint via ACCELERATE_AUTO_RESUME)
+    p.add_argument(
+        "--max_restarts", type=int, default=None,
+        help="re-exec the script up to N times on non-zero exit; restarted "
+        "runs get ACCELERATE_AUTO_RESUME=true so an Accelerator with a "
+        "project_dir reloads the latest checkpoint after prepare()",
+    )
     # misc
     p.add_argument("--debug", action="store_true", default=None, help="collective shape verification")
     p.add_argument("-m", "--module", action="store_true", help="script is a python module")
@@ -91,6 +100,7 @@ def _merge_args_into_config(args, cfg: ClusterConfig) -> ClusterConfig:
         ("coordinator_address", "coordinator_address"),
         ("use_fsdp", "use_fsdp"), ("debug", "debug"),
         ("tpu_name", "tpu_name"), ("tpu_zone", "tpu_zone"),
+        ("max_restarts", "max_restarts"),
     ]:
         v = getattr(args, cli, None)
         if v is not None:
@@ -119,11 +129,31 @@ def prepare_environment(args, cfg: ClusterConfig) -> dict[str, str]:
     return env
 
 
-def simple_launcher(cmd: list[str], env: dict[str, str]) -> int:
-    """Single-process spawn (reference ``simple_launcher`` ``launch.py:756``)."""
-    proc = subprocess.Popen(cmd, env=env)
-    proc.wait()
-    return proc.returncode
+def simple_launcher(cmd: list[str], env: dict[str, str], max_restarts: int = 0) -> int:
+    """Single-process spawn (reference ``simple_launcher`` ``launch.py:756``),
+    with checkpoint-autoresume fault tolerance in place of torchrun's elastic
+    agent: on a non-zero exit the script is re-exec'd up to ``max_restarts``
+    times with ``ACCELERATE_AUTO_RESUME=true`` (+ a restart counter), which
+    makes ``Accelerator.prepare`` reload the latest ``checkpoint_*`` under
+    the project_dir — a crashed multi-day run resumes at its last save
+    instead of dying (reference launchers.py:231-245; SURVEY §5)."""
+    restarts = 0
+    while True:
+        proc = subprocess.Popen(cmd, env=env)
+        proc.wait()
+        rc = proc.returncode
+        if rc == 0 or restarts >= max_restarts:
+            return rc
+        restarts += 1
+        env = dict(env)
+        env["ACCELERATE_AUTO_RESUME"] = "true"
+        env["ACCELERATE_RESTART_COUNT"] = str(restarts)
+        print(
+            f"[accelerate-tpu launch] script exited with {rc}; "
+            f"restart {restarts}/{max_restarts} (auto-resume from latest checkpoint)",
+            file=sys.stderr,
+            flush=True,
+        )
 
 
 def launch_command(args) -> int:
@@ -139,7 +169,7 @@ def launch_command(args) -> int:
         cmd = [sys.executable, "-m", args.training_script, *args.training_script_args]
     else:
         cmd = [sys.executable, args.training_script, *args.training_script_args]
-    rc = simple_launcher(cmd, env)
+    rc = simple_launcher(cmd, env, max_restarts=getattr(cfg, "max_restarts", 0) or 0)
     if rc != 0:
         raise RuntimeError(
             f"launch failed (exit {rc}): {' '.join(cmd)}"

@@ -514,19 +514,28 @@ def force_value(deferred: Deferred):
     return jitted(params, inputs)
 
 
-def grad_fn_for(loss: Deferred, trainable_models: list, loss_scale: float = 1.0):
-    """Compiled ``(loss, grads_per_model) = f(params_list, inputs)`` for the
-    loss graph; cached per signature. ``loss_scale`` divides the loss (the
-    reference divides by gradient_accumulation_steps inside ``backward``,
-    ``accelerator.py:2240``)."""
+def grad_fn_for(
+    loss: Deferred,
+    trainable_models: list,
+    loss_scale: float = 1.0,
+    dynamic_scale: bool = False,
+    comm_hook: tuple | None = None,  # (hook_str, mesh) → ddp_compressed_vag
+):
+    """Compiled ``(loss, grads_per_model) = f(params_list, inputs[, scale])``
+    for the loss graph; cached per signature. ``loss_scale`` divides the loss
+    (the reference divides by gradient_accumulation_steps inside ``backward``,
+    ``accelerator.py:2240``). With ``dynamic_scale`` the jitted fn takes one
+    extra device-scalar argument that MULTIPLIES the loss — the fp16
+    LossScaler's current scale, traced so backoff/growth never recompiles."""
     root = loss._node
     sig, inputs, models = linearize(root)
     trainables = [m for m in models if m in trainable_models]
     frozen = [m for m in models if m not in trainable_models]
-    key = (sig, tuple(id(m) for m in models), tuple(id(m) for m in trainables), loss_scale)
+    key = (sig, tuple(id(m) for m in models), tuple(id(m) for m in trainables), loss_scale,
+           dynamic_scale, comm_hook[0] if comm_hook else None)
     entry = _GRAD_CACHE.get(key)
     if entry is None:
-        def loss_fn(train_params: list, frozen_params: list, input_values: list):
+        def loss_fn(train_params: list, frozen_params: list, input_values: list, *scale):
             env = {id(m): p for m, p in zip(trainables, train_params)}
             env.update({id(m): p for m, p in zip(frozen, frozen_params)})
             out = replay(root, input_values, env)
@@ -537,13 +546,88 @@ def grad_fn_for(loss: Deferred, trainable_models: list, loss_scale: float = 1.0)
                     "Reduce it (e.g. .mean()) first."
                 )
             unscaled = out.astype(jnp.float32)
-            return (unscaled / loss_scale), unscaled
+            scaled = unscaled / loss_scale
+            if dynamic_scale:
+                scaled = scaled * scale[0]
+            return scaled, unscaled
 
-        vag = jax.value_and_grad(loss_fn, argnums=0, has_aux=True)
+        if comm_hook is not None:
+            vag = ddp_compressed_vag(loss_fn, comm_hook[1], inputs, comm_hook[0])
+        else:
+            vag = jax.value_and_grad(loss_fn, argnums=0, has_aux=True)
         entry = (_cost_aware_jit(vag, label="grad"), trainables, frozen)
         _GRAD_CACHE[key] = entry
     jitted, trainables, frozen = entry
     return jitted, trainables, frozen, inputs
+
+
+def ddp_compressed_vag(loss_fn, mesh, input_values, hook: str):
+    """``value_and_grad`` with an EXPLICIT data-parallel gradient reduction
+    whose wire dtype is compressed — the TPU-native analog of the
+    reference's DDP communication hooks (``fp16_compress_hook`` /
+    ``bf16_compress_hook``, reference ``utils/dataclasses.py:117-214``).
+
+    Under plain GSPMD the cross-replica grad all-reduce is implicit (XLA
+    inserts it in the grads' dtype), so there is no seam to compress. This
+    helper creates that seam: the loss/grad computation runs under
+    ``shard_map`` over the batch axes, each shard computes LOCAL grads, and
+    the cross-shard reduction is an explicit ``psum`` in bf16/fp16 — on a
+    multi-slice DCN mesh that halves bytes-on-wire for the gradient sync,
+    which is the whole point of the reference's hook. Semantics match DDP:
+    gradients are AVERAGED across shards; the returned loss is the
+    cross-shard mean of local losses.
+
+    Scope (same as the reference's DDP hooks, which are DP-only): a mesh
+    whose non-batch axes (tp/pp/cp/ep/fsdp) all have extent 1 — params
+    replicated, batch sharded.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    wire = {"bf16": jnp.bfloat16, "fp16": jnp.float16}[hook]
+    shape = dict(mesh.shape)
+    batch_axes = tuple(a for a in ("dp", "fsdp") if shape.get(a, 1) > 1)
+    n_shards = 1
+    for a in batch_axes:
+        n_shards *= shape[a]
+
+    def _spec_for(x):
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        if not spec:
+            return P()
+        names: set = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            names.update(entry if isinstance(entry, (tuple, list)) else (entry,))
+        return P(*spec) if names & set(batch_axes) else P()
+
+    input_specs = [_spec_for(x) for x in input_values]
+
+    def vag(params, frozen_params, inputs, *rest):
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), input_specs) + (P(),) * len(rest),
+            out_specs=((P(), P()), P()),
+            check_vma=False,
+        )
+        def inner(params, frozen_params, inputs, *rest):
+            (scaled, unscaled), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, frozen_params, inputs, *rest
+            )
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g.astype(wire), batch_axes).astype(g.dtype)
+                / n_shards,
+                grads,
+            )
+            return (
+                jax.lax.pmean(scaled, batch_axes),
+                jax.lax.pmean(unscaled, batch_axes),
+            ), grads
+
+        return inner(params, frozen_params, inputs, *rest)
+
+    return vag
 
 
 _FUSED_CACHE: dict = {}
@@ -555,7 +639,8 @@ def fused_step_fn_for(
     tx,
     *,
     clip_norm: bool = False,
-    grad_scaler: float | None = None,
+    grad_scaler=None,  # optimizer.LossScaler | None
+    comm_hook: tuple | None = None,  # (hook_str, mesh) → ddp_compressed_vag
 ):
     loss_scale = 1.0  # fusion only engages without accumulation in flight
     """One donated, jitted train step for the common single-model loop:
@@ -565,9 +650,15 @@ def fused_step_fn_for(
     compat loop cost what a hand-fused pjit step costs.
 
     Returns (jitted, frozen_models, inputs). jitted signature:
-      (params, opt_state, frozen_params, inputs, max_norm)
-        -> (new_params, new_opt_state, loss, grad_norm, step_ok)
+      (params, opt_state, frozen_params, inputs, max_norm, scaler_state)
+        -> (new_params, new_opt_state, loss, grad_norm, step_ok,
+            new_scaler_state)
     ``step_ok`` is False when fp16 grads were non-finite (update skipped).
+    With fp16, ``scaler_state`` is the LossScaler's (scale, good_steps)
+    device pair: the scale is a traced INPUT (growth/backoff never
+    recompiles; only the grow/backoff constants are baked into the trace)
+    and the updated pair comes back as the last output. Without a scaler,
+    pass ``()`` and ``()`` is returned.
     """
     import optax
 
@@ -577,10 +668,11 @@ def fused_step_fn_for(
         raise ValueError("the pending loss does not involve the optimizer's model")
     frozen = [m for m in models if m is not model]
     key = (sig, id(model), id(tx), tuple(id(m) for m in frozen), loss_scale, clip_norm,
-           grad_scaler)
+           None if grad_scaler is None else grad_scaler.trace_key,
+           comm_hook[0] if comm_hook else None)
     entry = _FUSED_CACHE.get(key)
     if entry is None:
-        def loss_fn(params, frozen_params, input_values):
+        def loss_fn(params, frozen_params, input_values, scale):
             env = {id(model): params}
             env.update({id(m): p for m, p in zip(frozen, frozen_params)})
             out = jnp.asarray(replay(root, input_values, env))
@@ -591,19 +683,29 @@ def fused_step_fn_for(
             unscaled = out.astype(jnp.float32)
             scaled = unscaled / loss_scale
             if grad_scaler is not None:
-                scaled = scaled * grad_scaler  # fp16: scale up against underflow
+                scaled = scaled * scale  # fp16: scale up against underflow
             return scaled, unscaled
 
-        def step(params, opt_state, frozen_params, input_values, max_norm):
-            (_, loss_value), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, frozen_params, input_values
+        if comm_hook is not None:
+            _vag = ddp_compressed_vag(loss_fn, comm_hook[1], inputs, comm_hook[0])
+        else:
+            _vag = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def step(params, opt_state, frozen_params, input_values, max_norm, scaler_state):
+            scale = scaler_state[0] if grad_scaler is not None else jnp.float32(1.0)
+            (_, loss_value), grads = _vag(
+                params, frozen_params, input_values, scale
             )
             step_ok = jnp.bool_(True)
+            new_scaler_state = scaler_state
             if grad_scaler is not None:
-                inv = 1.0 / grad_scaler
+                inv = 1.0 / scale
                 grads = jax.tree.map(lambda g: g * inv, grads)
                 finite = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
                 step_ok = jnp.all(jnp.stack(finite))
+                new_scaler_state = grad_scaler.next_state(
+                    scale, scaler_state[1], step_ok
+                )
             if clip_norm:
                 norm = optax.global_norm(grads)
                 factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
@@ -621,7 +723,7 @@ def fused_step_fn_for(
                 )
                 new_params = keep(new_params, params)
                 new_opt_state = keep(new_opt_state, opt_state)
-            return new_params, new_opt_state, loss_value, norm, step_ok
+            return new_params, new_opt_state, loss_value, norm, step_ok, new_scaler_state
 
         entry = (_cost_aware_jit(step, donate_argnums=(0, 1), label="fused_step"), frozen)
         _FUSED_CACHE[key] = entry

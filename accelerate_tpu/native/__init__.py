@@ -16,6 +16,28 @@ _Q4_LIB = None
 _Q4_TRIED = False
 
 
+def _isa_tag() -> str:
+    """Cache key component for the host ISA: -march=native binaries built on
+    a newer machine must not be reused on an older one sharing the cache
+    dir (NFS home) — that SIGILLs at call time, past the build guard. The
+    CPU feature flags identify what ``native`` resolves to; read from
+    /proc/cpuinfo (no subprocess — this runs in every worker that touches
+    the decoder), falling back to the bare machine arch."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    digest = hashlib.sha256(feats.encode()).hexdigest()[:12]
+                    return f"{platform.machine()}-{digest}"
+    except Exception:
+        pass
+    return platform.machine() or "unknown"
+
+
 def _build_q4decode():
     src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "q4decode.c")
     cache_dir = os.path.join(
@@ -23,7 +45,7 @@ def _build_q4decode():
         "accelerate_tpu",
     )
     os.makedirs(cache_dir, exist_ok=True)
-    lib_path = os.path.join(cache_dir, "libq4decode.so")
+    lib_path = os.path.join(cache_dir, f"libq4decode-{_isa_tag()}.so")
     if not (
         os.path.exists(lib_path)
         and os.path.getmtime(lib_path) >= os.path.getmtime(src)

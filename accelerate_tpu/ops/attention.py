@@ -101,7 +101,10 @@ def resolve_flash_blocks(seq_len: int, ctx: AttentionContext) -> tuple[int, int]
     """Effective (block_q, block_kv) for the flash kernel: the context's
     explicit values win; auto picks 512 q-rows below seq 2048 and 1024
     from there (the deeper grid amortises the online-softmax bookkeeping
-    once there are enough kv blocks per q tile)."""
+    once there are enough kv blocks per q tile). Confirmed optimal for the
+    flagship d=128 head at seq 2048/4096 by the round-5 sweep
+    (benchmarks/ablate_blocks.py): every larger tile (1024x2048, 2048x*)
+    exceeds Mosaic's scoped VMEM at d=128, and 512x1024 is ~1-2% slower."""
     block_q = ctx.block_q if ctx.block_q is not None else (1024 if seq_len >= 2048 else 512)
     block_kv = ctx.block_kv if ctx.block_kv is not None else 1024
     return block_q, block_kv

@@ -6,9 +6,10 @@ gradients accumulate, (b) integrate the GradScaler, (c) detect skipped
 steps. Here the optimizer is an optax ``GradientTransformation``; the
 wrapper owns the optimizer state, the accumulated gradients, and the jitted
 apply step. bf16 needs no loss scaling; with ``mixed_precision='fp16'`` a
-static loss scale is applied and non-finite gradients skip the step
+dynamic :class:`LossScaler` scales the loss, skips non-finite steps
 (preserving the ``optimizer_step_was_skipped`` contract, reference
-``optimizer.py:154-169``).
+``optimizer.py:154-169``), and grows/backs off the scale with the
+reference GradScaler's schedule (``accelerator.py:496-520``).
 """
 
 from __future__ import annotations
@@ -26,6 +27,94 @@ def _tree_add(a, b):
     return jax.tree.map(jnp.add, a, b)
 
 
+class LossScaler:
+    """Dynamic fp16 loss scaler — the reference's ``torch.cuda.amp.GradScaler``
+    (``/root/reference/src/accelerate/accelerator.py:496-520``) rebuilt for the
+    XLA execution model: the scale and the consecutive-good-step counter are
+    DEVICE scalars, passed into the compiled step as inputs and returned
+    updated. On the fused path the grow/backoff decision happens inside the
+    jitted step (no host sync, no retrace when the scale changes); the split
+    path updates eagerly, where the finite check already synchronises.
+
+    Schedule (GradScaler semantics): non-finite grads → ``scale *=
+    backoff_factor`` and the step is skipped; after ``growth_interval``
+    consecutive finite steps → ``scale *= growth_factor``.
+    """
+
+    def __init__(
+        self,
+        init_scale: float = 65536.0,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+    ):
+        if growth_factor <= 1.0:
+            raise ValueError("growth_factor must be > 1.0")
+        if not 0.0 < backoff_factor < 1.0:
+            raise ValueError("backoff_factor must be in (0, 1)")
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self._scale = jnp.asarray(float(init_scale), jnp.float32)
+        self._good_steps = jnp.asarray(0, jnp.int32)
+
+    @property
+    def scale(self) -> jax.Array:
+        """The current scale as a device scalar (safe to pass into jit)."""
+        return self._scale
+
+    def get_scale(self) -> float:
+        return float(jax.device_get(self._scale))
+
+    # -- jit plumbing -------------------------------------------------------
+
+    @property
+    def trace_key(self) -> tuple:
+        """The static config baked into a compiled step. The scale itself is
+        traced, so growth/backoff never triggers a recompile."""
+        return (self.growth_factor, self.backoff_factor, self.growth_interval)
+
+    def state(self) -> tuple:
+        return (self._scale, self._good_steps)
+
+    def set_state(self, state) -> None:
+        self._scale, self._good_steps = state
+
+    def next_state(self, scale, good_steps, step_ok):
+        """Pure GradScaler update rule; usable inside jit."""
+        good = jnp.where(step_ok, good_steps + 1, 0)
+        grow = good >= self.growth_interval
+        new_scale = jnp.where(
+            step_ok,
+            jnp.where(grow, scale * self.growth_factor, scale),
+            scale * self.backoff_factor,
+        )
+        return new_scale, jnp.where(grow, 0, good).astype(jnp.int32)
+
+    def update(self, step_ok: bool) -> None:
+        """Eager update (split path — the finite flag is already on host)."""
+        self.set_state(self.next_state(self._scale, self._good_steps, jnp.bool_(step_ok)))
+
+    # -- checkpoint contract (reference saves scaler.state_dict() as
+    # ``scaler.pt``, ``checkpointing.py:60``) --------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "scale": self.get_scale(),
+            "growth_factor": self.growth_factor,
+            "backoff_factor": self.backoff_factor,
+            "growth_interval": self.growth_interval,
+            "_growth_tracker": int(jax.device_get(self._good_steps)),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.growth_factor = float(sd.get("growth_factor", self.growth_factor))
+        self.backoff_factor = float(sd.get("backoff_factor", self.backoff_factor))
+        self.growth_interval = int(sd.get("growth_interval", self.growth_interval))
+        self._scale = jnp.asarray(float(sd["scale"]), jnp.float32)
+        self._good_steps = jnp.asarray(int(sd.get("_growth_tracker", 0)), jnp.int32)
+
+
 class AcceleratedOptimizer:
     """Owns (tx, opt_state) for one prepared model."""
 
@@ -34,7 +123,7 @@ class AcceleratedOptimizer:
             raise ValueError("optimizer is already prepared")
         self.optimizer = optimizer  # the raw optax transformation
         self.model = model          # PreparedModel, bound during prepare()
-        self.scaler = scaler        # static loss scale (fp16 only)
+        self.scaler = scaler        # LossScaler (fp16 only), shared per Accelerator
         self.accelerator_state = AcceleratorState() if AcceleratorState().initialized else None
         self.gradient_state = GradientState()
         self.opt_state = None
@@ -48,6 +137,7 @@ class AcceleratedOptimizer:
         self._pending_clip: float | None = None
         self._last_norm = None
         self._step_ok_device = None  # fp16: lazily-fetched finite flag
+        self.comm_hook = None  # (hook_str, mesh): compressed dp grad reduction
 
     # -- initialisation (called by Accelerator.prepare) ----------------------
 
@@ -67,7 +157,7 @@ class AcceleratedOptimizer:
         if self._grads_are_unscaled and self.scaler is not None:
             # grads already unscaled by a clip; bring the new contribution
             # into the same units before accumulating
-            inv = 1.0 / self.scaler
+            inv = 1.0 / self.scaler.scale
             grads = jax.tree.map(lambda g: g * inv, grads)
         if self._grads is None:
             self._grads = grads
@@ -126,7 +216,7 @@ class AcceleratedOptimizer:
         (reference GradScaler.unscale_ integration, ``optimizer.py:154``)."""
         if self.scaler is None or self._grads is None or self._grads_are_unscaled:
             return
-        inv = 1.0 / self.scaler
+        inv = 1.0 / self.scaler.scale  # device scalar: no retrace on change
         unscale = self._jit_cache.get("unscale")
         if unscale is None:
             unscale = jax.jit(
@@ -152,14 +242,18 @@ class AcceleratedOptimizer:
             self.optimizer,
             clip_norm=clip is not None,
             grad_scaler=self.scaler,
+            comm_hook=self.comm_hook,
         )
         frozen_params = [m.params for m in frozen]
-        new_params, new_opt_state, loss_value, norm, step_ok = jitted(
+        scaler_state = self.scaler.state() if self.scaler is not None else ()
+        new_params, new_opt_state, loss_value, norm, step_ok, new_scaler_state = jitted(
             self.model.params, self.opt_state, frozen_params, inputs,
-            clip if clip is not None else 0.0,
+            clip if clip is not None else 0.0, scaler_state,
         )
         self.model.params = new_params
         self.opt_state = new_opt_state
+        if self.scaler is not None:
+            self.scaler.set_state(new_scaler_state)
         loss._set_forced(loss_value)
         self._last_norm = norm
         self._step_ok_device = step_ok if self.scaler is not None else None
@@ -178,9 +272,12 @@ class AcceleratedOptimizer:
             self._step_was_skipped = True
             return
         if self.scaler is not None:
-            # fp16 static-scale path: unscale + skip on non-finite
+            # fp16 path: unscale, then skip + backoff on non-finite (and
+            # count good steps toward regrowth — GradScaler.update semantics)
             self.unscale_gradients()
-            if not bool(self._skip_fn()(self._grads)):
+            ok = bool(self._skip_fn()(self._grads))
+            self.scaler.update(ok)
+            if not ok:
                 self._step_was_skipped = True
                 self._grads = None
                 self._grads_are_unscaled = False

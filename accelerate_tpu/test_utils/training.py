@@ -39,3 +39,17 @@ def RegressionModel(a=0.0, b=0.0):
 
 def mse_loss(pred, target):
     return ((pred - target) ** 2).mean()
+
+
+class SimpleLoader:
+    """Duck-typed dataloader stub satisfying ``prepare_data_loader``'s
+    attribute contract (dataset/batch_size/drop_last/sampler/batch_sampler/
+    collate_fn) — the shared fixture the test suites build loaders from."""
+
+    def __init__(self, dataset, batch_size, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.sampler = None
+        self.batch_sampler = None
+        self.collate_fn = None

@@ -66,6 +66,19 @@ class GeneralTracker:
     def log_images(self, values: dict, step: int | None = None, **kwargs):
         pass
 
+    def log_table(
+        self,
+        table_name: str,
+        columns: list[str] | None = None,
+        data: list[list] | None = None,
+        dataframe=None,
+        step: int | None = None,
+        **kwargs,
+    ):
+        """Log a table either as ``columns`` + ``data`` rows or a dataframe.
+        Base implementation is a no-op; WandB/ClearML override (reference
+        ``tracking.py:360,822``)."""
+
     def finish(self):
         pass
 
@@ -125,6 +138,22 @@ class TensorBoardTracker(GeneralTracker):
             self._jsonl.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        """``{name: batch of HWC/NCHW arrays}`` → ``add_images`` (reference
+        ``tracking.py:251``); the JSONL fallback stores ``.npy`` files next
+        to the scalars so the data survives without a SummaryWriter."""
+        if self.writer is not None:
+            for k, v in values.items():
+                self.writer.add_images(k, v, global_step=step, **kwargs)
+            self.writer.flush()
+        else:
+            img_dir = os.path.join(self.logging_dir, "images")
+            os.makedirs(img_dir, exist_ok=True)
+            for k, v in values.items():
+                safe = k.replace("/", "_")
+                np.save(os.path.join(img_dir, f"{safe}_step{step or 0}.npy"), np.asarray(v))
+
+    @on_main_process
     def finish(self):
         if self.writer is not None:
             self.writer.close()
@@ -159,6 +188,31 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs):
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        """``{name: list of images}`` → ``wandb.Image`` wraps (reference
+        ``tracking.py:341``)."""
+        import wandb  # noqa: PLC0415
+
+        for k, v in values.items():
+            self.log({k: [wandb.Image(image) for image in v]}, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(
+        self,
+        table_name: str,
+        columns: list[str] | None = None,
+        data: list[list] | None = None,
+        dataframe=None,
+        step: int | None = None,
+        **kwargs,
+    ):
+        """(Reference ``tracking.py:360``.)"""
+        import wandb  # noqa: PLC0415
+
+        table = wandb.Table(columns=columns, data=data, dataframe=dataframe)
+        self.log({table_name: table}, step=step, **kwargs)
 
     @on_main_process
     def finish(self):
@@ -259,6 +313,23 @@ class AimTracker(GeneralTracker):
             self.writer.track(v, name=k, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, kwargs: dict | None = None):
+        """``{name: image | (image, caption)}`` → ``aim.Image`` tracks
+        (reference ``tracking.py:540``); ``kwargs`` splits into the
+        ``aim_image`` and ``track`` call kwargs."""
+        import aim  # noqa: PLC0415
+
+        kwargs = kwargs or {}
+        image_kw = kwargs.get("aim_image", {})
+        track_kw = kwargs.get("track", {})
+        for k, v in values.items():
+            caption = None
+            if isinstance(v, tuple):
+                v, caption = v
+            image = aim.Image(v, caption=caption, **image_kw) if caption is not None else aim.Image(v, **image_kw)
+            self.writer.track(image, name=k, step=step, **track_kw)
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -292,6 +363,38 @@ class ClearMLTracker(GeneralTracker):
                 continue
             title, _, series = k.partition("/")
             clearml_logger.report_scalar(title=title, series=series or title, value=v, iteration=step or 0)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        """(Reference ``tracking.py:804``.)"""
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            title, _, series = k.partition("/")
+            clearml_logger.report_image(
+                title=title, series=series or title, iteration=step, image=v, **kwargs
+            )
+
+    @on_main_process
+    def log_table(
+        self,
+        table_name: str,
+        columns: list[str] | None = None,
+        data: list[list] | None = None,
+        dataframe=None,
+        step: int | None = None,
+        **kwargs,
+    ):
+        """``columns`` + ``data`` rows, or a dataframe (reference
+        ``tracking.py:822``)."""
+        to_report = dataframe
+        if dataframe is None:
+            if data is None:
+                raise ValueError("log_table needs `data` when `dataframe` is None")
+            to_report = [columns] + data if columns else data
+        title, _, series = table_name.partition("/")
+        self.task.get_logger().report_table(
+            title=title, series=series or title, table_plot=to_report, iteration=step, **kwargs
+        )
 
     @on_main_process
     def finish(self):

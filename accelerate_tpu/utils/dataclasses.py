@@ -105,9 +105,13 @@ class InitProcessGroupKwargs(KwargsHandler):
 
 @dataclass
 class GradScalerKwargs(KwargsHandler):
-    """Kept for API parity; bf16-on-TPU needs no loss scaling. When
-    ``mixed_precision='fp16'`` we run a static loss scale instead of the
-    reference's dynamic ``torch.cuda.amp.GradScaler`` (``dataclasses.py:215``)."""
+    """Configures the fp16 dynamic loss scaler (reference
+    ``torch.cuda.amp.GradScaler`` kwargs, ``dataclasses.py:215``): the scale
+    starts at ``init_scale``, backs off by ``backoff_factor`` on non-finite
+    grads, and grows by ``growth_factor`` after ``growth_interval``
+    consecutive finite steps (``accelerate_tpu.optimizer.LossScaler``).
+    bf16-on-TPU needs no scaling; the handler only matters under
+    ``mixed_precision='fp16'``. ``enabled=False`` disables scaling."""
 
     init_scale: float = 65536.0
     growth_factor: float = 2.0

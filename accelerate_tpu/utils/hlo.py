@@ -1,0 +1,58 @@
+"""Tiny HLO/StableHLO text introspection helpers.
+
+Used by the comm-hook wire-bytes proof (tests) and the bench's
+``dp_grad_compression_wire_bytes_ratio`` row: both need "how many bytes do
+the all-reduce ops in this module move, by dtype" — one parser so the
+regexes can't drift apart. No reference analog (torch exposes comm bytes
+via NCCL debug env; XLA exposes the program text).
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1,
+}
+
+#: ``"stablehlo.all_reduce"(%x) ... : (tensor<32x32xbf16>) -> ...`` —
+#: pre-optimization module: the wire dtype as TRACED (what TPU executes;
+#: XLA:CPU's backend pass may later promote bf16 collectives to f32)
+_STABLEHLO_ALLREDUCE = re.compile(
+    r"stablehlo\.all_reduce.*?\(tensor<([0-9x]*)x?(\w+)>\)\s*->", re.DOTALL
+)
+
+#: ``%ar = (f32[], f32[32,32]) all-reduce(...)`` — compiled HLO form,
+#: including tuple-shaped combined all-reduces
+_HLO_ALLREDUCE = re.compile(r"=\s*\(?((?:\w+\[[0-9,]*\][^)=]*?,?\s*)+)\)?\s*all-reduce\(")
+_HLO_SHAPE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def _numel(dims: str, sep: str) -> int:
+    n = 1
+    for d in dims.split(sep):
+        if d:
+            n *= int(d)
+    return n
+
+
+def stablehlo_allreduce_bytes(text: str) -> dict[str, int]:
+    """{dtype: operand bytes} over every ``stablehlo.all_reduce`` op."""
+    out: dict[str, int] = {}
+    for m in _STABLEHLO_ALLREDUCE.finditer(text):
+        dims, dtype = m.group(1), m.group(2)
+        out[dtype] = out.get(dtype, 0) + _numel(dims, "x") * _DTYPE_BYTES.get(dtype, 4)
+    return out
+
+
+def hlo_allreduce_bytes(text: str) -> dict[str, int]:
+    """{dtype: result bytes} over every compiled-HLO ``all-reduce`` op."""
+    out: dict[str, int] = {}
+    for m in _HLO_ALLREDUCE.finditer(text):
+        for t in _HLO_SHAPE.finditer(m.group(1)):
+            dtype, dims = t.group(1), t.group(2)
+            out[dtype] = out.get(dtype, 0) + _numel(dims, ",") * _DTYPE_BYTES.get(dtype, 4)
+    return out

@@ -183,6 +183,23 @@ class Q4Tensor:
         self.scale_offset = scale_offset  # f32 [..., 1, out]
         self.scale_scale = scale_scale    # f32 [..., 1, out]
         self.code = code              # f32 [16] dequantization codebook
+        # Layout guard: round 4 moved absmax blocks from the last dim to the
+        # contraction dim (scale_q transposed). A checkpoint/offload dir in
+        # the pre-round-4 layout would reconstruct silently and dequantize
+        # to garbage — fail loudly instead. (Shape-less placeholders pass
+        # through: jax tree transforms unflatten with sentinels.)
+        p_shape = getattr(packed, "shape", None)
+        s_shape = getattr(scale_q, "shape", None)
+        if (
+            p_shape and s_shape and len(p_shape) >= 2 and len(s_shape) >= 1
+            and s_shape[-1] != p_shape[-1] * 2
+        ):
+            raise ValueError(
+                f"Q4Tensor layout mismatch: scale_q last dim {s_shape[-1]} != "
+                f"out dim {p_shape[-1] * 2}. This artifact was probably "
+                "written by a pre-round-4 layout (absmax blocks on the last "
+                "dim); re-quantize the weights with this version."
+            )
 
     @property
     def shape(self):
@@ -227,8 +244,15 @@ class Q4Tensor:
         gathered rows are ever unpacked (embedding lookups on a 4-bit
         table move ~0.5 bytes/param, not 4). Row ``r``'s scales live at
         block row ``r // block`` of the ``[nb, out]`` scale plane."""
-        if self.packed.ndim == 2 and isinstance(
-            idx, (int, np.integer, np.ndarray, jax.Array)
+        if (
+            self.packed.ndim == 2
+            and isinstance(idx, (int, np.integer, np.ndarray, jax.Array))
+            # boolean masks must NOT take the fast path: bool floor-div
+            # would map every gathered row to block 0's scales
+            and (
+                np.isscalar(idx)
+                or jnp.issubdtype(jnp.asarray(idx).dtype, jnp.integer)
+            )
         ):
             pair = _pair_table(self.code)
             rows = pair[self.packed[idx].astype(jnp.int32)]
@@ -340,8 +364,14 @@ class Q4DecodedTensor:
         return self.dequantize()
 
     def __getitem__(self, idx):
-        if self.c8.ndim == 2 and isinstance(
-            idx, (int, np.integer, np.ndarray, jax.Array)
+        if (
+            self.c8.ndim == 2
+            and isinstance(idx, (int, np.integer, np.ndarray, jax.Array))
+            # see Q4Tensor.__getitem__: bool masks route to full dequantize
+            and (
+                np.isscalar(idx)
+                or jnp.issubdtype(jnp.asarray(idx).dtype, jnp.integer)
+            )
         ):
             scales = self._scales()
             return self.c8[idx].astype(jnp.float32) * (

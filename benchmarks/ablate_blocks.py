@@ -1,6 +1,13 @@
 """Dev ablation: flash-kernel block sizes for the long-context rows
-(seq 2048/4096). The round-2 tuning targeted seq 1024; deeper sequences
-may want bigger kv blocks."""
+(seq 2048/4096) at the FLAGSHIP shape (h1536/L16/12h/d128 — the shape the
+bench's primary row measures; earlier revisions of this script swept the
+r3 h1024/L24 shape, whose d=64 head dim has different VMEM pressure).
+
+Each point runs in its own subprocess (clean HBM) and reports the remat
+policy that actually fit — at seq 4096 the dots_saveable residuals may
+exceed HBM, and a silent fallback to full remat costs ~25% MFU on its
+own, which matters more than any block-size choice.
+"""
 
 import os
 import subprocess
@@ -10,7 +17,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _one(seq, bq, bkv):
+def _one(seq, bq, bkv, remat):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -23,9 +30,10 @@ def _one(seq, bq, bkv):
 
     bsz = max(8 * 1024 // seq, 1)
     config = LlamaConfig(
-        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-        max_position_embeddings=seq, remat="dots_saveable",
+        vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+        num_hidden_layers=16, num_attention_heads=12, num_key_value_heads=12,
+        max_position_embeddings=seq,
+        remat={"0": False, "1": True}.get(remat, remat),
     )
     accelerator = Accelerator(mixed_precision="bf16")
     model, opt = accelerator.prepare(
@@ -37,7 +45,10 @@ def _one(seq, bq, bkv):
     batch = {k: jax.device_put(jnp.asarray(v), sharding) for k, v in
              {"input_ids": ids, "labels": ids}.items()}
 
-    with attention_context(block_q=bq, block_kv=bkv):
+    kw = {}
+    if bq:
+        kw = {"block_q": bq, "block_kv": bkv}
+    with attention_context(**kw):
         def step():
             out = model(**batch)
             accelerator.backward(out.loss)
@@ -53,26 +64,85 @@ def _one(seq, bq, bkv):
             last = step()
         float(np.asarray(last))
         t = (time.perf_counter() - t0) / 10
-    print(f"RESULT seq={seq} bq={bq} bkv={bkv} t={t*1000:.1f}ms tok/s={bsz*seq/t:.0f}")
+    print(f"RESULT seq={seq} bq={bq} bkv={bkv} remat={remat} "
+          f"t={t*1000:.1f}ms tok/s={bsz*seq/t:.0f}")
 
 
-if __name__ == "__main__":
-    if len(sys.argv) > 3:
-        _one(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
-        sys.exit(0)
-    points = [(2048, 512, 1024), (2048, 1024, 1024), (2048, 512, 2048),
-              (2048, 1024, 2048), (2048, 256, 1024)]
-    if len(sys.argv) > 1 and sys.argv[1] == "4k":
-        points = [(4096, 512, 1024), (4096, 1024, 2048), (4096, 512, 2048)]
-    for seq, bq, bkv in points:
+def _micro(seq, bq, bkv):
+    """Flash kernel alone (fwd+bwd) at the flagship per-layer shape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import causal_attn_fwd_bwd_flops, flagship_attn_shape
+
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    b, nh, d = flagship_attn_shape(seq)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, seq, nh, d)), jnp.bfloat16)
+               for _ in range(3))
+
+    def fwd_bwd(q, k, v):
+        def scalar(q):
+            return flash_attention(
+                q, k, v, causal=True, block_q=bq, block_kv=bkv
+            ).astype(jnp.float32).sum()
+        loss, g = jax.value_and_grad(scalar)(q)
+        return loss + g.astype(jnp.float32).sum()
+
+    jitted = jax.jit(fwd_bwd)
+    for _ in range(2):
+        last = jitted(q, k, v)
+    float(np.asarray(last))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        last = jitted(q, k, v)
+    float(np.asarray(last))
+    t = (time.perf_counter() - t0) / 20
+    flops = causal_attn_fwd_bwd_flops(b, nh, seq, d)
+    print(f"MICRO seq={seq} bq={bq} bkv={bkv} t={t*1e6:.0f}us "
+          f"eff_tflops={flops/t/1e12:.1f}")
+
+
+def _sweep(points, mode):
+    for p in points:
         for attempt in range(2):
             r = subprocess.run(
-                [sys.executable, __file__, str(seq), str(bq), str(bkv)],
-                capture_output=True, text=True, timeout=400,
+                [sys.executable, __file__, mode, *[str(x) for x in p]],
+                capture_output=True, text=True, timeout=600,
             )
-            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            out = [l for l in r.stdout.splitlines()
+                   if l.startswith(("RESULT", "MICRO"))]
             if r.returncode == 0 and out:
                 print(out[0], flush=True)
                 break
-            print(f"retry {seq}/{bq}/{bkv}: {(r.stdout + r.stderr)[-200:]}", flush=True)
+            print(f"retry {mode}{p}: {(r.stdout + r.stderr)[-300:]}", flush=True)
             time.sleep(10)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 5 and sys.argv[1] == "one":
+        _one(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]), sys.argv[5])
+        sys.exit(0)
+    if len(sys.argv) > 4 and sys.argv[1] == "micro":
+        _micro(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+        sys.exit(0)
+    which = sys.argv[1] if len(sys.argv) > 1 else "step"
+    if which == "micro-sweep":
+        pts = []
+        for seq in (1024, 2048, 4096):
+            for bq, bkv in ((512, 512), (512, 1024), (1024, 1024),
+                            (1024, 2048), (2048, 1024), (2048, 2048)):
+                if bq <= seq and bkv <= seq:
+                    pts.append((seq, bq, bkv))
+        _sweep(pts, "micro")
+    else:
+        pts = []
+        for seq in (2048, 4096):
+            # bq=0 → the resolve_flash_blocks auto choice (current default)
+            for bq, bkv in ((0, 0), (512, 1024), (1024, 1024), (1024, 2048),
+                            (2048, 1024), (2048, 2048)):
+                pts.append((seq, bq, bkv, "dots_saveable"))
+        pts.append((4096, 0, 0, "1"))  # full-remat comparison point
+        _sweep(pts, "one")

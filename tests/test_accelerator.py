@@ -17,14 +17,7 @@ from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
 from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
 
-class _Loader:
-    def __init__(self, dataset, batch_size, drop_last=False):
-        self.dataset = dataset
-        self.batch_size = batch_size
-        self.drop_last = drop_last
-        self.sampler = None
-        self.batch_sampler = None
-        self.collate_fn = None
+from accelerate_tpu.test_utils import SimpleLoader as _Loader  # noqa: E402
 
 
 def _make(accelerator=None, lr=0.1, batch_size=16, length=64, accum=1):
@@ -222,13 +215,19 @@ def test_fp16_clip_operates_on_unscaled_grads():
     import jax
     import jax.numpy as jnp
 
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    accelerator = Accelerator(mixed_precision="fp16")
+    # init_scale kept low enough that this model's first step is finite (the
+    # 65536 default would overflow fp16 here and back off — tested elsewhere)
+    accelerator = Accelerator(
+        mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(init_scale=1024.0)]
+    )
     model, opt, dl = accelerator.prepare(
         RegressionModel(), optax.sgd(0.1), _Loader(RegressionDataset(length=32), batch_size=32)
     )
-    assert opt.scaler is not None and opt.scaler > 1
+    assert opt.scaler is not None and opt.scaler.get_scale() > 1
     batch = next(iter(dl))
     out = model(**batch)
     accelerator.backward(out.loss)
@@ -243,7 +242,9 @@ def test_fp16_clip_operates_on_unscaled_grads():
     # and after a tight clip the post-step update is bounded by max_norm * lr
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    accelerator2 = Accelerator(mixed_precision="fp16")
+    accelerator2 = Accelerator(
+        mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(init_scale=1024.0)]
+    )
     model2, opt2, dl2 = accelerator2.prepare(
         RegressionModel(), optax.sgd(1.0), _Loader(RegressionDataset(length=32), batch_size=32)
     )
@@ -255,6 +256,153 @@ def test_fp16_clip_operates_on_unscaled_grads():
         float(model2.params["a"]) ** 2 + float(model2.params["b"]) ** 2
     )
     assert delta == pytest.approx(0.5, rel=0.05)
+
+
+def _fp16_scaler_setup():
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    handler = GradScalerKwargs(
+        init_scale=1024.0, growth_factor=2.0, backoff_factor=0.5, growth_interval=2
+    )
+    accelerator = Accelerator(mixed_precision="fp16", kwargs_handlers=[handler])
+    model, opt, dl = accelerator.prepare(
+        RegressionModel(a=0.0, b=0.0),
+        optax.sgd(0.01),
+        _Loader(RegressionDataset(length=32), batch_size=8),
+    )
+    good = next(iter(dl))
+    # 2*(pred - y)*x with x = 6e4 overflows the fp16 max (65504) even before
+    # the loss scale multiplies it: a deterministic non-finite gradient
+    bad = {
+        "x": np.full(np.shape(good["x"]), 6.0e4, dtype=np.float32),
+        "y": np.ones(np.shape(good["y"]), dtype=np.float32),
+    }
+    return accelerator, model, opt, good, bad
+
+
+def test_fp16_dynamic_scale_backoff_and_growth_fused():
+    """Overflow → backoff → regrowth on the fused step path (the scaler
+    state lives on device and updates inside the compiled step)."""
+    accelerator, model, opt, good, bad = _fp16_scaler_setup()
+    assert accelerator.scaler is opt.scaler
+    assert accelerator.scaler.get_scale() == 1024.0
+
+    out = model(**bad)
+    accelerator.backward(out.loss)
+    opt.step()
+    assert opt.step_was_skipped
+    assert float(np.asarray(model.params["a"])) == 0.0  # update suppressed
+    assert accelerator.scaler.get_scale() == 512.0
+    opt.zero_grad()
+
+    for _ in range(2):  # growth_interval=2 finite steps → scale regrows
+        out = model(**good)
+        accelerator.backward(out.loss)
+        opt.step()
+        assert not opt.step_was_skipped
+        opt.zero_grad()
+    assert accelerator.scaler.get_scale() == 1024.0
+
+
+def test_fp16_dynamic_scale_backoff_and_growth_split():
+    """Same schedule on the split path (grads materialised before step —
+    the scaler updates eagerly where the finite check already syncs)."""
+    accelerator, model, opt, good, bad = _fp16_scaler_setup()
+
+    out = model(**bad)
+    accelerator.backward(out.loss)
+    assert opt.grads is not None  # forces the pending loss → split path
+    opt.step()
+    assert opt.step_was_skipped
+    assert accelerator.scaler.get_scale() == 512.0
+    opt.zero_grad()
+
+    for _ in range(2):
+        out = model(**good)
+        accelerator.backward(out.loss)
+        assert opt.grads is not None
+        opt.step()
+        assert not opt.step_was_skipped
+        opt.zero_grad()
+    assert accelerator.scaler.get_scale() == 1024.0
+
+
+def test_fp16_scaler_state_round_trips_through_checkpoint(tmp_path):
+    accelerator, model, opt, good, bad = _fp16_scaler_setup()
+    out = model(**bad)
+    accelerator.backward(out.loss)
+    opt.step()
+    opt.zero_grad()
+    assert accelerator.scaler.get_scale() == 512.0
+    accelerator.save_state(str(tmp_path / "ckpt"))
+    accelerator.scaler.load_state_dict({"scale": 64.0})
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    assert accelerator.scaler.get_scale() == 512.0
+
+
+def test_dynamo_backend_warns_once(caplog):
+    import logging
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    Accelerator._dynamo_warned = False
+    with caplog.at_level(logging.WARNING, logger="accelerate_tpu.accelerator"):
+        # the reference's disabled spelling is uppercase "NO": no warning
+        Accelerator(dynamo_backend="NO")
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        Accelerator(dynamo_backend="inductor")
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        Accelerator(dynamo_backend="inductor")
+    hits = [r for r in caplog.records if "dynamo_backend" in r.getMessage()]
+    assert len(hits) == 1
+
+
+def test_auto_resume_covers_objects_prepared_in_later_calls(tmp_path, monkeypatch):
+    """Regression: a restarted script that prepares its objects across
+    SEVERAL prepare() calls must still have the last call's objects
+    restored — auto-resume re-fires per prepare until training starts."""
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    def _project():
+        return ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        )
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc1 = Accelerator(project_config=_project())
+    model, opt, dl = acc1.prepare(
+        RegressionModel(a=0.0, b=0.0), optax.sgd(0.1),
+        _Loader(RegressionDataset(length=32), batch_size=8),
+    )
+    out = model(**next(iter(dl)))
+    acc1.backward(out.loss)
+    opt.step()
+    opt.zero_grad()
+    acc1.save_state()
+    a_trained = float(np.asarray(model.params["a"]))
+    assert a_trained != 0.0
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    monkeypatch.setenv("ACCELERATE_AUTO_RESUME", "true")
+    acc2 = Accelerator(project_config=_project())
+    dl2 = acc2.prepare(_Loader(RegressionDataset(length=32), batch_size=8))
+    model2, opt2 = acc2.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(a_trained)
+    # training freezes further auto-resume: a third prepare() must not
+    # clobber the live params with the checkpoint again
+    out2 = model2(**next(iter(dl2)))
+    acc2.backward(out2.loss)
+    opt2.step()
+    opt2.zero_grad()
+    a_after_step = float(np.asarray(model2.params["a"]))
+    extra_model = acc2.prepare(RegressionModel(a=0.0, b=0.0))
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(a_after_step)
 
 
 def test_prepare_passes_through_unknown_callables():

@@ -159,6 +159,95 @@ class TestLaunch:
         assert proc.returncode == 0, proc.stderr[-3000:]
         assert "LAUNCH_OK" in proc.stdout
 
+    def test_launch_max_restarts_resumes_from_checkpoint(self, tmp_path):
+        """Fault tolerance: the script crashes mid-training on its first
+        run; ``--max_restarts`` re-execs it and ACCELERATE_AUTO_RESUME makes
+        prepare() reload the latest checkpoint, so training finishes at the
+        right step (TPU-native analog of torchrun elastic restarts,
+        reference launchers.py:231-245; SURVEY §5)."""
+        script = tmp_path / "train_crashy.py"
+        script.write_text(textwrap.dedent(
+            """
+            import json, os
+
+            import optax
+
+            from accelerate_tpu import Accelerator
+            from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+            from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+            class Loader:
+                def __init__(self, dataset, batch_size):
+                    self.dataset = dataset
+                    self.batch_size = batch_size
+                    self.sampler = self.batch_sampler = self.collate_fn = None
+                    self.drop_last = False
+
+            class StepCounter:
+                def __init__(self):
+                    self.steps_done = 0
+                def state_dict(self):
+                    return {"steps_done": self.steps_done}
+                def load_state_dict(self, sd):
+                    self.steps_done = sd["steps_done"]
+
+            train_dir = os.environ["TRAIN_DIR"]
+            acc = Accelerator(project_config=ProjectConfiguration(
+                project_dir=train_dir, automatic_checkpoint_naming=True))
+            counter = StepCounter()
+            acc.register_for_checkpointing(counter)
+            model, opt, dl = acc.prepare(
+                RegressionModel(a=0.0, b=0.0), optax.sgd(0.05),
+                Loader(RegressionDataset(length=32), 8))
+
+            restarted = "ACCELERATE_RESTART_COUNT" in os.environ
+            start = counter.steps_done
+            if restarted:
+                assert start == 3, f"expected resume at step 3, got {start}"
+            else:
+                assert start == 0, start
+
+            batches = iter([])
+            while counter.steps_done < 6:
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    batches = iter(dl)
+                    batch = next(batches)
+                out = model(**batch)
+                acc.backward(out.loss)
+                opt.step()
+                opt.zero_grad()
+                counter.steps_done += 1
+                acc.save_state()
+                if counter.steps_done == 3 and not restarted:
+                    os._exit(17)  # simulated mid-epoch crash, after a save
+
+            with open(os.path.join(train_dir, "final.json"), "w") as f:
+                json.dump({"steps_done": counter.steps_done,
+                           "resumed_at": start, "restarted": restarted}, f)
+            print("LAUNCH_FT_OK")
+            """
+        ))
+        train_dir = tmp_path / "run"
+        train_dir.mkdir()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+                "launch", "--num_cpu_devices", "2", "--max_restarts", "2",
+                str(script),
+            ],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "", "XLA_FLAGS": "",
+                 "TRAIN_DIR": str(train_dir)},
+            timeout=300,
+        )
+        assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+        assert "LAUNCH_FT_OK" in proc.stdout
+        assert "restart 1/2" in proc.stderr
+        final = json.loads((train_dir / "final.json").read_text())
+        assert final == {"steps_done": 6, "resumed_at": 3, "restarted": True}
+
     def test_bundled_test_script(self):
         proc = subprocess.run(
             [

@@ -1,0 +1,146 @@
+"""DDP communication-hook analog: compressed dp-axis gradient reduction
+(reference ``DDPCommunicationHookType`` / ``fp16_compress_hook``,
+``utils/dataclasses.py:117-214``). Numerics on the 8-CPU mesh + compiled-HLO
+proof that the gradient all-reduce rides the compressed wire dtype."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, SimpleLoader as _Loader
+from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+
+def _train_steps(comm_hook, n_steps=3, split=False):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    handlers = [DistributedDataParallelKwargs(comm_hook=comm_hook)] if comm_hook else None
+    accelerator = Accelerator(kwargs_handlers=handlers)
+    model, opt, dl = accelerator.prepare(
+        RegressionModel(a=0.0, b=0.0), optax.sgd(0.1),
+        _Loader(RegressionDataset(length=64), batch_size=16),
+    )
+    if comm_hook:
+        assert accelerator._grad_comm_hook == comm_hook
+    losses = []
+    it = iter([])
+    for _ in range(n_steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(dl)
+            batch = next(it)
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        if split:
+            assert opt.grads is not None  # forces the split grad path
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(np.asarray(out.loss.force())))
+    params = {k: float(np.asarray(v)) for k, v in model.params.items()}
+    return params, losses
+
+
+def test_bf16_comm_hook_matches_full_precision_numerics():
+    base_params, base_losses = _train_steps(None)
+    hook_params, hook_losses = _train_steps("bf16")
+    for k in base_params:
+        assert hook_params[k] == pytest.approx(base_params[k], rel=2e-2, abs=2e-2)
+    assert hook_losses[0] == pytest.approx(base_losses[0], rel=2e-2)
+
+
+def test_bf16_comm_hook_split_path_matches():
+    base_params, _ = _train_steps(None, split=True)
+    hook_params, _ = _train_steps("bf16", split=True)
+    for k in base_params:
+        assert hook_params[k] == pytest.approx(base_params[k], rel=2e-2, abs=2e-2)
+
+
+def test_unsupported_hook_warns_and_deactivates(caplog):
+    import logging
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    with caplog.at_level(logging.WARNING, logger="accelerate_tpu.accelerator"):
+        accelerator = Accelerator(
+            kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="power_sgd")]
+        )
+    assert accelerator._grad_comm_hook is None
+    assert any("power_sgd" in r.getMessage() for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# wire-format proof: the gradient cross-shard reduction is bf16 on the wire.
+# Parsed from the pre-optimization StableHLO — the backend may later promote
+# (XLA:CPU rewrites bf16 all-reduce to f32 because its collectives have no
+# bf16 kernel; TPU/DCN executes the declared wire dtype, which is where the
+# bytes-on-wire claim lives).
+# ---------------------------------------------------------------------------
+
+from accelerate_tpu.utils.hlo import stablehlo_allreduce_bytes as _allreduce_bytes
+
+
+def _mesh_and_batch():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((64, 32)), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    return mesh, x
+
+
+def _loss_fn(params, frozen, inputs, scale):
+    pred = inputs[0] @ params["w"]
+    loss = (pred**2).mean() * scale
+    return loss, loss
+
+
+def test_wire_bytes_halved_vs_full_precision_reduction():
+    """The compiled program's gradient all-reduce moves bf16 — half the
+    bytes of the f32 baseline (the reference hook's exact claim)."""
+    from accelerate_tpu.lazy import ddp_compressed_vag
+
+    mesh, x = _mesh_and_batch()
+    params = {"w": jnp.ones((32, 32), jnp.float32)}
+    one = jnp.float32(1.0)
+
+    vag = ddp_compressed_vag(_loss_fn, mesh, [x], "bf16")
+    text = jax.jit(vag).lower(params, [], [x], one).as_text()
+    by_dtype = _allreduce_bytes(text)
+    assert by_dtype.get("bf16", 0) > 0, f"no bf16 all-reduce found: {by_dtype}"
+    # the gradient payload (32*32 leaves) rides bf16, not f32; the only f32
+    # all-reduces left are the two scalar loss pmeans
+    grad_bytes_bf16 = by_dtype["bf16"]
+    assert grad_bytes_bf16 >= 32 * 32 * 2
+    assert by_dtype.get("f32", 0) <= 2 * 4
+    # vs the full-precision payload: exactly half the gradient bytes
+    assert grad_bytes_bf16 * 2 == 32 * 32 * 4
+
+    vag_f16 = ddp_compressed_vag(_loss_fn, mesh, [x], "fp16")  # fp16 wire
+    text_fp16 = jax.jit(vag_f16).lower(params, [], [x], one).as_text()
+    assert _allreduce_bytes(text_fp16).get("f16", 0) > 0
+
+
+def test_compressed_vag_grad_values_match_plain():
+    """shard_map + compressed psum computes the same averaged gradient as
+    plain GSPMD value_and_grad (bf16 wire tolerance)."""
+    from accelerate_tpu.lazy import ddp_compressed_vag
+
+    mesh, x = _mesh_and_batch()
+    params = {"w": jnp.asarray(np.random.default_rng(1).standard_normal((32, 32)), jnp.float32)}
+    one = jnp.float32(1.0)
+
+    vag = ddp_compressed_vag(_loss_fn, mesh, [x], "bf16")
+    (scaled, unscaled), grads = jax.jit(vag)(params, [], [x], one)
+
+    plain = jax.value_and_grad(lambda p: _loss_fn(p, [], [x], one)[0])
+    ref_loss, ref_grads = jax.jit(plain)(params)
+
+    np.testing.assert_allclose(np.asarray(unscaled), np.asarray(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]), np.asarray(ref_grads["w"]), rtol=2e-2, atol=2e-2
+    )

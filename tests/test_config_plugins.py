@@ -126,6 +126,89 @@ def test_questionnaire_fsdp_branch_roundtrips(tmp_path):
     assert loaded.main_training_function == "train"
 
 
+def test_questionnaire_fsdp_answers_build_working_accelerator(monkeypatch):
+    """Full round trip: fsdp questionnaire answers → ClusterConfig → launch
+    env contract → an Accelerator whose FSDP plugin and mesh reflect every
+    answer (reference cluster.py:54 sub-questionnaire → env → plugin)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    answers = iter([
+        "jax_tpu",              # compute env
+        "1",                    # hosts
+        "2",                    # fsdp extent
+        "SHARD_GRAD_OP",        # sharding strategy
+        "1000",                 # min_num_params
+        "yes",                  # activation checkpointing
+        "no",                   # offload params
+        "1", "1", "1", "1",     # tp, cp, ep, pp
+        "bf16", "1", "no", "main",
+    ])
+    with mock.patch("builtins.input", lambda prompt="": next(answers)):
+        cfg = get_cluster_input()
+    assert cfg.use_fsdp and cfg.mesh_fsdp == 2
+    assert cfg.fsdp_config["offload_params"] is False
+
+    env = cfg.to_environment()
+    assert env["ACCELERATE_USE_FSDP"] == "true"
+    assert env["FSDP_SHARDING_STRATEGY"] == "SHARD_GRAD_OP"
+    assert env["FSDP_MIN_NUM_PARAMS"] == "1000"
+    assert env["FSDP_ACTIVATION_CHECKPOINTING"] == "True"
+    for k, v in env.items():
+        if k.startswith(("FSDP_", "ACCELERATE_")):
+            monkeypatch.setenv(k, v)
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator()
+    try:
+        plugin = acc.fsdp_plugin
+        assert plugin is not None
+        assert plugin.sharding_strategy == "SHARD_GRAD_OP"
+        assert plugin.min_num_params == 1000
+        assert plugin.activation_checkpointing is True
+        assert plugin.cpu_offload is False
+        assert dict(acc.mesh.shape)["fsdp"] == 2
+    finally:
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+
+
+def test_questionnaire_deepspeed_answers_build_working_accelerator(monkeypatch):
+    """DeepSpeed questionnaire answers reach a working Accelerator: zero
+    stage + offload map onto the plugin (→ GSPMD fsdp sharding)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    answers = iter([
+        "jax_tpu",  # compute env
+        "1",        # hosts
+        "1",        # fsdp extent (1 → offer deepspeed)
+        "yes",      # use deepspeed?
+        "",         # no config file → questionnaire
+        "3",        # zero stage
+        "no",       # offload optimizer
+        "no",       # offload params
+        "2",        # zero shard extent
+        "1", "1", "1", "1",  # tp, cp, ep, pp
+        "bf16", "1", "no", "main",
+    ])
+    with mock.patch("builtins.input", lambda prompt="": next(answers)):
+        cfg = get_cluster_input()
+    for k, v in cfg.to_environment().items():
+        if k.startswith(("FSDP_", "ACCELERATE_")):
+            monkeypatch.setenv(k, v)
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator()
+    try:
+        assert acc.deepspeed_plugin is not None
+        assert acc.deepspeed_plugin.zero_stage == 3
+        assert dict(acc.mesh.shape)["fsdp"] == 2
+    finally:
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+
+
 def test_launch_command_builder():
     cmd = get_launch_command(num_cpu_devices=4, mesh_tp=2, debug=True)
     assert "--num_cpu_devices" in cmd and "4" in cmd

@@ -89,6 +89,118 @@ def test_accelerator_tracker_facade_roundtrip(tmp_path):
     assert got is tracker or getattr(got, "tracker", None) is tracker
 
 
+def _fake_wandb(calls):
+    import types
+
+    fake = types.ModuleType("wandb")
+
+    class FakeTable:
+        def __init__(self, columns=None, data=None, dataframe=None):
+            self.columns, self.data, self.dataframe = columns, data, dataframe
+
+    class FakeImage:
+        def __init__(self, img):
+            self.img = img
+
+    class FakeRun:
+        def log(self, values, step=None, **kw):
+            calls.append(("log", values, step))
+
+        def finish(self):
+            calls.append(("finish",))
+
+    fake.Table, fake.Image = FakeTable, FakeImage
+    fake.init = lambda project=None, **kw: FakeRun()
+    fake.config = types.SimpleNamespace(update=lambda *a, **k: None)
+    return fake
+
+
+def test_wandb_log_table_and_images(monkeypatch):
+    """log_table wraps into a wandb.Table, log_images into wandb.Image
+    (reference tracking.py:341,360)."""
+    import sys
+
+    import numpy as np
+
+    calls = []
+    monkeypatch.setitem(sys.modules, "wandb", _fake_wandb(calls))
+    from accelerate_tpu.tracking import WandBTracker
+
+    t = WandBTracker("proj")
+    t.log_table("preds", columns=["x", "y"], data=[[1, 2]], step=4)
+    t.log_images({"samples": [np.zeros((2, 2, 3))]}, step=5)
+
+    (_, tbl_values, tbl_step), (_, img_values, img_step) = calls
+    assert tbl_step == 4 and img_step == 5
+    table = tbl_values["preds"]
+    assert table.columns == ["x", "y"] and table.data == [[1, 2]]
+    assert [type(i).__name__ for i in img_values["samples"]] == ["FakeImage"]
+
+
+def test_clearml_log_table_and_images(monkeypatch):
+    """log_table reports [columns]+rows (or a dataframe); log_images routes
+    through report_image with title/series split (reference
+    tracking.py:804,822)."""
+    import sys
+    import types
+
+    import numpy as np
+
+    reports = []
+
+    class FakeLogger:
+        def report_table(self, title, series, table_plot, iteration=None, **kw):
+            reports.append(("table", title, series, table_plot, iteration))
+
+        def report_image(self, title, series, iteration=None, image=None, **kw):
+            reports.append(("image", title, series, image, iteration))
+
+    class FakeTask:
+        def get_logger(self):
+            return FakeLogger()
+
+        def close(self):
+            pass
+
+    fake = types.ModuleType("clearml")
+    fake.Task = types.SimpleNamespace(init=lambda project_name=None, **kw: FakeTask())
+    monkeypatch.setitem(sys.modules, "clearml", fake)
+    from accelerate_tpu.tracking import ClearMLTracker
+
+    t = ClearMLTracker("proj")
+    t.log_table("eval/preds", columns=["a"], data=[[1], [2]], step=7)
+    img = np.zeros((2, 2))
+    t.log_images({"viz/recon": img}, step=8)
+    with pytest.raises(ValueError, match="data"):
+        t.log_table("bad")
+
+    assert reports[0] == ("table", "eval", "preds", [["a"], [1], [2]], 7)
+    kind, title, series, image, it = reports[1]
+    assert (kind, title, series, it) == ("image", "viz", "recon", 8)
+    assert image is img
+
+
+def test_base_tracker_log_table_is_noop():
+    t = JSONTracker("/dev/null")
+    assert t.log_table("anything", data=[[1]]) is None
+
+
+def test_tensorboard_log_images_jsonl_fallback(tmp_path, monkeypatch):
+    """Without a SummaryWriter backend the images land as .npy files next
+    to the scalar JSONL."""
+    import numpy as np
+
+    from accelerate_tpu.tracking import TensorBoardTracker
+
+    t = TensorBoardTracker.__new__(TensorBoardTracker)
+    GeneralTracker.__init__(t)
+    t.writer = None
+    t.logging_dir = str(tmp_path)
+    t.log_images({"val/sample": np.zeros((2, 4, 4, 3))}, step=2)
+    saved = os.listdir(tmp_path / "images")
+    assert saved == ["val_sample_step2.npy"]
+
+
 def test_tensorboard_tracker_writes_event_files(tmp_path):
     try:
         import torch.utils.tensorboard  # noqa: F401
