@@ -293,14 +293,17 @@ class DispatchedModel:
         self.hf_device_map = dict(device_map)  # reference-compatible attr name
         self._jit_apply = None
         self._segment_fns: dict[str, Any] = {}
-        self._io_executor = None  # lazy single-worker prefetch thread
+        self._io_executor = None      # lazy single-worker disk-read stage
+        self._decode_executor = None  # lazy single-worker decode+place stage
 
     def close(self):
-        """Release the prefetch worker (also runs on GC so dispatched models
-        don't each pin an idle OS thread for the process lifetime)."""
-        if self._io_executor is not None:
-            self._io_executor.shutdown(wait=False, cancel_futures=True)
-            self._io_executor = None
+        """Release the prefetch workers (also runs on GC so dispatched models
+        don't each pin idle OS threads for the process lifetime)."""
+        for attr in ("_io_executor", "_decode_executor"):
+            ex = getattr(self, attr, None)
+            if ex is not None:
+                ex.shutdown(wait=False, cancel_futures=True)
+                setattr(self, attr, None)
 
     def __del__(self):
         try:
@@ -341,96 +344,125 @@ class DispatchedModel:
 
     # -- streaming path ------------------------------------------------------
 
-    def _fetch_one(self, p, idx):
+    # -- stage 1: disk → page cache (IO worker; no decode, no device work) --
+
+    @staticmethod
+    def _page_in(arr: np.ndarray) -> np.ndarray:
+        """Touch one element per page so the kernel reads a memmap-backed
+        leaf NOW, on the IO stage — without this the ``np.asarray`` below is
+        a lazy view and every real disk read would page-fault later, inside
+        the decode worker or the consuming GEMM, collapsing the pipeline to
+        two stages. No bytes are copied: stage 2's ``device_put`` still
+        aliases the (now resident) mapped pages. On host-RAM leaves the
+        touch is a few thousand adds — noise."""
+        flat = arr.reshape(-1) if arr.flags.c_contiguous else arr
+        step = max(1, 4096 // max(arr.dtype.itemsize, 1))
+        if flat.size:
+            float(np.asarray(flat[::step], dtype=np.float64).sum())
+        return arr
+
+    def _fetch_raw_leaf(self, p, idx):
+        """Host numpy bytes for an offloaded leaf, or the device array
+        itself when the leaf is resident. KeyError when the path is absent.
+        A ``(path, i)`` entry addresses layer i of a stacked leaf — for
+        host/disk tiers this slices the numpy/memmap view, so one layer's
+        bytes move, not the whole stack."""
         if idx is not None and (p, idx) in self.tiered.resident_slices:
             return self.tiered.resident_slices[(p, idx)]
         if p in self.tiered.resident:
             value = self.tiered.resident[p]
             return value if idx is None else value[idx]
-        return jax.device_put(np.asarray(self.tiered.fetch_host_or_disk(p, idx)))
+        return self._page_in(np.asarray(self.tiered.fetch_host_or_disk(p, idx)))
 
-    def _fetch_host_np(self, p, idx):
-        """Host numpy view of an offloaded leaf, or None when the leaf is
-        HBM-resident (nothing to decode host-side there)."""
-        if (idx is not None and (p, idx) in self.tiered.resident_slices) or (
-            p in self.tiered.resident
-        ):
-            return None
-        return np.asarray(self.tiered.fetch_host_or_disk(p, idx))
-
-    def _segment_params(self, seg_name, paths):
-        """Device arrays for one segment; offloaded leaves H2D-copied
-        (async). A ``(path, i)`` entry addresses layer i of a stacked leaf —
-        for host/disk tiers this slices the numpy/memmap view, so one layer's
-        bytes move, not the whole stack. Quantized leaves live as
-        ``<path>.q``/``<path>.scale`` pairs — the int8 bytes are what cross
-        disk→host→HBM; they stay :class:`QTensor`s here and the segment's
-        compiled fn dequantizes in-kernel (fused into the consuming matmul —
-        no materialised full-precision copy)."""
-        from .utils.quantization import Q4Tensor, QTensor
-
+    def _segment_fetch_raw(self, seg_name, paths):
+        """One segment's leaves as (kind, payload) host material. Quantized
+        leaves live as ``<path>.q``/``<path>.scale`` pairs (int8) or the
+        five 4-bit planes — the quantized bytes are what cross disk→host."""
         out = {}
         for entry in paths:
             p, idx = entry if isinstance(entry, tuple) else (entry, None)
             try:
-                out[p] = self._fetch_one(p, idx)
+                out[p] = ("dense", self._fetch_raw_leaf(p, idx))
             except KeyError:
                 try:
-                    out[p] = QTensor(
-                        self._fetch_one(f"{p}.q", idx),
-                        self._fetch_one(f"{p}.scale", idx),
-                    )
+                    out[p] = ("qt", (
+                        self._fetch_raw_leaf(f"{p}.q", idx),
+                        self._fetch_raw_leaf(f"{p}.scale", idx),
+                    ))
                 except KeyError:
                     # 4-bit leaves: all-array children, path-addressed (the
-                    # [16] codebook is per-tensor, never layer-sliced).
-                    # When the packed plane comes off the host/disk tier
-                    # AND the native pshufb decoder built, unpack nibbles →
-                    # int8 codes HERE (on the prefetch thread, host-only
-                    # work) so the segment program runs a straight int8
-                    # GEMM instead of in-jit nibble decoding — the decode
-                    # was the 4-bit offload compute floor.
-                    out[p] = self._fetch_q4(p, idx)
+                    # [16] codebook is per-tensor, never layer-sliced)
+                    planes = {
+                        leaf: self._fetch_raw_leaf(f"{p}.{leaf}", idx)
+                        for leaf in ("packed", "scale_q", "scale_offset", "scale_scale")
+                    }
+                    planes["code"] = self._fetch_raw_leaf(f"{p}.code", None)
+                    out[p] = ("q4", planes)
         return out
 
-    def _fetch_q4(self, p, idx):
-        from .native import q4_decode_codes
-        from .utils.quantization import Q4DecodedTensor, Q4Tensor
+    # -- stage 2: decode + place (decode worker) -----------------------------
 
-        packed_np = self._fetch_host_np(f"{p}.packed", idx)
-        if packed_np is not None and packed_np.ndim == 2:
-            # the [16] codebook may be HBM-resident even when the packed
-            # plane is offloaded (per-path device maps) — fall back to a
-            # 16-float device fetch rather than assuming its tier
-            code = self._fetch_host_np(f"{p}.code", None)
-            if code is None:
-                code = np.asarray(self._fetch_one(f"{p}.code", None))
-            c8 = q4_decode_codes(packed_np, np.round(code * 127.0).astype(np.int8))
-            if c8 is not None:
-                return Q4DecodedTensor(
-                    jax.device_put(c8),
-                    self._fetch_one(f"{p}.scale_q", idx),
-                    self._fetch_one(f"{p}.scale_offset", idx),
-                    self._fetch_one(f"{p}.scale_scale", idx),
+    @staticmethod
+    def _put(x):
+        return jax.device_put(x) if isinstance(x, np.ndarray) else x
+
+    def _segment_decode_put(self, raw):
+        """Host material → device-ready segment params. 4-bit packed planes
+        unpack nibbles → int8 codes via the native pshufb decoder (host-only
+        work, 64-byte-aligned output so the CPU-backend ``device_put``
+        aliases instead of copying) so the segment program runs a straight
+        int8 GEMM instead of in-jit nibble decoding — the decode was the
+        4-bit offload compute floor. int8 leaves stay :class:`QTensor`s and
+        the compiled fn dequantizes in-kernel (fused into the consuming
+        matmul — no materialised full-precision copy)."""
+        from .native import q4_decode_codes
+        from .utils.quantization import Q4DecodedTensor, Q4Tensor, QTensor
+
+        out = {}
+        for p, (kind, payload) in raw.items():
+            if kind == "dense":
+                out[p] = self._put(payload)
+            elif kind == "qt":
+                out[p] = QTensor(self._put(payload[0]), self._put(payload[1]))
+            else:
+                packed = payload["packed"]
+                if isinstance(packed, np.ndarray) and packed.ndim == 2:
+                    # the [16] codebook may be HBM-resident even when the
+                    # packed plane is offloaded (per-path device maps)
+                    code = np.asarray(payload["code"])
+                    c8 = q4_decode_codes(packed, np.round(code * 127.0).astype(np.int8))
+                    if c8 is not None:
+                        out[p] = Q4DecodedTensor(
+                            jax.device_put(c8),
+                            self._put(payload["scale_q"]),
+                            self._put(payload["scale_offset"]),
+                            self._put(payload["scale_scale"]),
+                        )
+                        continue
+                out[p] = Q4Tensor(
+                    self._put(payload["packed"]),
+                    self._put(payload["scale_q"]),
+                    self._put(payload["scale_offset"]),
+                    self._put(payload["scale_scale"]),
+                    self._put(payload["code"]),
                 )
-        return Q4Tensor(
-            self._fetch_one(f"{p}.packed", idx),
-            self._fetch_one(f"{p}.scale_q", idx),
-            self._fetch_one(f"{p}.scale_offset", idx),
-            self._fetch_one(f"{p}.scale_scale", idx),
-            self._fetch_one(f"{p}.code", None),
-        )
+        return out
 
     def _call_streaming(self, segments, *args, **kwargs):
         """segments: list of (name, param_paths, fn) where
         ``fn(params_dict, carry) -> carry``; first carry built from inputs,
         last carry is the output.
 
-        Segment i+1's *entire load* — the synchronous disk read
-        (``np.asarray`` over the memmap) **and** the H2D copy — runs on a
-        background thread while segment i computes, so the step time is
-        max(read, compute) instead of their sum (SURVEY §7 calls this path
-        the difference between 2 s/tok and 30 s/tok; the reference's analog
-        is AlignDevicesHook prefetch)."""
+        Three-stage pipeline over two background workers: while segment i
+        computes, the decode worker unpacks/places segment i+1 and the IO
+        worker reads segment i+2 off disk — steady-state step time is
+        max(read, decode+place, compute) instead of their sum (SURVEY §7
+        calls this path the difference between 2 s/tok and 30 s/tok; the
+        reference's analog is AlignDevicesHook prefetch). The GIL does not
+        serialise the stages: disk reads, the ctypes nibble decoder, and
+        XLA execution all release it. Peak extra memory is one segment's
+        raw bytes + one segment's decoded arrays (vs one segment before).
+        """
         from concurrent.futures import ThreadPoolExecutor
 
         plan = segments(*args, **kwargs) if callable(segments) else segments
@@ -438,15 +470,44 @@ class DispatchedModel:
         carry = plan["init"]()
         if self._io_executor is None:
             self._io_executor = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="offload-prefetch"
+                max_workers=1, thread_name_prefix="offload-fetch"
             )
-        future = (
-            self._io_executor.submit(self._segment_params, *steps[0][:2]) if steps else None
-        )
+        if self._decode_executor is None:
+            self._decode_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="offload-decode"
+            )
+
+        param_futures: dict[int, Any] = {}
+
+        def _schedule(i: int) -> None:
+            if i < len(steps) and i not in param_futures:
+                raw = self._io_executor.submit(self._segment_fetch_raw, *steps[i][:2])
+                # drain the raw future's exception here; the consumer still
+                # sees it re-raised through r.result() in the decode task
+                raw.add_done_callback(lambda f: f.exception())
+                param_futures[i] = self._decode_executor.submit(
+                    lambda r=raw: self._segment_decode_put(r.result())
+                )
+
+        # lookahead depth 2: i computes, i+1 decodes, i+2 reads
+        _schedule(0)
+        _schedule(1)
+        try:
+            return self._run_streaming_loop(steps, plan, carry, param_futures, _schedule)
+        finally:
+            # a failed segment must not strand the in-flight prefetches:
+            # cancel what's still queued, drain what already ran, so no
+            # exception goes unretrieved and (beyond one bounded in-flight
+            # read) no stale task runs ahead of the next call's work on
+            # these single-worker pools
+            for fut in param_futures.values():
+                if not fut.cancel():
+                    fut.add_done_callback(lambda f: f.exception())
+
+    def _run_streaming_loop(self, steps, plan, carry, param_futures, _schedule):
         for i, (name, paths, fn) in enumerate(steps):
-            seg_params = future.result()
-            if i + 1 < len(steps):
-                future = self._io_executor.submit(self._segment_params, *steps[i + 1][:2])
+            seg_params = param_futures.pop(i).result()
+            _schedule(i + 2)
             key = name if isinstance(name, str) else name[0]
             jit_fn = self._segment_fns.get(key)
             if jit_fn is None:

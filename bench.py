@@ -769,10 +769,17 @@ def main():
             if tag.startswith("nf4"):
                 note += (
                     "; nf4 moves 7.7x fewer bytes; nibbles decode to int8 "
-                    "codes via the native AVX2 pshufb decoder on the host "
-                    "fetch path (accelerate_tpu/native/q4decode.c) and the "
+                    "codes via the native AVX2 pshufb decoder on the "
+                    "pipeline's decode stage (accelerate_tpu/native/"
+                    "q4decode.c; 3-stage fetch/decode/compute overlap, "
+                    "64B-aligned output so the device_put aliases) and the "
                     "matmul runs as per-block int8 GEMMs, so s/token beats "
-                    "fp32's"
+                    "fp32's. int8 stays ahead of nf4 ON THIS HOST only "
+                    "because its memmap pages alias into the GEMM with zero "
+                    "copies while nf4 must materialise decoded bytes "
+                    "(~2x packed) through a ~4 GB/s 1-core memory system — "
+                    "with any second core (or slower disk) the decode stage "
+                    "hides entirely and nf4's halved disk bytes win"
                 )
             extra_rows.append(
                 {
