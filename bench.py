@@ -644,7 +644,7 @@ def main():
     # ---- extra rows (all best-effort): long context, fp8, MRPC, cv, offload
     extra_rows = []
     if platform == "tpu":
-        for s in (2048, 4096):
+        for s in (2048, 4096, 8192):
             row = _seq_row(platform, device_kind, n_dev, s)
             if row:
                 extra_rows.append(row)
@@ -831,6 +831,7 @@ def main():
     _pick = {
         "llama_train_tokens_per_sec_per_chip_seq2048": ("seq2048_mfu", "mfu"),
         "llama_train_tokens_per_sec_per_chip_seq4096": ("seq4096_mfu", "mfu"),
+        "llama_train_tokens_per_sec_per_chip_seq8192": ("seq8192_mfu", "mfu"),
         "fp8_vs_bf16_train_step_speedup": ("fp8_ratio", "value"),
         "mrpc_train_steps_per_sec": ("mrpc_steps_per_sec", "value"),
         "cv_train_steps_per_sec": ("cv_steps_per_sec", "value"),

@@ -1,0 +1,92 @@
+"""Feature: gradient-compression communication hook (reference
+``examples/by_feature/ddp_comm_hook.py``, which registers torch DDP's
+fp16/bf16 compress hooks) — pass
+``DistributedDataParallelKwargs(comm_hook="bf16")`` and the data-parallel
+gradient reduction rides a compressed bf16 psum: half the gradient-sync
+bytes-on-wire, which is real money on multi-slice (DCN) meshes. Training
+semantics are DDP AVERAGE, numerically within bf16 tolerance of the
+full-precision reduction."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairMetric, build_model, get_dataloaders
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+from accelerate_tpu.utils.random import set_seed
+
+EVAL_BATCH_SIZE = 32
+
+
+def training_function(config, args):
+    ddp_kwargs = DistributedDataParallelKwargs(comm_hook=args.comm_hook)
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        kwargs_handlers=[ddp_kwargs],
+    )
+    accelerator.print(f"grad comm hook: {accelerator._grad_comm_hook}")
+    if args.comm_hook != "no" and accelerator._grad_comm_hook is None:
+        accelerator.print(
+            "comm hook inactive on this mesh (needs data-parallel-only, dp>1); "
+            "training proceeds with the full-precision reduction"
+        )
+    lr, num_epochs = config["lr"], int(config["num_epochs"])
+    seed, batch_size = int(config["seed"]), int(config["batch_size"])
+    metric = PairMetric()
+
+    set_seed(seed)
+    train_dataloader, eval_dataloader, tokenizer = get_dataloaders(
+        accelerator, batch_size, EVAL_BATCH_SIZE
+    )
+    model = build_model(tokenizer, seed=seed)
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        train_dataloader.set_epoch(epoch)
+        for step, batch in enumerate(train_dataloader):
+            output = model(**batch)
+            accelerator.backward(output.loss)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        model.eval()
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(**{k: v for k, v in batch.items() if k != "labels"})
+            predictions = np.asarray(outputs.logits.force()).argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics(
+                (predictions, batch["labels"])
+            )
+            metric.add_batch(predictions=predictions, references=references)
+
+        eval_metric = metric.compute()
+        accelerator.print(f"epoch {epoch}:", eval_metric)
+    accelerator.end_training()
+    return eval_metric
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Gradient comm-hook example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--comm_hook", type=str, default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

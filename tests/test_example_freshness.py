@@ -24,6 +24,7 @@ CASES = [
     ("local_sgd.py", ["LocalSGD", "local_sgd.step()"]),
     ("tracking.py", ["log_with"]),
     ("multi_process_metrics.py", ["samples_seen"]),
+    ("ddp_comm_hook.py", ["DistributedDataParallelKwargs", "comm_hook"]),
 ]
 
 

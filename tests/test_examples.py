@@ -124,6 +124,19 @@ def test_local_sgd_example():
     assert "final loss" in stdout
 
 
+def test_ddp_comm_hook_example():
+    stdout = _run(
+        os.path.join(BY_FEATURE, "ddp_comm_hook.py"), "--num_epochs", "2",
+        "--comm_hook", "bf16",
+    )
+    assert "grad comm hook: bf16" in stdout  # active on the 8-device dp mesh
+    last = [l for l in stdout.splitlines() if l.startswith("epoch")][-1]
+    acc = float(last.split("'accuracy': ")[1].split(",")[0].rstrip("}"))
+    # same bar as the canonical nlp example at 2 epochs: the compressed
+    # reduction must not cost convergence
+    assert acc >= 0.85, f"comm-hook training underperformed: {last}"
+
+
 def test_context_parallel_example():
     stdout = _run(
         os.path.join(BY_FEATURE, "context_parallel.py"),
