@@ -114,6 +114,75 @@ def test_cached_generation_matches_full_forward():
     np.testing.assert_array_equal(cached[1, :7], ref[1, :7])
 
 
+def test_cached_generation_eos_matches_full_forward():
+    """The cached path's in-scan eos masking + host trim must stop at the
+    same step (and emit the same tokens) as the full-forward loop's
+    finished.all() break."""
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    ids = np.random.default_rng(9).integers(0, 256, size=(2, 6)).astype(np.int32)
+    # pick the greedy first new token of row 0 as "eos" so generation halts
+    # mid-way through max_new_tokens deterministically
+    probe = generate(wrapped, ids, max_new_tokens=1)
+    eos = int(probe[0, 6])
+    ref = generate(wrapped, ids, max_new_tokens=8, eos_token_id=eos)
+    cached = generate(model, ids, max_new_tokens=8, eos_token_id=eos, use_cache=True)
+    assert cached.shape == ref.shape
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_cached_generation_eos_zero_does_not_collide_with_padding():
+    """eos_token_id=0 must not be confused with the zero-initialised output
+    buffer: rows keep their real tokens until THEY emit 0."""
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    ids = np.random.default_rng(10).integers(1, 256, size=(2, 5)).astype(np.int32)
+    ref = generate(wrapped, ids, max_new_tokens=6, eos_token_id=0)
+    cached = generate(model, ids, max_new_tokens=6, eos_token_id=0, use_cache=True)
+    assert cached.shape == ref.shape
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_cached_generation_sampling_is_seed_deterministic():
+    model, cfg = _model()
+    ids = np.random.default_rng(11).integers(0, 256, size=(2, 5)).astype(np.int32)
+    a = generate(model, ids, max_new_tokens=5, do_sample=True, temperature=0.8,
+                 seed=3, use_cache=True)
+    b = generate(model, ids, max_new_tokens=5, do_sample=True, temperature=0.8,
+                 seed=3, use_cache=True)
+    c = generate(model, ids, max_new_tokens=5, do_sample=True, temperature=0.8,
+                 seed=4, use_cache=True)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == c.shape and not np.array_equal(a, c)
+
+
+def test_cached_generation_zero_new_tokens_returns_prompt():
+    model, cfg = _model()
+    ids = np.random.default_rng(12).integers(0, 256, size=(2, 5)).astype(np.int32)
+    out = generate(model, ids, max_new_tokens=0, use_cache=True)
+    np.testing.assert_array_equal(out, ids)
+    ref = generate(_as_callable(model), ids, max_new_tokens=0)
+    np.testing.assert_array_equal(ref, ids)
+
+
+def test_cached_generation_chunked_eos_loop_spans_chunks(monkeypatch):
+    """With a tiny chunk length the decode loop crosses several compiled
+    chunks and still matches the full-forward output (and stops early when
+    every row finished)."""
+    import accelerate_tpu.generation as gen
+
+    monkeypatch.setattr(gen, "_EOS_CHUNK", 2)
+    model, cfg = _model()
+    wrapped = _as_callable(model)
+    ids = np.random.default_rng(13).integers(0, 256, size=(2, 6)).astype(np.int32)
+    probe = generate(wrapped, ids, max_new_tokens=3)
+    eos = int(probe[0, 8])  # third greedy token of row 0
+    ref = generate(wrapped, ids, max_new_tokens=9, eos_token_id=eos)
+    cached = generate(model, ids, max_new_tokens=9, eos_token_id=eos, use_cache=True)
+    assert cached.shape == ref.shape
+    np.testing.assert_array_equal(cached, ref)
+
+
 def test_cached_generation_on_prepared_model():
     from accelerate_tpu import Accelerator
 
