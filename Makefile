@@ -11,6 +11,7 @@ test:            ## core lane (default pytest addopts = -m "not slow and not exa
 test_slow:       ## compile-heavy lane, batched by theme
 	python -m pytest tests/test_models_bert.py tests/test_models_gpt2.py tests/test_models_llama.py -q -m ""
 	python -m pytest tests/test_models_t5.py tests/test_models_mixtral.py tests/test_attention.py -q -m ""
+	python -m pytest tests/test_models_opt.py tests/test_models_neox.py -q -m ""
 	python -m pytest tests/test_pipeline_parallel.py tests/test_inference.py -q -m ""
 	python -m pytest tests/test_generation.py tests/test_checkpointing.py tests/test_cli.py tests/test_quantization.py -q -m ""
 

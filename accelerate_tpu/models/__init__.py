@@ -56,6 +56,33 @@ def _zoo():
     except ImportError:
         pass
     try:
+        from .opt import OPTConfig, OPTForCausalLM
+
+        z["opt-125m"] = (OPTConfig(), lambda c: OPTForCausalLM.from_config(c))
+        z["opt-1.3b"] = (OPTConfig.opt_1_3b(), lambda c: OPTForCausalLM.from_config(c))
+        z["opt-6.7b"] = (OPTConfig.opt_6_7b(), lambda c: OPTForCausalLM.from_config(c))
+        z["opt-13b"] = (OPTConfig.opt_13b(), lambda c: OPTForCausalLM.from_config(c))
+        z["opt-30b"] = (OPTConfig.opt_30b(), lambda c: OPTForCausalLM.from_config(c))
+    except ImportError:
+        pass
+    try:
+        from .gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        z["pythia-1.4b"] = (
+            GPTNeoXConfig.pythia_1_4b(),
+            lambda c: GPTNeoXForCausalLM.from_config(c),
+        )
+        z["gpt-neox-20b"] = (
+            GPTNeoXConfig.neox_20b(),
+            lambda c: GPTNeoXForCausalLM.from_config(c),
+        )
+        z["gpt-j-6b"] = (
+            GPTNeoXConfig.gptj_6b(),
+            lambda c: GPTNeoXForCausalLM.from_config(c),
+        )
+    except ImportError:
+        pass
+    try:
         from .resnet import ResNetConfig, ResNetForImageClassification
 
         z["resnet50d"] = (
@@ -114,6 +141,54 @@ def config_from_hf_json(path: str):
             num_attention_heads=d.get("n_head", 12),
             max_position_embeddings=d.get("n_positions", 1024),
         )
+    if mt == "opt":
+        from .opt import OPTConfig
+
+        if d.get("word_embed_proj_dim", d.get("hidden_size", 768)) != d.get(
+            "hidden_size", 768
+        ):
+            raise ValueError(
+                "OPT checkpoints with word_embed_proj_dim != hidden_size "
+                "(opt-350m) are not supported"
+            )
+        return OPTConfig(
+            vocab_size=d.get("vocab_size", 50272),
+            hidden_size=d.get("hidden_size", 768),
+            intermediate_size=d.get("ffn_dim", 3072),
+            num_hidden_layers=d.get("num_hidden_layers", 12),
+            num_attention_heads=d.get("num_attention_heads", 12),
+            max_position_embeddings=d.get("max_position_embeddings", 2048),
+        )
+    if mt == "gpt_neox":
+        from .gpt_neox import GPTNeoXConfig
+
+        return GPTNeoXConfig(
+            vocab_size=d.get("vocab_size", 50432),
+            hidden_size=d.get("hidden_size", 768),
+            intermediate_size=d.get("intermediate_size", 3072),
+            num_hidden_layers=d.get("num_hidden_layers", 12),
+            num_attention_heads=d.get("num_attention_heads", 12),
+            max_position_embeddings=d.get("max_position_embeddings", 2048),
+            rotary_pct=d.get("rotary_pct", 0.25),
+            rope_theta=d.get("rotary_emb_base", 10000.0),
+            use_parallel_residual=d.get("use_parallel_residual", True),
+        )
+    if mt == "gptj":
+        from .gpt_neox import GPTNeoXConfig
+
+        h = d.get("n_embd", 4096)
+        heads = d.get("n_head", 16)
+        return GPTNeoXConfig(
+            vocab_size=d.get("vocab_size", 50400),
+            hidden_size=h,
+            intermediate_size=d.get("n_inner") or 4 * h,
+            num_hidden_layers=d.get("n_layer", 28),
+            num_attention_heads=heads,
+            max_position_embeddings=d.get("n_positions", 2048),
+            rotary_pct=d.get("rotary_dim", 64) / (h // heads),
+            shared_layernorm=True,
+            attention_bias=False,
+        )
     if mt == "mixtral":
         from .mixtral import MixtralConfig
 
@@ -156,6 +231,14 @@ def model_factory_for_config(config):
         from .gpt2 import GPT2LMHeadModel
 
         return lambda c: GPT2LMHeadModel.from_config(c)
+    if name == "OPTConfig":
+        from .opt import OPTForCausalLM
+
+        return lambda c: OPTForCausalLM.from_config(c)
+    if name == "GPTNeoXConfig":
+        from .gpt_neox import GPTNeoXForCausalLM
+
+        return lambda c: GPTNeoXForCausalLM.from_config(c)
     if name == "MixtralConfig":
         from .mixtral import MixtralForCausalLM
 

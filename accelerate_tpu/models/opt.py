@@ -1,0 +1,424 @@
+"""OPT causal LM: decoder-only pre-LN transformer with learned positions
+and a ReLU MLP.
+
+OPT-30B is the flagship row of the reference's big-model-inference
+benchmark (reference ``benchmarks/big_model_inference/README.md:36-37``);
+this family makes those rows instantiable by name (``opt-30b`` etc. in the
+zoo, meta-loadable via ``init_empty_weights`` for the estimate CLI and the
+disk-offload executor). Same TPU-first recipe as :mod:`.gpt2` —
+layer-stacked params + ``lax.scan``, flash attention routing, partition
+rules for tp/fsdp — with OPT's architecture: learned positions with the
+HF +2 offset folded away at conversion, separate q/k/v projections (all
+biased), ReLU MLP, tied LM head.
+
+Sizes with ``word_embed_proj_dim != hidden_size`` (only opt-350m) are not
+supported: the projection exists for exactly one published checkpoint and
+would put a dead branch in every other size's forward.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..modules import Model, ModelOutput
+from ..ops.attention import attention
+from ..ops.fp8 import dense
+from ..ops.layers import cached_attention, cross_entropy_loss, write_kv_cache
+from ..parallel.pipeline import remat_wrap
+from .gpt2 import layer_norm
+from .llama import _constrain, residual_spec
+
+
+@dataclass
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    remat: bool | str = False  # False | True | jax.checkpoint_policies name
+    #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
+    pipeline_microbatches: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, vocab_size=256, hidden_size=64, layers=2, heads=4, seq=128):
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=hidden_size,
+            intermediate_size=4 * hidden_size,
+            num_hidden_layers=layers,
+            num_attention_heads=heads,
+            max_position_embeddings=seq,
+        )
+
+    @classmethod
+    def opt_1_3b(cls):
+        return cls(hidden_size=2048, intermediate_size=8192,
+                   num_hidden_layers=24, num_attention_heads=32)
+
+    @classmethod
+    def opt_6_7b(cls):
+        return cls(hidden_size=4096, intermediate_size=16384,
+                   num_hidden_layers=32, num_attention_heads=32)
+
+    @classmethod
+    def opt_13b(cls):
+        return cls(hidden_size=5120, intermediate_size=20480,
+                   num_hidden_layers=40, num_attention_heads=40)
+
+    @classmethod
+    def opt_30b(cls):
+        return cls(hidden_size=7168, intermediate_size=28672,
+                   num_hidden_layers=48, num_attention_heads=56)
+
+
+OPT_PARTITION_RULES = [
+    (r"wte", P("tp", "fsdp")),
+    (r"wpe", P(None, "fsdp")),
+    (r"layers\.w_(q|k|v)", P(None, "fsdp", "tp")),
+    (r"layers\.b_(q|k|v)", P(None, "tp")),
+    (r"layers\.w_proj", P(None, "tp", "fsdp")),
+    (r"layers\.w_fc", P(None, "fsdp", "tp")),
+    (r"layers\.b_fc", P(None, "tp")),
+    (r"layers\.w_out", P(None, "tp", "fsdp")),
+    (r"layers\.(ln1|ln2)_(g|b)", P()),
+    (r"layers\.(b_proj|b_out)", P()),
+    (r"ln_f_(g|b)", P()),
+]
+
+
+def init_opt_params(key: jax.Array, config: OPTConfig, dtype=jnp.float32):
+    c = config
+    h, ff, L = c.hidden_size, c.intermediate_size, c.num_hidden_layers
+    keys = jax.random.split(key, 8)
+
+    def w(k, *shape):
+        # OPT's fixed 0.02-std init (matches the released configs' init_std)
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * 0.02).astype(dtype)
+
+    return {
+        "wte": w(keys[0], c.vocab_size, h),
+        "wpe": w(keys[1], c.max_position_embeddings, h),
+        "layers": {
+            "ln1_g": jnp.ones((L, h), dtype), "ln1_b": jnp.zeros((L, h), dtype),
+            "w_q": w(keys[2], L, h, h), "b_q": jnp.zeros((L, h), dtype),
+            "w_k": w(keys[3], L, h, h), "b_k": jnp.zeros((L, h), dtype),
+            "w_v": w(keys[4], L, h, h), "b_v": jnp.zeros((L, h), dtype),
+            "w_proj": w(keys[5], L, h, h),
+            "b_proj": jnp.zeros((L, h), dtype),
+            "ln2_g": jnp.ones((L, h), dtype), "ln2_b": jnp.zeros((L, h), dtype),
+            "w_fc": w(keys[6], L, h, ff),
+            "b_fc": jnp.zeros((L, ff), dtype),
+            "w_out": w(keys[7], L, ff, h),
+            "b_out": jnp.zeros((L, h), dtype),
+        },
+        "ln_f_g": jnp.ones((h,), dtype),
+        "ln_f_b": jnp.zeros((h,), dtype),
+    }
+
+
+def opt_layer_apply(config: OPTConfig, layer, x, attention_mask, return_kv: bool = False):
+    """One pre-LN block on UNstacked layer params (shared by the scan body
+    and the streaming executor). ``return_kv`` additionally returns this
+    block's (K, V) so prefill caches reuse them."""
+    c = config
+    nh, hd = c.num_attention_heads, c.head_dim
+    b, s, h = x.shape
+    y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+    q = (dense(y, layer["w_q"]) + layer["b_q"]).reshape(b, s, nh, hd)
+    k = (dense(y, layer["w_k"]) + layer["b_k"]).reshape(b, s, nh, hd)
+    v = (dense(y, layer["w_v"]) + layer["b_v"]).reshape(b, s, nh, hd)
+    q = _constrain(q, P(("dp", "fsdp"), "cp", "tp", None))
+    k = _constrain(k, P(("dp", "fsdp"), "cp", "tp", None))
+    attn = attention(q, k, v, segment_mask=attention_mask, causal=True)
+    x = x + dense(attn.reshape(b, s, h), layer["w_proj"]) + layer["b_proj"]
+    x = _constrain(x, residual_spec())
+    y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+    x = x + dense(jax.nn.relu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]) + layer["b_out"]
+    x = _constrain(x, residual_spec())
+    if return_kv:
+        return x, (k, v)
+    return x
+
+
+def opt_apply(
+    config: OPTConfig,
+    params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    labels: jax.Array | None = None,
+    positions: jax.Array | None = None,
+    use_cache: bool = False,
+    kv_cache=None,  # {"k","v"}: [L, b, max_cache, nh, hd] (decode step)
+    cache_index: jax.Array | None = None,  # [b] per-row write position
+    max_cache_len: int | None = None,
+):
+    c = config
+    b, s = input_ids.shape
+    if s > c.max_position_embeddings:
+        raise ValueError(
+            f"sequence length {s} exceeds max_position_embeddings "
+            f"{c.max_position_embeddings}: the position-embedding lookup "
+            "would silently clamp, producing wrong logits"
+        )
+    from ..parallel.pipeline import active_pipeline_mesh, pipeline_layer_stack
+
+    pp_mesh = active_pipeline_mesh()
+    if kv_cache is not None:
+        return _opt_decode_step(c, params, input_ids, kv_cache, cache_index)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = params["wte"][input_ids] + params["wpe"][positions]
+    x = _constrain(x, residual_spec())
+
+    caches = None
+    if use_cache:
+        max_cache = int(max_cache_len or c.max_position_embeddings)
+        if not (s <= max_cache <= c.max_position_embeddings):
+            raise ValueError(
+                f"max_cache_len {max_cache} must be in [{s} (prompt length), "
+                f"{c.max_position_embeddings} (max_position_embeddings)]"
+            )
+
+        from ..parallel.pipeline import prefill_layer_stack
+
+        pad = ((0, 0), (0, max_cache - s), (0, 0), (0, 0))
+
+        def prefill_layer(layer, h, pos_b, mask_b):
+            out, (k, v) = opt_layer_apply(c, layer, h, mask_b, return_kv=True)
+            return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        x, caches = prefill_layer_stack(
+            prefill_layer, params["layers"], x,
+            (c.num_hidden_layers, b, max_cache, c.num_attention_heads, c.head_dim),
+            mask=attention_mask,
+        )
+    elif pp_mesh is not None:
+        # GPipe over the pp axis: positions are already folded into x at
+        # the embedding, so only the mask rides the microbatch schedule
+        x = pipeline_layer_stack(
+            lambda layer, h, pos_mb, mask_mb: opt_layer_apply(c, layer, h, mask_mb),
+            params["layers"], x,
+            mesh=pp_mesh,
+            remat=c.remat,
+            mask=attention_mask,
+            num_microbatches=c.pipeline_microbatches,
+        )
+    else:
+        def body(x, layer):
+            return opt_layer_apply(c, layer, x, attention_mask), None
+
+        body_fn = remat_wrap(body, c.remat)
+        x, _ = jax.lax.scan(body_fn, x, params["layers"])
+
+    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)
+    logits = dense(x, params["wte"].T)  # tied head
+    logits = _constrain(logits, P(("dp", "fsdp"), "cp", "tp"))
+
+    out = ModelOutput(logits=logits)
+    if caches is not None:
+        out["kv_cache"] = caches
+    if labels is not None:
+        out["loss"] = cross_entropy_loss(logits[:, :-1, :], labels[:, 1:])
+    return out
+
+
+def _opt_decode_layer(c, layer, x, k_cache_l, v_cache_l, idx, pp_manual=False):
+    """One cached decode block on UNstacked layer params (mirrors
+    ``_gpt2_decode_layer`` with separate biased q/k/v projections and a
+    ReLU MLP; ``pp_manual``: see
+    :func:`accelerate_tpu.ops.layers.write_kv_cache`)."""
+    b, s, _ = x.shape
+    nh, hd = c.num_attention_heads, c.head_dim
+    y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+    q = (dense(y, layer["w_q"]) + layer["b_q"]).reshape(b, s, nh, hd)
+    k = (dense(y, layer["w_k"]) + layer["b_k"]).reshape(b, s, nh, hd)
+    v = (dense(y, layer["w_v"]) + layer["b_v"]).reshape(b, s, nh, hd)
+    if pp_manual:
+        q = _constrain(q, P())
+    k_cache_l, v_cache_l = write_kv_cache(
+        k_cache_l, v_cache_l, k, v, idx, pin_replicated=pp_manual
+    )
+    attn = cached_attention(q, k_cache_l, v_cache_l, idx)
+    x = x + dense(attn.reshape(b, s, nh * hd), layer["w_proj"]) + layer["b_proj"]
+    y = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+    x = x + dense(
+        jax.nn.relu(dense(y, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
+    ) + layer["b_out"]
+    return x, k_cache_l, v_cache_l
+
+
+def _opt_decode_step(c, params, input_ids, kv_cache, cache_index):
+    """One cached decode step: s == 1 token per row appended at
+    ``cache_index[b]``; the layer loop is owned by
+    :func:`parallel.pipeline.decode_stack`."""
+    from ..parallel.pipeline import decode_stack
+
+    b, s = input_ids.shape
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
+    x = params["wte"][input_ids] + params["wpe"][idx[:, None]]
+
+    x, kv = decode_stack(
+        lambda layer, h, kc_l, vc_l, idx_b, pp_manual: _opt_decode_layer(
+            c, layer, h, kc_l, vc_l, idx_b, pp_manual=pp_manual
+        ),
+        params["layers"], kv_cache, x, broadcast=(idx,),
+    )
+    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], c.layer_norm_eps)
+    logits = dense(x, params["wte"].T)
+    return ModelOutput(logits=logits, kv_cache=kv)
+
+
+_LAYER_KEYS = (
+    "ln1_g", "ln1_b", "w_q", "b_q", "w_k", "b_k", "w_v", "b_v",
+    "w_proj", "b_proj", "ln2_g", "ln2_b", "w_fc", "b_fc", "w_out", "b_out",
+)
+
+
+def opt_segments(config: OPTConfig):
+    """Streaming plan (offload/pipeline executors): embed → L× layer →
+    final-norm+tied-head (mirrors ``gpt2_segments``)."""
+
+    def plan(input_ids=None, attention_mask=None, positions=None, labels=None, **kw):
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+        def init():
+            return {
+                "ids": jnp.asarray(input_ids),
+                "mask": None if attention_mask is None else jnp.asarray(attention_mask),
+                "pos": positions,
+            }
+
+        def embed_fn(seg, carry):
+            x = seg["wte"][carry["ids"]] + seg["wpe"][carry["pos"]]
+            return {**carry, "x": x}
+
+        def layer_fn(seg, carry):
+            layer = {k: seg[f"layers.{k}"] for k in _LAYER_KEYS}
+            return {**carry, "x": opt_layer_apply(config, layer, carry["x"], carry["mask"])}
+
+        def head_fn(seg, carry):
+            x = layer_norm(carry["x"], seg["ln_f_g"], seg["ln_f_b"], config.layer_norm_eps)
+            # dense(): a quantized tied head takes the int8-GEMM path
+            return {**carry, "logits": dense(x, seg["wte"].T)}
+
+        steps = [("embed", ["wte", "wpe"], embed_fn)]
+        for i in range(config.num_hidden_layers):
+            steps.append(
+                (("layer", i), [(f"layers.{k}", i) for k in _LAYER_KEYS], layer_fn)
+            )
+        steps.append(("head", ["ln_f_g", "ln_f_b", "wte"], head_fn))
+
+        def finalize(carry):
+            out = ModelOutput(logits=carry["logits"])
+            if labels is not None:
+                out["loss"] = cross_entropy_loss(
+                    carry["logits"][:, :-1, :], jnp.asarray(labels)[:, 1:]
+                )
+            return out
+
+        return {"init": init, "steps": steps, "finalize": finalize}
+
+    return plan
+
+
+def convert_hf_opt_state_dict(flat: dict, config: OPTConfig) -> dict:
+    """HF-transformers OPT naming → this model's stacked layout. HF stores
+    ``nn.Linear`` weights ``[out, in]`` (transposed here) and position
+    embeddings with the legacy +2 row offset (``OPTLearnedPositionalEmbedding``
+    adds 2 to every index), which is sliced away so positions index
+    directly."""
+    L = config.num_hidden_layers
+
+    def get(name):
+        for prefix in ("model.decoder.", "decoder.", ""):
+            if prefix + name in flat:
+                return np.asarray(flat[prefix + name])
+        raise KeyError(name)
+
+    def stack_t(fmt):
+        # Linear weights: HF [out, in] → ours [in, out]
+        return np.stack([get(fmt.format(i)).T for i in range(L)])
+
+    def stack(fmt):
+        return np.stack([get(fmt.format(i)) for i in range(L)])
+
+    wpe = get("embed_positions.weight")
+    if wpe.shape[0] == config.max_position_embeddings + 2:
+        wpe = wpe[2:]
+
+    return {
+        "wte": get("embed_tokens.weight"),
+        "wpe": wpe,
+        "layers": {
+            "ln1_g": stack("layers.{}.self_attn_layer_norm.weight"),
+            "ln1_b": stack("layers.{}.self_attn_layer_norm.bias"),
+            "w_q": stack_t("layers.{}.self_attn.q_proj.weight"),
+            "b_q": stack("layers.{}.self_attn.q_proj.bias"),
+            "w_k": stack_t("layers.{}.self_attn.k_proj.weight"),
+            "b_k": stack("layers.{}.self_attn.k_proj.bias"),
+            "w_v": stack_t("layers.{}.self_attn.v_proj.weight"),
+            "b_v": stack("layers.{}.self_attn.v_proj.bias"),
+            "w_proj": stack_t("layers.{}.self_attn.out_proj.weight"),
+            "b_proj": stack("layers.{}.self_attn.out_proj.bias"),
+            "ln2_g": stack("layers.{}.final_layer_norm.weight"),
+            "ln2_b": stack("layers.{}.final_layer_norm.bias"),
+            "w_fc": stack_t("layers.{}.fc1.weight"),
+            "b_fc": stack("layers.{}.fc1.bias"),
+            "w_out": stack_t("layers.{}.fc2.weight"),
+            "b_out": stack("layers.{}.fc2.bias"),
+        },
+        "ln_f_g": get("final_layer_norm.weight"),
+        "ln_f_b": get("final_layer_norm.bias"),
+    }
+
+
+class OPTForCausalLM:
+    @staticmethod
+    def from_config(config: OPTConfig, seed: int = 0, dtype=jnp.float32) -> Model:
+        import dataclasses as _dc
+
+        from ..big_modeling import is_empty_init
+        from .gpt2 import _flatten
+
+        # private copy: apply_fn closes over it (see GPT2LMHeadModel)
+        config = _dc.replace(config)
+
+        if is_empty_init():
+            params = jax.eval_shape(
+                lambda k: init_opt_params(k, config, dtype=dtype), jax.random.key(0)
+            )
+        else:
+            params = init_opt_params(jax.random.key(seed), config, dtype=dtype)
+
+        def apply_fn(p, **kwargs):
+            return opt_apply(config, p, **kwargs)
+
+        model = Model(
+            apply_fn, params,
+            partition_rules=OPT_PARTITION_RULES,
+            name="OPTForCausalLM",
+        )
+        model.config = config
+        model.supports_kv_cache = True
+        model.stacked_params_prefix = "layers"
+        model.segments = opt_segments(config)
+        model.tied_parameters = []
+        model.convert_state_dict = lambda flat: _flatten(
+            convert_hf_opt_state_dict(flat, config)
+        )
+        return model

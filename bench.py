@@ -502,7 +502,7 @@ def _mode_decode(platform: str) -> None:
     from accelerate_tpu.generation import generate
     from accelerate_tpu.models import LlamaForCausalLM
 
-    config, bsz, _ = _bench_config(platform)
+    config, _, _ = _bench_config(platform)
     if platform == "cpu":
         bsz, prompt, short, long_ = 2, 16, 2, 6
     else:
