@@ -168,7 +168,7 @@ def dispatch_model(
         key = ".".join(_ppart(p) for p in path)
         flat[key] = leaf
 
-    from .utils.modeling import stacked_prefixes
+    from .utils.modeling import stacked_prefix_of, stacked_prefixes
 
     prefixes = stacked_prefixes(getattr(model, "stacked_params_prefix", None))
     devices = jax.local_devices()
@@ -176,7 +176,7 @@ def dispatch_model(
     slice_plans: dict[str, list] = {}  # path -> per-layer tiers (straddling stacks)
     unmapped = []
     for key in flat:
-        stack_prefix = next((p for p in prefixes if key.startswith(p + ".")), None)
+        stack_prefix = stacked_prefix_of(key, prefixes)
         if stack_prefix is not None:
             # per-layer lookup: 'layers.wq' layer i probes 'layers.i.wq' (the
             # expanded granularity auto maps use), falling back to the

@@ -395,4 +395,5 @@ class MixtralForCausalLM:
         )
         model.config = config
         model.supports_kv_cache = True
+        model.stacked_params_prefix = "layers"
         return model

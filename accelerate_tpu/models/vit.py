@@ -193,4 +193,5 @@ class ViTForImageClassification:
             name="ViTForImageClassification",
         )
         model.config = config
+        model.stacked_params_prefix = "layers"
         return model

@@ -76,6 +76,13 @@ def stacked_prefixes(expand_stacked) -> tuple[str, ...]:
     return tuple(expand_stacked)
 
 
+def stacked_prefix_of(key: str, prefixes) -> str | None:
+    """The stacked prefix a flat dot-path lives under, else None — the one
+    definition of 'is this leaf layer-stacked' shared by dispatch,
+    flat-shape expansion, and quantization eligibility."""
+    return next((p for p in prefixes if key.startswith(p + ".")), None)
+
+
 def flat_param_shapes(model_or_params, expand_stacked=None) -> dict[str, tuple]:
     """``{dot.path: (shape, dtype)}`` for a Model/PreparedModel/params tree.
 
@@ -91,7 +98,7 @@ def flat_param_shapes(model_or_params, expand_stacked=None) -> dict[str, tuple]:
         key = ".".join(_part(p) for p in path)
         shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
         dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
-        prefix = next((p for p in prefixes if key.startswith(p + ".")), None)
+        prefix = stacked_prefix_of(key, prefixes)
         if prefix is not None and len(shape) >= 1:
             for i in range(shape[0]):
                 flat[f"{prefix}.{i}.{key[len(prefix) + 1:]}"] = (shape[1:], dtype)

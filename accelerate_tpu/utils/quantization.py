@@ -788,16 +788,24 @@ class BnbQuantizationConfig:
         return {"bfloat16": jnp.bfloat16, "float16": jnp.float16}.get(name, jnp.float32)
 
 
-def _eligible(path: str, leaf, config: BnbQuantizationConfig) -> bool:
+def _eligible(
+    path: str, leaf, config: BnbQuantizationConfig, stacked: bool = False
+) -> bool:
     if isinstance(leaf, (QTensor, Q4Tensor)):
         return False
     shape = getattr(leaf, "shape", ())
     dtype = getattr(leaf, "dtype", None)
-    if len(shape) < 2 or dtype is None or not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+    min_ndim = 3 if stacked else 2
+    if len(shape) < min_ndim or dtype is None or not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        # under a layer-stacked prefix a 2-D leaf is a per-layer VECTOR
+        # ([L, h] norm/bias): the shape[-2] guard below can't see that
+        # once L >= 16, and quantizing it would share one scale across
+        # layers and break per-layer scan slicing
         return False
-    # only true matmul weights: a layer-stacked norm is [L, h] with a tiny
-    # second-to-last dim — quantizing it would be wrong-scaled and hurts
-    # precision where it matters most (reference bnb swaps Linear only)
+    # only true matmul weights: an unstacked norm is [h] / a bias [out]
+    # with a tiny (or missing) second-to-last dim — quantizing it would be
+    # wrong-scaled and hurts precision where it matters most (reference
+    # bnb swaps Linear only)
     if shape[-2] < 16:
         return False
     if config.load_in_4bit and shape[-1] % 2:
@@ -820,10 +828,19 @@ def quantize_model_params(model: Model, config: BnbQuantizationConfig) -> Model:
     (params + apply_fn swapped), mirroring the reference's in-place module
     replacement (``bnb.py:274`` ``replace_with_bnb_layers``)."""
     from ..big_modeling import _ppart
+    from .modeling import stacked_prefix_of, stacked_prefixes
 
+    prefixes = stacked_prefixes(getattr(model, "stacked_params_prefix", None))
     flat, treedef = jax.tree_util.tree_flatten_with_path(model.params)
     plan = [
-        (path, leaf, _eligible(".".join(_ppart(p) for p in path), leaf, config))
+        (
+            path,
+            leaf,
+            _eligible(
+                p_str := ".".join(_ppart(p) for p in path), leaf, config,
+                stacked=stacked_prefix_of(p_str, prefixes) is not None,
+            ),
+        )
         for path, leaf in flat
     ]
     if not any(e for _, _, e in plan):

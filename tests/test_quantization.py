@@ -56,6 +56,42 @@ def test_quantized_model_forward_close_to_fp32():
     assert agree > 0.9
 
 
+def test_deep_stack_norms_stay_fp():
+    """Regression: at >=16 stacked layers a [L, h] norm/vector leaf passed
+    the shape[-2] >= 16 matmul-weight guard and was quantized with ONE
+    scale shared across layers, breaking per-layer scan slicing (leading
+    axes L vs 1). Stacked-prefix leaves must be rank >= 3 to quantize."""
+    config = LlamaConfig.tiny(layers=16)
+    model = LlamaForCausalLM.from_config(config, seed=1)
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16)).astype(np.int32)
+    ref = np.asarray(model.apply_fn(model.params, input_ids=ids)["logits"])
+    model = quantize_model_params(model, BnbQuantizationConfig())
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        model.params, is_leaf=lambda l: isinstance(l, QTensor)
+    )[0]:
+        name = str(path[-1])
+        if "norm" in name:
+            assert not isinstance(leaf, QTensor), name
+    out = np.asarray(jax.jit(model.apply_fn)(model.params, input_ids=ids)["logits"])
+    assert np.max(np.abs(out - ref)) / max(np.abs(ref).max(), 1.0) < 0.05
+
+
+def test_mixtral_declares_stacked_prefix():
+    """Every layer-stacked zoo family must declare stacked_params_prefix —
+    the quantization eligibility guard keys off it (review follow-up to
+    test_deep_stack_norms_stay_fp: mixtral and vit scanned stacked layers
+    without declaring)."""
+    from accelerate_tpu.models import MODEL_ZOO
+
+    for name in ("mixtral-8x7b", "vit-base-patch16-224"):
+        import accelerate_tpu.big_modeling as bm
+
+        cfg, factory = MODEL_ZOO[name]
+        with bm.init_empty_weights():
+            meta = factory(cfg)
+        assert getattr(meta, "stacked_params_prefix", None) == "layers", name
+
+
 def test_skip_modules_keep_fp32():
     config, model, _ = _tiny_llama()
     model = quantize_model_params(
