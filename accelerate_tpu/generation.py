@@ -198,6 +198,8 @@ def generate(
     seed: int = 0,
     attention_mask=None,
     use_cache: bool = False,
+    draft_model=None,
+    num_draft_tokens: int = 5,
 ):
     """Greedy / temperature-sampled decoding. Returns ``[b, prompt+new]``
     int32 token ids (right-padded with ``eos`` after a sequence finishes).
@@ -217,6 +219,25 @@ def generate(
         return _generate_seq2seq(
             model, input_ids, max_new_tokens, do_sample, temperature,
             eos_token_id, seed, attention_mask,
+        )
+    if draft_model is not None:
+        if do_sample:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only: rejection sampling for "
+                "do_sample=True is not implemented (pass do_sample=False)"
+            )
+        target = _cache_backend(model)
+        draft = _cache_backend(draft_model)
+        if target is None or draft is None:
+            raise ValueError(
+                "draft_model decoding needs KV-cache support on both models "
+                "(supports_kv_cache on a Model/PreparedModel); got "
+                f"target={'ok' if target else 'unsupported'}, "
+                f"draft={'ok' if draft else 'unsupported'}"
+            )
+        return _generate_speculative(
+            target, draft, input_ids, max_new_tokens, int(num_draft_tokens),
+            eos_token_id, attention_mask,
         )
     if use_cache:
         backend = _cache_backend(model)
@@ -426,4 +447,160 @@ def _generate_cached(
     for s in range(n_emit):
         buf[rows, lengths] = toks[s]
         lengths += 1
+    return buf[:, : int(lengths.max())]
+
+
+def _spec_jits(apply_fn, draft_apply, cache_len: int, k: int):
+    """Compiled pieces of the speculative loop, cached per (apply fns,
+    cache_len, k): target/draft prefill, the draft chunk scan (reused from
+    the cached path), the draft feed-only step that pushes the last draft
+    token's K/V so the draft cache never develops a hole, and the target
+    verify chunk (one s = k+1 forward + argmax)."""
+    _, scan_cache = _jitted_for(apply_fn, cache_len)
+    # the draft apply is part of the key: the same target can be paired
+    # with different drafts, and a stale feed closure would run one
+    # draft's apply_fn with another's params
+    entry = scan_cache.get(("spec", k, id(draft_apply)))
+    if entry is None:
+        def verify(params, kv, chunk, pos):
+            out = apply_fn(params, input_ids=chunk, kv_cache=kv, cache_index=pos)
+            return out["kv_cache"], jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)
+
+        def feed(params, kv, tok, pos):
+            return draft_apply(
+                params, input_ids=tok[:, None], kv_cache=kv, cache_index=pos
+            )["kv_cache"]
+
+        entry = (
+            jax.jit(verify, donate_argnums=(1,)),
+            jax.jit(feed, donate_argnums=(1,)),
+        )
+        scan_cache[("spec", k, id(draft_apply))] = entry
+    return entry
+
+
+def _generate_speculative(
+    target, draft, input_ids, max_new_tokens, k, eos_token_id, attention_mask,
+):
+    """Greedy speculative decoding (the reference has no analog): a cheap
+    draft model proposes ``k`` tokens autoregressively, the target model
+    scores all of them in ONE chunked decode forward (s = k+1 — the
+    multi-token `cached_attention` path), and the longest matching prefix
+    plus the target's own next token are accepted. Greedy acceptance is
+    exact: the emitted sequence equals plain greedy decoding of the target
+    for ANY draft — the draft only changes how many target forwards it
+    takes. Per round the target reads its weights once for up to ``k+1``
+    emitted tokens, which is the win in the memory-bound decode regime.
+
+    Cache rollback is free by construction: `cached_attention` masks every
+    position past each row's own index, so rejected draft entries are
+    simply never attended and are overwritten by later appends.
+    """
+    apply_t, params_t = target
+    apply_d, params_d = draft
+    ids = np.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, prompt_len = ids.shape
+    mask = (
+        np.atleast_2d(np.asarray(attention_mask, np.int32))
+        if attention_mask is not None
+        else np.ones((b, prompt_len), np.int32)
+    )
+    if mask.shape != (b, prompt_len):
+        raise ValueError(
+            f"attention_mask shape {mask.shape} does not match input_ids {(b, prompt_len)}"
+        )
+    lengths = mask.sum(axis=1).astype(np.int64)
+    total = prompt_len + max_new_tokens
+    # verify chunks may overshoot a row's budget by up to k; both caches
+    # carry the margin so the scatter never clips a live row
+    cache_len = total + k + 1
+    buf = np.zeros((b, total), np.int32)
+    buf[:, :prompt_len] = ids
+    if max_new_tokens <= 0:
+        return buf[:, : int(lengths.max())] if lengths.size else buf
+
+    prefill_t, scan_cache_t = _jitted_for(apply_t, cache_len)
+    prefill_d, scan_cache_d = _jitted_for(apply_d, cache_len)
+    verify, feed = _spec_jits(apply_t, apply_d, cache_len, k)
+    draft_chunk = _scan_decode_for(apply_d, scan_cache_d, k, do_sample=False, has_eos=False)
+
+    out_t = prefill_t(params_t, jnp.asarray(ids), jnp.asarray(mask))
+    out_d = prefill_d(params_d, jnp.asarray(ids), jnp.asarray(mask))
+    rows = np.arange(b)
+    logits0 = out_t["logits"][jnp.asarray(rows), jnp.asarray(lengths - 1), :]
+    pending = np.asarray(jax.device_get(jnp.argmax(logits0, axis=-1))).astype(np.int32)
+
+    kv_t, kv_d = out_t["kv_cache"], out_d["kv_cache"]
+    pos = lengths.copy()  # next cache slot == count of cached tokens per row
+    emitted = np.zeros((b,), np.int64)
+    finished = np.zeros((b,), bool)
+    has_eos = eos_token_id is not None
+    # greedy: the key is carried but never consumed; a HOST copy is
+    # re-materialised every round because the chunk scan donates its carry
+    key_host = np.asarray(jax.random.PRNGKey(0))
+    none_dev = jnp.int32(0)
+    temp_dev = jnp.float32(1.0)
+
+    def emit(row, tok):
+        if emitted[row] >= max_new_tokens or (has_eos and finished[row]):
+            return
+        t = int(tok)
+        buf[row, lengths[row]] = t
+        lengths[row] += 1
+        emitted[row] += 1
+        if has_eos and t == eos_token_id:
+            finished[row] = True
+
+    # the prefill pick is the first emitted token (each later round emits
+    # its accepted drafts plus the correction, which becomes the next
+    # round's pending — so only this initial pending needs emitting here)
+    for row in rows:
+        emit(row, pending[row])
+
+    while True:
+        alive = ~finished if has_eos else np.ones((b,), bool)
+        if not (alive & (emitted < max_new_tokens)).any():
+            break
+        # draft k tokens from the pending one (its K/V lands at pos)
+        carry = (kv_d, jnp.asarray(pending), jnp.asarray(pos, jnp.int32),
+                 jnp.asarray(key_host), jnp.zeros((b,), bool))
+        carry, d_toks = draft_chunk(params_d, carry, none_dev, temp_dev)
+        kv_d = feed(params_d, carry[0], carry[1], carry[2])  # push d_k's K/V
+        d_np = np.asarray(jax.device_get(d_toks))  # [k, b]
+
+        # one target forward over [pending, d_1 .. d_k]
+        chunk = np.concatenate([pending[None, :], d_np], axis=0).T.astype(np.int32)
+        kv_t, preds = verify(
+            params_t, kv_t, jnp.asarray(chunk), jnp.asarray(pos, jnp.int32)
+        )
+        p_np = np.asarray(jax.device_get(preds))  # [b, k+1]
+
+        # greedy accept: longest prefix where the target agrees, then the
+        # target's own token at the first disagreement (always >= 1 token)
+        match = p_np[:, :k] == d_np.T  # [b, k]
+        accept = np.where(
+            match.all(axis=1), k, np.argmin(match, axis=1)
+        ).astype(np.int64)
+        for row in rows:
+            for j in range(accept[row]):
+                emit(row, d_np[j, row])
+            emit(row, p_np[row, accept[row]])
+        pending = p_np[rows, accept].astype(np.int32)
+        pos = pos + accept + 1
+        # rows that are done keep riding the batch; pin their write position
+        # inside the cache margin so their (ignored) chunks never clip
+        done = finished | (emitted >= max_new_tokens)
+        pos[done] = np.minimum(pos[done], cache_len - k - 2)
+
+    # eos-finished rows pad with eos to the step the LAST row stopped at —
+    # the same column the all-finished break of the plain loops produces
+    if has_eos:
+        n_emit = int(emitted.max())
+        for row in rows:
+            while emitted[row] < n_emit and lengths[row] < total:
+                buf[row, lengths[row]] = eos_token_id
+                lengths[row] += 1
+                emitted[row] += 1
     return buf[:, : int(lengths.max())]

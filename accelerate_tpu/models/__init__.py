@@ -141,6 +141,32 @@ def config_from_hf_json(path: str):
             num_attention_heads=d.get("n_head", 12),
             max_position_embeddings=d.get("n_positions", 1024),
         )
+    if mt == "bert":
+        from .bert import BertConfig
+
+        return BertConfig(
+            vocab_size=d.get("vocab_size", 30522),
+            hidden_size=d.get("hidden_size", 768),
+            intermediate_size=d.get("intermediate_size", 3072),
+            num_hidden_layers=d.get("num_hidden_layers", 12),
+            num_attention_heads=d.get("num_attention_heads", 12),
+            max_position_embeddings=d.get("max_position_embeddings", 512),
+            type_vocab_size=d.get("type_vocab_size", 2),
+            norm_eps=d.get("layer_norm_eps", 1e-12),
+        )
+    if mt == "vit":
+        from .vit import ViTConfig
+
+        return ViTConfig(
+            image_size=d.get("image_size", 224),
+            patch_size=d.get("patch_size", 16),
+            in_channels=d.get("num_channels", 3),
+            hidden_size=d.get("hidden_size", 768),
+            num_hidden_layers=d.get("num_hidden_layers", 12),
+            num_attention_heads=d.get("num_attention_heads", 12),
+            intermediate_size=d.get("intermediate_size", 3072),
+            layer_norm_eps=d.get("layer_norm_eps", 1e-6),
+        )
     if mt == "opt":
         from .opt import OPTConfig
 

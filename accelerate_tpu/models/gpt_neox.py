@@ -308,7 +308,7 @@ def _gpt_neox_decode_layer(c, layer, x, k_cache_l, v_cache_l, idx, rope, pp_manu
     cos, sin = rope
     b, s, _ = x.shape
     nh, hd = c.num_attention_heads, c.head_dim
-    positions = idx[:, None]  # [b, 1]
+    positions = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
     y = layer_norm(x, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
     qkv = dense(y, layer["w_qkv"])
     if c.attention_bias:

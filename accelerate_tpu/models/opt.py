@@ -268,7 +268,8 @@ def _opt_decode_step(c, params, input_ids, kv_cache, cache_index):
 
     b, s = input_ids.shape
     idx = jnp.asarray(cache_index, jnp.int32).reshape(b)
-    x = params["wte"][input_ids] + params["wpe"][idx[:, None]]
+    pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+    x = params["wte"][input_ids] + params["wpe"][pos]
 
     x, kv = decode_stack(
         lambda layer, h, kc_l, vc_l, idx_b, pp_manual: _opt_decode_layer(

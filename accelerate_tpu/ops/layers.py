@@ -171,12 +171,14 @@ def fused_cross_entropy(
 
 
 def write_kv_cache(k_cache_l, v_cache_l, k, v, idx, pin_replicated: bool = False):
-    """Append one decode step's K/V (``[b, 1, n_kv, hd]``) at each row's own
-    cache position ``idx[b]`` — the single owner of the decode scatter every
-    causal family shares. ``pin_replicated`` constrains the scatter operands
-    replicated over the AUTO mesh axes: under a shard_map manual over
-    ``pp``, GSPMD's scatter partitioner check-fails when it tries to
-    tp-shard the cache update, and decode tensors are tiny."""
+    """Append a decode chunk's K/V (``[b, s, n_kv, hd]``, ``s >= 1``) at
+    each row's own cache positions ``idx[b] .. idx[b]+s-1`` — the single
+    owner of the decode scatter every causal family shares (``s == 1`` is
+    the plain per-token decode; ``s > 1`` is the speculative-verify chunk).
+    ``pin_replicated`` constrains the scatter operands replicated over the
+    AUTO mesh axes: under a shard_map manual over ``pp``, GSPMD's scatter
+    partitioner check-fails when it tries to tp-shard the cache update,
+    and decode tensors are tiny."""
     if pin_replicated:
         from jax.sharding import PartitionSpec
 
@@ -188,10 +190,12 @@ def write_kv_cache(k_cache_l, v_cache_l, k, v, idx, pin_replicated: bool = False
 
         k, v = _pin(k), _pin(v)
         k_cache_l, v_cache_l = _pin(k_cache_l), _pin(v_cache_l)
-    rows = jnp.arange(k.shape[0])
-    idx = jnp.asarray(idx, jnp.int32).reshape(k.shape[0])
-    k_cache_l = k_cache_l.at[rows, idx].set(k[:, 0])
-    v_cache_l = v_cache_l.at[rows, idx].set(v[:, 0])
+    b, s = k.shape[0], k.shape[1]
+    rows = jnp.arange(b)[:, None]
+    idx = jnp.asarray(idx, jnp.int32).reshape(b)
+    pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+    k_cache_l = k_cache_l.at[rows, pos].set(k)
+    v_cache_l = v_cache_l.at[rows, pos].set(v)
     return k_cache_l, v_cache_l
 
 
@@ -209,7 +213,7 @@ def rope_cached_attention_block(
     from .fp8 import dense
 
     b, s, _ = x.shape
-    positions = idx[:, None]  # [b, 1]
+    positions = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
     y = rms_norm(x, layer["attn_norm"], eps)
     q = apply_rope(
         dense(y, layer["wq"]).reshape(b, s, n_heads, head_dim), cos, sin, positions
@@ -234,13 +238,16 @@ def rope_cached_attention_block(
 
 
 def cached_attention(q, k_cache, v_cache, idx):
-    """Single-token attention against a KV cache with per-row valid prefix.
+    """Chunked attention against a KV cache with per-row valid prefix.
 
-    q: ``[b, 1, nh, hd]`` (the token being decoded); caches
-    ``[b, max_cache, n_kv, hd]`` already containing this step's K/V at
-    ``idx[b]``; rows attend only positions ``<= idx[b]``. GQA handled by
-    repeating KV heads. f32 scores/softmax. Shared by every model family's
-    decode step (no per-model drift in the masking or dtype policy).
+    q: ``[b, s, nh, hd]`` (``s == 1``: the token being decoded; ``s > 1``:
+    a speculative-verify chunk); caches ``[b, max_cache, n_kv, hd]``
+    already containing this chunk's K/V at ``idx[b] .. idx[b]+s-1``. Query
+    position ``j`` of row ``b`` attends cache positions ``<= idx[b]+j`` —
+    the per-row prefix plus the causal triangle within the chunk. GQA
+    handled by repeating KV heads. f32 scores/softmax. Shared by every
+    model family's decode step (no per-model drift in the masking or
+    dtype policy).
     """
     b, s, nh, hd = q.shape
     n_kv = k_cache.shape[2]
@@ -249,11 +256,12 @@ def cached_attention(q, k_cache, v_cache, idx):
         k_cache = jnp.repeat(k_cache, rep, axis=2)
         v_cache = jnp.repeat(v_cache, rep, axis=2)
     max_cache = k_cache.shape[1]
-    valid = jnp.arange(max_cache)[None, :] <= idx[:, None]  # [b, max]
+    q_pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+    valid = jnp.arange(max_cache)[None, None, :] <= q_pos[:, :, None]  # [b, s, max]
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
     ) / np.sqrt(float(hd))
-    scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(jnp.float32).min)
+    scores = jnp.where(valid[:, None, :, :], scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)

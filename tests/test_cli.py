@@ -85,6 +85,36 @@ class TestEstimate:
         p.write_text(json.dumps(cfg))
         assert cli_main(["estimate-memory", str(p)]) == 0
 
+    def test_hf_config_json_all_model_types(self, tmp_path, capsys):
+        """Every zoo family reachable from an HF config.json by model_type
+        (the reference's 'point estimate at any checkpoint' UX)."""
+        cases = {
+            "gpt2": {"n_embd": 32, "n_layer": 2, "n_head": 4, "vocab_size": 128},
+            "bert": {"hidden_size": 32, "num_hidden_layers": 2,
+                     "num_attention_heads": 4, "intermediate_size": 64,
+                     "vocab_size": 128},
+            "vit": {"hidden_size": 32, "num_hidden_layers": 2,
+                    "num_attention_heads": 4, "intermediate_size": 64},
+            "opt": {"hidden_size": 32, "ffn_dim": 64, "num_hidden_layers": 2,
+                    "num_attention_heads": 4, "vocab_size": 128},
+            "gpt_neox": {"hidden_size": 32, "intermediate_size": 64,
+                         "num_hidden_layers": 2, "num_attention_heads": 4,
+                         "vocab_size": 128},
+            "gptj": {"n_embd": 32, "n_inner": 64, "n_layer": 2, "n_head": 4,
+                     "rotary_dim": 4, "vocab_size": 128},
+            "t5": {"d_model": 32, "d_kv": 8, "d_ff": 64, "num_layers": 2,
+                   "num_heads": 4, "vocab_size": 128},
+            "mixtral": {"hidden_size": 32, "intermediate_size": 64,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "num_key_value_heads": 4, "num_local_experts": 2,
+                        "num_experts_per_tok": 1, "vocab_size": 128},
+        }
+        for mt, fields in cases.items():
+            p = tmp_path / f"{mt}.json"
+            p.write_text(json.dumps({"model_type": mt, **fields}))
+            assert cli_main(["estimate-memory", str(p)]) == 0, mt
+        capsys.readouterr()
+
 
 class TestMerge:
     def test_merge_sharded(self, tmp_path, capsys):

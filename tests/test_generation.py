@@ -355,3 +355,125 @@ def test_mixtral_cached_generation_on_pp_mesh():
     ref = np.asarray(generate(model, ids, max_new_tokens=5, use_cache=False))
     cached = np.asarray(generate(model, ids, max_new_tokens=5, use_cache=True))
     np.testing.assert_array_equal(cached, ref)
+
+
+# ---------------------------------------------------------------------------
+# chunked decode + speculative decoding
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_decode_matches_full_forward():
+    """s > 1 decode (the speculative-verify path): feeding a chunk against
+    the KV cache must produce the same logits as the full forward at every
+    chunk position, for both the rope and learned-position families."""
+    from accelerate_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    for cls, cfg in [
+        (LlamaForCausalLM, LlamaConfig.tiny(layers=2, seq=64)),
+        (GPT2LMHeadModel, GPT2Config.tiny(layers=2)),
+        (GPTNeoXForCausalLM, GPTNeoXConfig.tiny(layers=2)),
+    ]:
+        model = cls.from_config(cfg, seed=1)
+        ids = np.random.default_rng(0).integers(0, 256, size=(2, 12)).astype(np.int32)
+        with jax.default_matmul_precision("highest"):
+            full = np.asarray(model.apply_fn(model.params, input_ids=ids)["logits"])
+            pre = model.apply_fn(
+                model.params, input_ids=ids[:, :8], use_cache=True, max_cache_len=12
+            )
+            step = model.apply_fn(
+                model.params, input_ids=ids[:, 8:12],
+                kv_cache=pre["kv_cache"], cache_index=np.full((2,), 8, np.int32),
+            )
+        np.testing.assert_allclose(
+            np.asarray(step["logits"]), full[:, 8:12], rtol=2e-4, atol=2e-4
+        )
+
+
+def _spec_case():
+    target = LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=4, seq=64), seed=1)
+    draft = LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=2, seq=64), seed=9)
+    ids = np.random.default_rng(0).integers(1, 250, size=(3, 10)).astype(np.int32)
+    mask = np.ones((3, 10), np.int32)
+    mask[1, 7:] = 0
+    ids[1, 7:] = 0  # ragged right-padded row
+    return target, draft, ids, mask
+
+
+def test_speculative_equals_plain_greedy():
+    """The speculative guarantee: output identical to plain greedy decoding
+    for ANY draft — an unrelated random draft (low acceptance), the target
+    itself (full acceptance), and k at both extremes."""
+    target, draft, ids, mask = _spec_case()
+    with jax.default_matmul_precision("highest"):
+        plain = np.asarray(
+            generate(target, ids, max_new_tokens=12, use_cache=True, attention_mask=mask)
+        )
+        for d, k in [(draft, 4), (target, 4), (draft, 1), (target, 8)]:
+            spec = np.asarray(
+                generate(target, ids, max_new_tokens=12, draft_model=d,
+                         num_draft_tokens=k, attention_mask=mask)
+            )
+            np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_eos_matches_plain():
+    target, draft, ids, mask = _spec_case()
+    with jax.default_matmul_precision("highest"):
+        probe = np.asarray(
+            generate(target, ids, max_new_tokens=12, use_cache=True, attention_mask=mask)
+        )
+        eos = int(probe[0, -1])  # a token we know the model emits
+        plain = np.asarray(
+            generate(target, ids, max_new_tokens=12, use_cache=True,
+                     attention_mask=mask, eos_token_id=eos)
+        )
+        spec = np.asarray(
+            generate(target, ids, max_new_tokens=12, draft_model=draft,
+                     num_draft_tokens=3, attention_mask=mask, eos_token_id=eos)
+        )
+    np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_rejects_sampling():
+    target, draft, ids, mask = _spec_case()
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        generate(target, ids, max_new_tokens=4, draft_model=draft, do_sample=True)
+
+
+def test_speculative_gpt2_family():
+    t = GPT2LMHeadModel.from_config(GPT2Config.tiny(layers=4), seed=1)
+    d = GPT2LMHeadModel.from_config(GPT2Config.tiny(layers=2), seed=7)
+    ids = np.random.default_rng(2).integers(1, 250, size=(2, 9)).astype(np.int32)
+    with jax.default_matmul_precision("highest"):
+        plain = np.asarray(generate(t, ids, max_new_tokens=10, use_cache=True))
+        spec = np.asarray(
+            generate(t, ids, max_new_tokens=10, draft_model=d, num_draft_tokens=5)
+        )
+    np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_draft_swap_same_target():
+    """Regression: the compiled draft-feed closure is cached on the target's
+    jit cache — swapping in a different draft (even another architecture)
+    must not reuse the first draft's apply_fn with the new params."""
+    target, llama_draft, ids, mask = _spec_case()
+    gpt2_draft = GPT2LMHeadModel.from_config(
+        GPT2Config.tiny(layers=2, vocab_size=256), seed=3
+    )
+    with jax.default_matmul_precision("highest"):
+        plain = np.asarray(
+            generate(target, ids, max_new_tokens=8, use_cache=True, attention_mask=mask)
+        )
+        for d in (gpt2_draft, llama_draft):
+            spec = np.asarray(
+                generate(target, ids, max_new_tokens=8, draft_model=d,
+                         num_draft_tokens=5, attention_mask=mask)
+            )
+            np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_bad_mask_raises():
+    target, draft, ids, _ = _spec_case()
+    bad = np.ones((ids.shape[0], ids.shape[1] + 3), np.int32)
+    with pytest.raises(ValueError, match="attention_mask shape"):
+        generate(target, ids, max_new_tokens=4, draft_model=draft, attention_mask=bad)
