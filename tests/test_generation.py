@@ -477,3 +477,20 @@ def test_speculative_bad_mask_raises():
     bad = np.ones((ids.shape[0], ids.shape[1] + 3), np.int32)
     with pytest.raises(ValueError, match="attention_mask shape"):
         generate(target, ids, max_new_tokens=4, draft_model=draft, attention_mask=bad)
+
+
+def test_speculative_on_prepared_target():
+    """Speculative decoding through a prepare()'d mesh-sharded target (the
+    PreparedModel cache backend) with a raw-Model draft."""
+    acc = _mesh_accelerator(dp=2, fsdp=2, tp=2)
+    target = acc.prepare(
+        LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=4, seq=64), seed=1)
+    )
+    draft = LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=2, seq=64), seed=9)
+    ids = np.random.default_rng(0).integers(1, 250, size=(2, 8)).astype(np.int32)
+    with jax.default_matmul_precision("highest"):
+        plain = np.asarray(generate(target, ids, max_new_tokens=8, use_cache=True))
+        spec = np.asarray(
+            generate(target, ids, max_new_tokens=8, draft_model=draft, num_draft_tokens=4)
+        )
+    np.testing.assert_array_equal(spec, plain)
