@@ -450,33 +450,111 @@ def _generate_cached(
     return buf[:, : int(lengths.max())]
 
 
-def _spec_jits(apply_fn, draft_apply, cache_len: int, k: int):
-    """Compiled pieces of the speculative loop, cached per (apply fns,
-    cache_len, k): target/draft prefill, the draft chunk scan (reused from
-    the cached path), the draft feed-only step that pushes the last draft
-    token's K/V so the draft cache never develops a hole, and the target
-    verify chunk (one s = k+1 forward + argmax)."""
+def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool):
+    """The WHOLE speculative loop as one compiled program — draft scan,
+    feed-only push of the last draft token (so the draft cache never
+    develops a hole), target verify chunk, vectorised accept/emit, and the
+    round-to-round state threading all live inside a ``lax.while_loop``,
+    so a full generation is ONE dispatch regardless of round count (the
+    same move that made the plain decode loop dispatch-latency-proof).
+    Cached per (target apply, cache_len); the draft apply is part of the
+    key — the same target can be paired with different drafts, and a stale
+    closure would run one draft's apply_fn with another's params."""
     _, scan_cache = _jitted_for(apply_fn, cache_len)
-    # the draft apply is part of the key: the same target can be paired
-    # with different drafts, and a stale feed closure would run one
-    # draft's apply_fn with another's params
-    entry = scan_cache.get(("spec", k, id(draft_apply)))
-    if entry is None:
-        def verify(params, kv, chunk, pos):
-            out = apply_fn(params, input_ids=chunk, kv_cache=kv, cache_index=pos)
-            return out["kv_cache"], jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)
+    key_ = ("specloop", k, id(draft_apply), has_eos)
+    runner = scan_cache.get(key_)
+    if runner is not None:
+        return runner
 
-        def feed(params, kv, tok, pos):
-            return draft_apply(
-                params, input_ids=tok[:, None], kv_cache=kv, cache_index=pos
+    def spec_loop(
+        params_t, params_d, kv_t, kv_d, buf, lengths, emitted, pending,
+        pos, finished, eos_id, max_new,
+    ):
+        b, total = buf.shape
+        rows = jnp.arange(b, dtype=jnp.int32)
+        cache_limit = jnp.int32(cache_len - k - 2)
+
+        def round_done(state):
+            _, _, _, _, emitted, _, _, finished = state
+            return ~(finished | (emitted >= max_new)).all()
+
+        def round_body(state):
+            kv_t, kv_d, buf, lengths, emitted, pending, pos, finished = state
+
+            # draft k tokens greedily from the pending one
+            def dstep(c, _):
+                kv, tok, p = c
+                out = draft_apply(
+                    params_d, input_ids=tok[:, None], kv_cache=kv, cache_index=p
+                )
+                nxt = jnp.argmax(out["logits"][:, 0, :], axis=-1).astype(jnp.int32)
+                return (out["kv_cache"], nxt, p + 1), nxt
+
+            (kv_d, d_last, d_pos), d = jax.lax.scan(
+                dstep, (kv_d, pending, pos), None, length=k
+            )
+            # feed-only: d_k's K/V must land so the draft cache has no hole
+            kv_d = draft_apply(
+                params_d, input_ids=d_last[:, None], kv_cache=kv_d, cache_index=d_pos
             )["kv_cache"]
+            d = d.T.astype(jnp.int32)  # [b, k]
 
-        entry = (
-            jax.jit(verify, donate_argnums=(1,)),
-            jax.jit(feed, donate_argnums=(1,)),
-        )
-        scan_cache[("spec", k, id(draft_apply))] = entry
-    return entry
+            # one target forward over [pending, d_1 .. d_k]
+            chunk = jnp.concatenate([pending[:, None], d], axis=1)
+            out_t = apply_fn(
+                params_t, input_ids=chunk, kv_cache=kv_t, cache_index=pos
+            )
+            kv_t = out_t["kv_cache"]
+            preds = jnp.argmax(out_t["logits"], axis=-1).astype(jnp.int32)  # [b, k+1]
+
+            # greedy accept: longest agreeing prefix + the target's own token
+            match = preds[:, :k] == d
+            accept = jnp.where(
+                match.all(axis=1), k, jnp.argmin(match, axis=1)
+            ).astype(jnp.int32)  # [b]
+            j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            corr = jnp.take_along_axis(preds, accept[:, None], axis=1)  # [b, 1]
+            d_ext = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            tok_seq = jnp.where(
+                j < accept[:, None], d_ext, jnp.where(j == accept[:, None], corr, 0)
+            )
+
+            # emit semantics identical to the sequential rule: skip finished
+            # rows, cut a run at its first eos, cap at the token budget
+            base = j <= accept[:, None]
+            if has_eos:
+                is_eos = (tok_seq == eos_id).astype(jnp.int32)
+                prior_eos = jnp.cumsum(is_eos, axis=1) - is_eos
+                base = base & (prior_eos == 0) & (~finished)[:, None]
+            cnt_before = jnp.cumsum(base.astype(jnp.int32), axis=1) - base.astype(jnp.int32)
+            valid = base & (emitted[:, None] + cnt_before < max_new)
+            write_pos = jnp.where(valid, lengths[:, None] + cnt_before, total)
+            buf = buf.at[rows[:, None], write_pos].set(tok_seq, mode="drop")
+            n_row = valid.astype(jnp.int32).sum(axis=1)
+            emitted = emitted + n_row
+            lengths = lengths + n_row
+            if has_eos:
+                finished = finished | (valid & (tok_seq == eos_id)).any(axis=1)
+
+            pending = corr[:, 0]
+            pos = pos + accept + 1
+            # done rows keep riding the batch; pin their write position
+            # inside the cache margin so their (ignored) chunks never clip
+            done = finished | (emitted >= max_new)
+            pos = jnp.where(done, jnp.minimum(pos, cache_limit), pos)
+            return kv_t, kv_d, buf, lengths, emitted, pending, pos, finished
+
+        state = (kv_t, kv_d, buf, lengths, emitted, pending, pos, finished)
+        state = jax.lax.while_loop(round_done, round_body, state)
+        kv_t, kv_d, buf, lengths, emitted, _, _, _ = state
+        # the caches ride back in the outputs ONLY so the donation can
+        # alias them (unreturned donated buffers force a transient second
+        # copy of both caches and a per-compile warning); callers drop them
+        return buf, lengths, emitted, kv_t, kv_d
+
+    runner = jax.jit(spec_loop, donate_argnums=(2, 3, 4))
+    scan_cache[key_] = runner
+    return runner
 
 
 def _generate_speculative(
@@ -521,10 +599,10 @@ def _generate_speculative(
     if max_new_tokens <= 0:
         return buf[:, : int(lengths.max())] if lengths.size else buf
 
-    prefill_t, scan_cache_t = _jitted_for(apply_t, cache_len)
-    prefill_d, scan_cache_d = _jitted_for(apply_d, cache_len)
-    verify, feed = _spec_jits(apply_t, apply_d, cache_len, k)
-    draft_chunk = _scan_decode_for(apply_d, scan_cache_d, k, do_sample=False, has_eos=False)
+    has_eos = eos_token_id is not None
+    prefill_t, _ = _jitted_for(apply_t, cache_len)
+    prefill_d, _ = _jitted_for(apply_d, cache_len)
+    spec_loop = _spec_loop_for(apply_t, apply_d, cache_len, k, has_eos)
 
     out_t = prefill_t(params_t, jnp.asarray(ids), jnp.asarray(mask))
     out_d = prefill_d(params_d, jnp.asarray(ids), jnp.asarray(mask))
@@ -532,67 +610,32 @@ def _generate_speculative(
     logits0 = out_t["logits"][jnp.asarray(rows), jnp.asarray(lengths - 1), :]
     pending = np.asarray(jax.device_get(jnp.argmax(logits0, axis=-1))).astype(np.int32)
 
-    kv_t, kv_d = out_t["kv_cache"], out_d["kv_cache"]
-    pos = lengths.copy()  # next cache slot == count of cached tokens per row
-    emitted = np.zeros((b,), np.int64)
-    finished = np.zeros((b,), bool)
-    has_eos = eos_token_id is not None
-    # greedy: the key is carried but never consumed; a HOST copy is
-    # re-materialised every round because the chunk scan donates its carry
-    key_host = np.asarray(jax.random.PRNGKey(0))
-    none_dev = jnp.int32(0)
-    temp_dev = jnp.float32(1.0)
+    # next cache slot == count of CACHED tokens: the prompt only — the
+    # pending pick is not yet fed, its K/V lands in the first draft step
+    pos = lengths.copy()
 
-    def emit(row, tok):
-        if emitted[row] >= max_new_tokens or (has_eos and finished[row]):
-            return
-        t = int(tok)
-        buf[row, lengths[row]] = t
+    # the prefill pick is the first emitted token (each round inside the
+    # compiled loop emits its accepted drafts plus the correction, which
+    # becomes the next round's pending — so only this one is host-emitted)
+    emitted = np.zeros((b,), np.int32)
+    finished = np.zeros((b,), bool)
+    for row in rows:
+        buf[row, lengths[row]] = pending[row]
         lengths[row] += 1
         emitted[row] += 1
-        if has_eos and t == eos_token_id:
+        if has_eos and pending[row] == eos_token_id:
             finished[row] = True
 
-    # the prefill pick is the first emitted token (each later round emits
-    # its accepted drafts plus the correction, which becomes the next
-    # round's pending — so only this initial pending needs emitting here)
-    for row in rows:
-        emit(row, pending[row])
-
-    while True:
-        alive = ~finished if has_eos else np.ones((b,), bool)
-        if not (alive & (emitted < max_new_tokens)).any():
-            break
-        # draft k tokens from the pending one (its K/V lands at pos)
-        carry = (kv_d, jnp.asarray(pending), jnp.asarray(pos, jnp.int32),
-                 jnp.asarray(key_host), jnp.zeros((b,), bool))
-        carry, d_toks = draft_chunk(params_d, carry, none_dev, temp_dev)
-        kv_d = feed(params_d, carry[0], carry[1], carry[2])  # push d_k's K/V
-        d_np = np.asarray(jax.device_get(d_toks))  # [k, b]
-
-        # one target forward over [pending, d_1 .. d_k]
-        chunk = np.concatenate([pending[None, :], d_np], axis=0).T.astype(np.int32)
-        kv_t, preds = verify(
-            params_t, kv_t, jnp.asarray(chunk), jnp.asarray(pos, jnp.int32)
-        )
-        p_np = np.asarray(jax.device_get(preds))  # [b, k+1]
-
-        # greedy accept: longest prefix where the target agrees, then the
-        # target's own token at the first disagreement (always >= 1 token)
-        match = p_np[:, :k] == d_np.T  # [b, k]
-        accept = np.where(
-            match.all(axis=1), k, np.argmin(match, axis=1)
-        ).astype(np.int64)
-        for row in rows:
-            for j in range(accept[row]):
-                emit(row, d_np[j, row])
-            emit(row, p_np[row, accept[row]])
-        pending = p_np[rows, accept].astype(np.int32)
-        pos = pos + accept + 1
-        # rows that are done keep riding the batch; pin their write position
-        # inside the cache margin so their (ignored) chunks never clip
-        done = finished | (emitted >= max_new_tokens)
-        pos[done] = np.minimum(pos[done], cache_len - k - 2)
+    buf_dev, lengths_dev, emitted_dev, _, _ = spec_loop(
+        params_t, params_d, out_t["kv_cache"], out_d["kv_cache"],
+        jnp.asarray(buf), jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(emitted), jnp.asarray(pending),
+        jnp.asarray(pos, jnp.int32), jnp.asarray(finished),
+        jnp.int32(eos_token_id if has_eos else 0), jnp.int32(max_new_tokens),
+    )
+    buf = np.array(jax.device_get(buf_dev))  # copy: device_get views are read-only
+    lengths = np.asarray(jax.device_get(lengths_dev)).astype(np.int64)
+    emitted = np.array(jax.device_get(emitted_dev))
 
     # eos-finished rows pad with eos to the step the LAST row stopped at —
     # the same column the all-finished break of the plain loops produces
