@@ -83,4 +83,8 @@ def __getattr__(name):
         from .generation import generate
 
         return generate
+    if name in ("TelemetryRecorder", "NULL_TELEMETRY", "get_active_recorder"):
+        from . import telemetry
+
+        return getattr(telemetry, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -102,12 +102,14 @@ class ProfileContext:
     ``step()`` once per training step; capture runs only during 'active'
     phases of the wait/warmup/active/repeat cycle."""
 
-    def __init__(self, handler: ProfileKwargs, trace_dir: str):
+    def __init__(self, handler: ProfileKwargs, trace_dir: str, telemetry=None):
         self.handler = handler
         self.trace_dir = trace_dir
         self.schedule = handler.build_schedule()
         self.step_num = 0
+        self.active_steps = 0
         self._tracing = False
+        self._telemetry = telemetry
         if handler.with_flops:
             # record XLA cost analyses of every compiled step executed
             # during the session (dumped to flops.json at exit)
@@ -129,6 +131,8 @@ class ProfileContext:
             self._tracing = False
 
     def step(self):
+        if self.schedule(self.step_num) == "active":
+            self.active_steps += 1
         if self.handler.profile_memory and self.schedule(self.step_num) == "active":
             import os as _os
 
@@ -162,6 +166,12 @@ class ProfileContext:
                     },
                     f,
                 )
+        if self._telemetry:
+            self._telemetry.record_profile(
+                trace_dir=self.trace_dir,
+                steps=self.step_num,
+                active_steps=self.active_steps,
+            )
 
 
 class Accelerator:
@@ -194,6 +204,7 @@ class Accelerator:
         dynamo_backend=None,  # accepted for parity; XLA always compiles
         even_batches: bool = True,
         use_seedable_sampler: bool = False,
+        telemetry: bool | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -472,6 +483,31 @@ class Accelerator:
         self.log_with = filter_trackers(log_with, self.logging_dir)
         self.trackers = []
 
+        # step-level telemetry (telemetry.py): opt-in via the constructor or
+        # ACCELERATE_TELEMETRY=1; disabled holds the no-op singleton so the
+        # hot path pays one attribute read
+        from .telemetry import NULL_TELEMETRY, TelemetryRecorder, set_active_recorder
+        from .utils.environment import parse_flag_from_env
+
+        if telemetry is None:
+            telemetry = parse_flag_from_env("ACCELERATE_TELEMETRY")
+        if telemetry:
+            self.telemetry = TelemetryRecorder(
+                logging_dir=self.logging_dir,
+                tracker_sink=self._telemetry_tracker_sink,
+            )
+            set_active_recorder(self.telemetry)
+        else:
+            self.telemetry = NULL_TELEMETRY
+            # Borg semantics: the newest Accelerator owns the process-wide
+            # observability state — a disabled one must silence a stale
+            # recorder left by an earlier telemetry=True instance, or
+            # "disabled" keeps writing to the old run's trail
+            from .lazy import set_compile_callback
+
+            set_active_recorder(None)
+            set_compile_callback(None)
+
     # ------------------------------------------------------------------
     # properties delegating to state (reference :525-760)
     # ------------------------------------------------------------------
@@ -647,6 +683,10 @@ class Accelerator:
         ``accelerator.py:1225``). Pass any combination of models
         (:class:`Model` / flax module+params), optax transformations,
         dataloaders and schedule fns; order is preserved."""
+        import time as _time
+
+        _prepare_t0 = _time.perf_counter()
+        _models_before = len(self._models)
         if device_placement is None:
             device_placement = [None] * len(args)
 
@@ -718,6 +758,15 @@ class Accelerator:
         if self.deepspeed_plugin is not None:
             self._fill_deepspeed_auto()
         self._maybe_auto_resume()
+        if self.telemetry:
+            self.telemetry.record_event(
+                "prepare",
+                seconds=_time.perf_counter() - _prepare_t0,
+                n_objects=len(args),
+                n_params=sum(
+                    m.num_parameters() for m in self._models[_models_before:]
+                ),
+            )
         return result[0] if len(result) == 1 else tuple(result)
 
     def _maybe_auto_resume(self):
@@ -819,6 +868,8 @@ class Accelerator:
         wrapped = AcceleratedOptimizer(optimizer, scaler=self._loss_scale)
         if self._grad_comm_hook is not None:
             wrapped.comm_hook = (self._grad_comm_hook, self.mesh)
+        if self.telemetry:
+            wrapped.telemetry = self.telemetry
         self._optimizers.append(wrapped)
         return wrapped
 
@@ -877,6 +928,12 @@ class Accelerator:
                 "model outputs (e.g. model(**batch).loss)."
             )
         self._training_started = True  # freezes auto-resume (see _maybe_auto_resume)
+        if self.telemetry:
+            self._backward_instrumented(loss)
+            return
+        self._backward_core(loss)
+
+    def _backward_core(self, loss):
         opt = self._fusable_optimizer(loss)
         if opt is not None:
             if opt._pending_loss is not None:
@@ -888,6 +945,25 @@ class Accelerator:
                 object.__setattr__(loss, "_pre_force_hook", lambda: self._flush_pending(opt))
                 return
         self._backward_split(loss)
+
+    def _backward_instrumented(self, loss):
+        """Telemetry-enabled backward: feed the step's batch geometry (from
+        the deferred graph's input leaves) and the host time spent here to
+        the recorder; the matching ``record_step`` fires in
+        ``AcceleratedOptimizer.step``."""
+        import time as _time
+
+        from .lazy import linearize
+        from .telemetry import batch_geometry
+
+        t0 = _time.perf_counter()
+        try:
+            _, inputs, _ = linearize(loss._node)
+            self.telemetry.note_batch(*batch_geometry(inputs))
+        except Exception:
+            pass
+        self._backward_core(loss)
+        self.telemetry.note_backward(_time.perf_counter() - t0)
 
     def _fusable_optimizer(self, loss):
         """The single optimizer eligible for the fused step, or None."""
@@ -1180,7 +1256,7 @@ class Accelerator:
         if trace_dir is None:
             yield None
             return
-        ctx = ProfileContext(handler, trace_dir)
+        ctx = ProfileContext(handler, trace_dir, telemetry=self.telemetry)
         try:
             ctx._maybe_start()
             yield ctx
@@ -1272,9 +1348,15 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.log(values, step=step, **(log_kwargs or {}).get(tracker.name, {}))
 
+    def _telemetry_tracker_sink(self, values: dict, step: int | None):
+        """Telemetry → tracker fan-out (the recorder gates this to the main
+        process, matching ``tracking.on_main_process``)."""
+        self.log(values, step=step)
+
     def end_training(self):
         for tracker in self.trackers:
             tracker.finish()
+        self.telemetry.close()
         self.wait_for_everyone()
 
     # ------------------------------------------------------------------

@@ -14,6 +14,8 @@ def env_command(args) -> int:
 
     import accelerate_tpu
 
+    from accelerate_tpu.utils.environment import parse_flag_from_env
+
     info = {
         "`accelerate_tpu` version": accelerate_tpu.__version__,
         "Platform": platform.platform(),
@@ -23,6 +25,11 @@ def env_command(args) -> int:
         "Device count": jax.device_count(),
         "Device kind": jax.devices()[0].device_kind if jax.devices() else "none",
         "Process count": jax.process_count(),
+        "Telemetry": (
+            "active (ACCELERATE_TELEMETRY=1)"
+            if parse_flag_from_env("ACCELERATE_TELEMETRY")
+            else "inactive (set ACCELERATE_TELEMETRY=1 or Accelerator(telemetry=True))"
+        ),
     }
     try:
         import flax

@@ -19,6 +19,8 @@ not a correctness one.)
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -215,11 +217,22 @@ def generate(
     (the reference gets this from transformers' seq2seq ``generate``);
     the returned ids are the DECODER sequence including the start token.
     """
+    from .telemetry import get_active_recorder
+
+    tel = get_active_recorder()
+    _t0 = time.perf_counter()
     if _is_encoder_decoder(model):
-        return _generate_seq2seq(
+        out = _generate_seq2seq(
             model, input_ids, max_new_tokens, do_sample, temperature,
             eos_token_id, seed, attention_mask,
         )
+        if tel:
+            tel.record_generation(
+                mode="seq2seq",
+                new_tokens=int(out.shape[0]) * (int(out.shape[1]) - 1),
+                seconds=time.perf_counter() - _t0,
+            )
+        return out
     if draft_model is not None:
         if do_sample:
             raise NotImplementedError(
@@ -242,10 +255,18 @@ def generate(
     if use_cache:
         backend = _cache_backend(model)
         if backend is not None:
-            return _generate_cached(
+            out = _generate_cached(
                 backend, input_ids, max_new_tokens, do_sample, temperature,
                 eos_token_id, seed, attention_mask,
             )
+            if tel:
+                prompt_len = np.atleast_2d(np.asarray(input_ids)).shape[1]
+                tel.record_generation(
+                    mode="kv_cache",
+                    new_tokens=int(out.shape[0]) * max(int(out.shape[1]) - prompt_len, 0),
+                    seconds=time.perf_counter() - _t0,
+                )
+            return out
     ids = np.asarray(input_ids)
     if ids.ndim == 1:
         ids = ids[None, :]
@@ -277,7 +298,14 @@ def generate(
         lengths += 1
         if eos_token_id is not None and finished.all():
             break
-    return buf[:, : int(lengths.max())]
+    out = buf[:, : int(lengths.max())]
+    if tel:
+        tel.record_generation(
+            mode="full_forward",
+            new_tokens=int(b) * max(int(out.shape[1]) - prompt_len, 0),
+            seconds=time.perf_counter() - _t0,
+        )
+    return out
 
 
 def _is_encoder_decoder(model) -> bool:
@@ -475,11 +503,11 @@ def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool)
         cache_limit = jnp.int32(cache_len - k - 2)
 
         def round_done(state):
-            _, _, _, _, emitted, _, _, finished = state
+            _, _, _, _, emitted, _, _, finished, _ = state
             return ~(finished | (emitted >= max_new)).all()
 
         def round_body(state):
-            kv_t, kv_d, buf, lengths, emitted, pending, pos, finished = state
+            kv_t, kv_d, buf, lengths, emitted, pending, pos, finished, rounds = state
 
             # draft k tokens greedily from the pending one
             def dstep(c, _):
@@ -542,15 +570,16 @@ def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool)
             # inside the cache margin so their (ignored) chunks never clip
             done = finished | (emitted >= max_new)
             pos = jnp.where(done, jnp.minimum(pos, cache_limit), pos)
-            return kv_t, kv_d, buf, lengths, emitted, pending, pos, finished
+            return kv_t, kv_d, buf, lengths, emitted, pending, pos, finished, rounds + 1
 
-        state = (kv_t, kv_d, buf, lengths, emitted, pending, pos, finished)
+        state = (kv_t, kv_d, buf, lengths, emitted, pending, pos, finished, jnp.int32(0))
         state = jax.lax.while_loop(round_done, round_body, state)
-        kv_t, kv_d, buf, lengths, emitted, _, _, _ = state
+        kv_t, kv_d, buf, lengths, emitted, _, _, _, rounds = state
         # the caches ride back in the outputs ONLY so the donation can
         # alias them (unreturned donated buffers force a transient second
-        # copy of both caches and a per-compile warning); callers drop them
-        return buf, lengths, emitted, kv_t, kv_d
+        # copy of both caches and a per-compile warning); callers drop them.
+        # ``rounds`` (verify-forward count) feeds the telemetry accept-rate.
+        return buf, lengths, emitted, rounds, kv_t, kv_d
 
     runner = jax.jit(spec_loop, donate_argnums=(2, 3, 4))
     scan_cache[key_] = runner
@@ -574,6 +603,7 @@ def _generate_speculative(
     position past each row's own index, so rejected draft entries are
     simply never attended and are overwritten by later appends.
     """
+    _t_start = time.perf_counter()
     apply_t, params_t = target
     apply_d, params_d = draft
     ids = np.asarray(input_ids)
@@ -626,7 +656,7 @@ def _generate_speculative(
         if has_eos and pending[row] == eos_token_id:
             finished[row] = True
 
-    buf_dev, lengths_dev, emitted_dev, _, _ = spec_loop(
+    buf_dev, lengths_dev, emitted_dev, rounds_dev, _, _ = spec_loop(
         params_t, params_d, out_t["kv_cache"], out_d["kv_cache"],
         jnp.asarray(buf), jnp.asarray(lengths, jnp.int32),
         jnp.asarray(emitted), jnp.asarray(pending),
@@ -636,6 +666,23 @@ def _generate_speculative(
     buf = np.array(jax.device_get(buf_dev))  # copy: device_get views are read-only
     lengths = np.asarray(jax.device_get(lengths_dev)).astype(np.int64)
     emitted = np.array(jax.device_get(emitted_dev))
+
+    from .telemetry import get_active_recorder
+
+    tel = get_active_recorder()
+    if tel:
+        rounds = int(np.asarray(jax.device_get(rounds_dev)))
+        loop_tokens = int(emitted.sum()) - b  # first token was host-emitted
+        tel.record_generation(
+            mode="speculative",
+            new_tokens=int(emitted.sum()),
+            seconds=time.perf_counter() - _t_start,
+            # aggregate acceptance: fraction of the k+1 tokens each verify
+            # round could emit that were actually emitted (rows that finish
+            # early drag it down — it is a fleet-level utilisation number)
+            accept_rate=(loop_tokens / (rounds * b * (k + 1))) if rounds else None,
+            verify_rounds=rounds,
+        )
 
     # eos-finished rows pad with eos to the step the LAST row stopped at —
     # the same column the all-finished break of the plain loops produces

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import operator
+import time
 from typing import Any, Callable, Iterable
 
 import jax
@@ -438,10 +439,17 @@ _GRAD_CACHE: dict = {}
 #: the source of truth)
 PROFILE_COST_STATS: list = []
 _COLLECT_COSTS = False
-#: (label, signature) → AOT-compiled executable, so each signature compiles
-#: ONCE per collection session (the executable both serves the call and
-#: answers cost_analysis); cleared when a session starts
-_COST_COMPILED: dict = {}
+#: (label, signature) → (AOT-compiled executable, cost facts), so each
+#: signature compiles ONCE (the executable both serves the calls and
+#: answers cost_analysis); a (None, None) entry marks a backend where AOT
+#: lowering is unavailable, so the plain jit path serves without re-probing
+_AOT_CACHE: dict = {}
+#: signatures already appended to PROFILE_COST_STATS this collection session
+_COST_SEEN: set = set()
+
+#: telemetry compile-miss hook: called with a cost-facts dict every time a
+#: signature compiles while instrumentation is active (see telemetry.py)
+_COMPILE_CALLBACK = None
 
 
 def set_cost_collection(enabled: bool) -> None:
@@ -449,43 +457,117 @@ def set_cost_collection(enabled: bool) -> None:
     _COLLECT_COSTS = bool(enabled)
     if enabled:
         PROFILE_COST_STATS.clear()
-        _COST_COMPILED.clear()
+        _COST_SEEN.clear()
+
+
+def set_compile_callback(callback) -> None:
+    """Register the compile-event observer (one per process; the telemetry
+    recorder owns it). None unregisters."""
+    global _COMPILE_CALLBACK
+    _COMPILE_CALLBACK = callback
+
+
+def get_compile_callback():
+    return _COMPILE_CALLBACK
+
+
+def _compile_facts(jitted, args, label: str) -> tuple:
+    """AOT-compile one signature, timing trace+lower and compile separately
+    and extracting the program's static cost facts: XLA-cost-model FLOPs /
+    bytes accessed, and collective bytes parsed from the compiled HLO."""
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    try:
+        stats = compiled.cost_analysis() or {}
+    except Exception:
+        stats = {}
+    if isinstance(stats, (list, tuple)):  # older jax: one dict per device
+        stats = stats[0] if stats else {}
+    facts = {
+        "label": label,
+        "lower_s": t1 - t0,
+        "compile_s": t2 - t1,
+        "flops": stats.get("flops"),
+        "bytes_accessed": stats.get("bytes accessed"),
+        "collective_bytes": None,
+    }
+    try:
+        from .utils.hlo import total_collective_bytes
+
+        facts["collective_bytes"] = total_collective_bytes(compiled.as_text())
+    except Exception:
+        pass
+    return compiled, facts
 
 
 def _cost_aware_jit(fn, donate_argnums=(), label=""):
-    """``jax.jit`` that, while cost collection is on, records the compiled
-    program's XLA cost analysis (flops, bytes accessed) once per signature
-    per session. The AOT executable is kept and serves the calls, so
-    collection never compiles a program twice. Zero overhead when
-    collection is off."""
+    """``jax.jit`` that, while instrumentation is active (a profile session
+    with ``with_flops``, or a telemetry recorder's compile callback),
+    AOT-compiles each new signature explicitly — timing trace+lower+compile
+    and recording the program's cost analysis once. The executable is kept
+    and serves the calls, so instrumentation never compiles a program
+    twice. Zero overhead when both are off (one global read per call)."""
     jitted = jax.jit(fn, donate_argnums=donate_argnums)
 
     def call(*args):
-        if _COLLECT_COSTS:
-            # every leaf participates: truncating the signature would hand
-            # a cached executable mismatched avals if two calls differ only
-            # in later-leaf shapes (shape/dtype tuples are cheap to hash)
-            sig = (label, id(fn)) + tuple(
-                (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
-                for l in jax.tree.leaves(args)
+        callback = _COMPILE_CALLBACK
+        if not (_COLLECT_COSTS or callback is not None):
+            return jitted(*args)
+        # every leaf participates: truncating the signature would hand
+        # a cached executable mismatched avals if two calls differ only
+        # in later-leaf shapes (shape/dtype tuples are cheap to hash).
+        # Shardings are part of the key for the same reason jit keys on
+        # them: step 1 compiles against the as-prepared placement, the
+        # donated outputs come back with GSPMD's chosen shardings, and an
+        # executable replayed against re-sharded args raises instead of
+        # recompiling. ``fn`` itself (not id(fn)) keys the entry: the
+        # reference pins the closure alive, so a recycled id can never
+        # alias two programs.
+        sig = (label, fn) + tuple(
+            (
+                tuple(getattr(l, "shape", ())),
+                str(getattr(l, "dtype", "")),
+                getattr(l, "sharding", None),
             )
-            compiled = _COST_COMPILED.get(sig)
-            if compiled is None:
-                try:
-                    compiled = jitted.lower(*args).compile()
-                    stats = compiled.cost_analysis() or {}
-                    PROFILE_COST_STATS.append(
-                        {
-                            "label": label,
-                            "flops": stats.get("flops"),
-                            "bytes_accessed": stats.get("bytes accessed"),
-                        }
-                    )
-                    _COST_COMPILED[sig] = compiled
-                except Exception:  # cost model unavailable on this backend
-                    return jitted(*args)
-            return compiled(*args)
-        return jitted(*args)
+            for l in jax.tree.leaves(args)
+        )
+        entry = _AOT_CACHE.get(sig)
+        if entry is None:
+            try:
+                entry = _compile_facts(jitted, args, label)
+            except Exception:  # AOT path unavailable on this backend
+                entry = (None, None)
+            _AOT_CACHE[sig] = entry
+            if entry[1] is not None and callback is not None:
+                # the human-readable shape key: label + the leaf signature
+                # (the part of the cache key a batch-shape change perturbs).
+                # A big step's args include every param/opt-state leaf, so
+                # cap the readable part and pin identity with a digest —
+                # distinct shapes must stay distinct without writing a
+                # multi-KB key into every compile record.
+                key = f"{label}:{sig[2:]}"
+                if len(key) > 512:
+                    import hashlib
+
+                    digest = hashlib.sha1(key.encode()).hexdigest()[:16]
+                    key = f"{key[:480]}...#{digest}"
+                callback(dict(entry[1], static_key=key))
+        compiled, facts = entry
+        if compiled is None:
+            return jitted(*args)
+        if _COLLECT_COSTS and sig not in _COST_SEEN:
+            _COST_SEEN.add(sig)
+            PROFILE_COST_STATS.append(
+                {
+                    "label": facts["label"],
+                    "flops": facts["flops"],
+                    "bytes_accessed": facts["bytes_accessed"],
+                }
+            )
+        return compiled(*args)
 
     return call
 
@@ -494,6 +576,8 @@ def clear_caches():
     _FORCE_CACHE.clear()
     _GRAD_CACHE.clear()
     _FUSED_CACHE.clear()
+    _AOT_CACHE.clear()
+    _COST_SEEN.clear()
 
 
 def force_value(deferred: Deferred):
@@ -604,8 +688,10 @@ def ddp_compressed_vag(loss_fn, mesh, input_values, hook: str):
     input_specs = [_spec_for(x) for x in input_values]
 
     def vag(params, frozen_params, inputs, *rest):
+        from .utils.compat import shard_map
+
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(P(), P(), input_specs) + (P(),) * len(rest),
             out_specs=((P(), P()), P()),

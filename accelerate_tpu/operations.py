@@ -32,6 +32,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .utils.compat import axis_size
+
 P = PartitionSpec
 
 
@@ -390,7 +392,7 @@ class jops:
     @staticmethod
     def ring_shift(x, axis_name: str, shift: int = 1):
         """Rotate shards around the ring (KV rotation for ring attention)."""
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(x, axis_name, perm)
 

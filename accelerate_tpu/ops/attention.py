@@ -24,7 +24,7 @@ from typing import Literal
 import jax
 from jax.sharding import PartitionSpec as P
 
-shard_map = jax.shard_map
+from ..utils.compat import shard_map
 
 from .flash_attention import blockwise_attention, flash_attention
 from .layers import causal_attention

@@ -138,6 +138,7 @@ class AcceleratedOptimizer:
         self._last_norm = None
         self._step_ok_device = None  # fp16: lazily-fetched finite flag
         self.comm_hook = None  # (hook_str, mesh): compressed dp grad reduction
+        self.telemetry = None  # TelemetryRecorder, wired by prepare_optimizer
 
     # -- initialisation (called by Accelerator.prepare) ----------------------
 
@@ -260,6 +261,38 @@ class AcceleratedOptimizer:
         self._step_was_skipped = False  # overridden lazily via step_was_skipped
 
     def step(self, closure=None):
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return self._step_inner(closure)
+        import time
+
+        t0 = time.perf_counter()
+        self._step_inner(closure)
+        t1 = time.perf_counter()
+        device_s = None
+        if tel.sync_device and self.model is not None and self.gradient_state.sync_gradients:
+            # realise the dispatched update: splits the step's wall time
+            # into host dispatch vs device-blocked (costs the host-runahead
+            # pipelining; the recorder's sync_device=False keeps full async)
+            try:
+                jax.block_until_ready(self.model.params)
+                device_s = time.perf_counter() - t1
+            except Exception:
+                device_s = None
+        # fused fp16 keeps the finite flag on device; only fetch it when the
+        # sync above already realised the step (no extra host round trip) —
+        # otherwise report unknown rather than fabricate False
+        skipped = self._step_was_skipped
+        if self._step_ok_device is not None:
+            skipped = self.step_was_skipped if tel.sync_device else None
+        tel.record_step(
+            dispatch_s=t1 - t0,
+            device_s=device_s,
+            sync_gradients=self.gradient_state.sync_gradients,
+            skipped=skipped,
+        )
+
+    def _step_inner(self, closure=None):
         if not self.gradient_state.sync_gradients:
             self._step_was_skipped = False
             self._step_ok_device = None

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flash_attention import NEG_INF, blockwise_attention, flash_attention
 
-shard_map = jax.shard_map
+from ..utils.compat import axis_size, shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -106,7 +106,7 @@ def ring_attention_local(
             q, k, v, kv_valid, axis_name=axis_name, causal=causal, scale=scale,
             cp_index=cp_index,
         )
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = (
         cp_index.reshape(()).astype(jnp.int32)
         if cp_index is not None
@@ -171,7 +171,7 @@ def allgather_attention_local(
 ):
     """Baseline: gather all KV chunks, run dense attention on the local Q
     chunk with the right global offset."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = (
         cp_index.reshape(()).astype(jnp.int32)
         if cp_index is not None

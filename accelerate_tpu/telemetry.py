@@ -1,0 +1,536 @@
+"""Step-level telemetry: compile/recompile events, device memory, throughput.
+
+The reference treats observability as host-side experiment tracking only
+(``tracking.py``'s ``GeneralTracker`` zoo). On a JAX/TPU backend the signals
+that explain performance — recompiles, HBM high-water marks, dispatch vs
+device time, ICI collective bytes — live in XLA and are invisible to a
+tracker that only sees what the user logs. This module is the unifying
+consumer of the raw ingredients the codebase already had: the compile cache
+in :mod:`accelerate_tpu.lazy` (hooked via :func:`lazy.set_compile_callback`),
+the HLO collective-bytes parser in :mod:`accelerate_tpu.utils.hlo`, and the
+``jax.profiler`` plumbing around ``ProfileContext``.
+
+Three sinks, one record stream:
+
+* a **ring buffer** with p50/p95/max summaries — ``accelerator.telemetry.summary()``
+* a **JSONL trail** under ``{logging_dir}/telemetry/telemetry.jsonl`` —
+  crash-safe append (one ``write``+``flush`` per record), main-process only
+* **tracker fan-out** through ``Accelerator.log()`` into whatever trackers
+  are initialized, gated on the main process exactly like
+  ``tracking.on_main_process``
+
+Enable with ``Accelerator(telemetry=True)`` or ``ACCELERATE_TELEMETRY=1``.
+Disabled, every instrumentation point holds a :data:`NULL_TELEMETRY`
+singleton whose methods are no-ops — the hot path pays one attribute read.
+
+Record schema (every record carries ``type`` and ``ts``):
+
+``step``     — ``step``, ``optimizer_steps``, ``step_time_s``,
+               ``dispatch_s``, ``device_s``, ``examples``, ``tokens``,
+               ``examples_per_sec``, ``tokens_per_sec``, ``sync_gradients``,
+               ``accum_phase``, ``skipped``, ``recompiles`` and (when a step
+               program's FLOPs are known and the chip's peak is in the
+               table) ``mfu``.
+``compile``  — ``label``, ``static_key``, ``lower_s``, ``compile_s``,
+               ``total_s``, ``flops``, ``bytes_accessed``,
+               ``collective_bytes``, ``recompiles`` (cumulative).
+``memory``   — ``device_bytes_in_use``, ``device_peak_bytes``,
+               ``host_rss_bytes`` (sampled every ``memory_interval`` steps).
+``generate`` — ``mode``, ``new_tokens``, ``seconds``, ``tokens_per_sec``
+               and, for speculative decoding, ``accept_rate`` /
+               ``verify_rounds``.
+``profile``  — ``trace_dir``, ``steps``, ``active_steps`` (one record per
+               finished ``accelerator.profile()`` session).
+``event``    — free-form (``kind`` + fields), e.g. the ``prepare`` timing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+#: Peak dense bf16 FLOPs/s per chip by device kind (public spec sheets;
+#: same table the bench harness uses). Override per-run with
+#: ``TelemetryRecorder(peak_flops=...)`` or ``ACCELERATE_TELEMETRY_PEAK_FLOPS``.
+PEAK_FLOPS_TABLE: tuple[tuple[str, float], ...] = (
+    ("v6e", 918e12),
+    ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+#: compile labels that constitute "the train step" — their cost facts feed
+#: the MFU estimate and the recompile counter the summary reports
+_STEP_LABELS = ("fused_step", "grad", "forward", "opt_apply")
+
+
+def _percentiles(values) -> dict[str, float]:
+    arr = np.asarray(list(values), dtype=np.float64)
+    return {
+        "p50": float(np.percentile(arr, 50)),
+        "p95": float(np.percentile(arr, 95)),
+        "max": float(arr.max()),
+    }
+
+
+def _is_main_process() -> bool:
+    """Same gate as ``tracking.on_main_process`` (a fresh ``PartialState``
+    is the Borg view of process identity)."""
+    try:
+        from .state import PartialState
+
+        return bool(PartialState().is_main_process)
+    except Exception:
+        return True
+
+
+def _host_rss_bytes() -> int | None:
+    try:
+        import resource
+
+        # linux reports ru_maxrss in KiB
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+class _NullTelemetry:
+    """The disabled-mode recorder: every method is a no-op, ``bool()`` is
+    False, and ``summary()`` is empty. Instrumentation points hold this
+    singleton so the enabled check is one truthiness test."""
+
+    enabled = False
+    sync_device = False
+
+    def __bool__(self):
+        return False
+
+    def note_batch(self, *a, **k):
+        pass
+
+    def note_backward(self, *a, **k):
+        pass
+
+    def record_step(self, *a, **k):
+        pass
+
+    def record_generation(self, *a, **k):
+        pass
+
+    def record_profile(self, *a, **k):
+        pass
+
+    def record_event(self, *a, **k):
+        pass
+
+    def record_memory(self, *a, **k):
+        pass
+
+    def summary(self):
+        return {}
+
+    def close(self):
+        pass
+
+
+NULL_TELEMETRY = _NullTelemetry()
+
+#: process-wide active recorder, so free functions (the generation decode
+#: loops) can report without threading an accelerator through their args
+_ACTIVE: _NullTelemetry | "TelemetryRecorder" = NULL_TELEMETRY
+
+
+def get_active_recorder():
+    return _ACTIVE
+
+
+def set_active_recorder(recorder) -> None:
+    global _ACTIVE
+    _ACTIVE = recorder if recorder is not None else NULL_TELEMETRY
+
+
+class TelemetryRecorder:
+    """Collects step/compile/memory/generation records and serves them to
+    the three sinks. Construction registers the compile-miss callback on
+    :mod:`accelerate_tpu.lazy`'s compile cache; ``close()`` (or a later
+    recorder) unregisters it.
+
+    Args:
+        logging_dir: root under which ``telemetry/telemetry.jsonl`` is
+            appended (no file sink when None).
+        tracker_sink: ``callable(values_dict, step)`` — normally the
+            owning ``Accelerator.log`` — invoked on the main process only.
+        ring_size: per-kind ring buffer capacity backing ``summary()``.
+        memory_interval: sample ``device.memory_stats()`` + host RSS every
+            N step records (0 disables sampling).
+        peak_flops: chip peak FLOPs/s for the MFU estimate; default looks
+            up the attached device kind in :data:`PEAK_FLOPS_TABLE`
+            (``ACCELERATE_TELEMETRY_PEAK_FLOPS`` overrides). Unknown kinds
+            (CPU hosts) leave ``mfu`` unset — see the telemetry guide for
+            why a CPU MFU would be meaningless.
+        sync_device: block on the updated params after each optimizer step
+            to split wall time into dispatch vs device-blocked. Costs the
+            host-runahead pipelining; set False (or
+            ``ACCELERATE_TELEMETRY_NO_SYNC=1``) to keep fully-async
+            stepping and record dispatch time only.
+    """
+
+    def __init__(
+        self,
+        logging_dir: str | None = None,
+        tracker_sink: Callable[[dict, int | None], Any] | None = None,
+        ring_size: int = 1024,
+        memory_interval: int = 10,
+        peak_flops: float | None = None,
+        sync_device: bool | None = None,
+    ):
+        self.enabled = True
+        self._tracker_sink = tracker_sink
+        self._ring_size = int(ring_size)
+        self.memory_interval = int(memory_interval)
+        if sync_device is None:
+            from .utils.environment import parse_flag_from_env
+
+            sync_device = not parse_flag_from_env("ACCELERATE_TELEMETRY_NO_SYNC")
+        self.sync_device = bool(sync_device)
+
+        env_peak = os.environ.get("ACCELERATE_TELEMETRY_PEAK_FLOPS")
+        if peak_flops is None and env_peak:
+            peak_flops = float(env_peak)
+        self._peak_flops = peak_flops  # None → resolve lazily from the device
+
+        # ring buffers (per kind, so step percentiles aren't diluted)
+        self.records: deque = deque(maxlen=self._ring_size)
+        self._step_times: deque = deque(maxlen=self._ring_size)
+        self._dispatch_times: deque = deque(maxlen=self._ring_size)
+        self._device_times: deque = deque(maxlen=self._ring_size)
+        self._examples_rates: deque = deque(maxlen=self._ring_size)
+        self._tokens_rates: deque = deque(maxlen=self._ring_size)
+
+        # counters
+        self.step_count = 0
+        self.optimizer_step_count = 0
+        self.recompile_count = 0
+        self.compile_seconds_total = 0.0
+        self._static_keys: set = set()
+        self._step_flops: float | None = None  # last step-program cost fact
+        self._step_collective_bytes: int | None = None
+
+        # per-step scratch fed by backward()/note_batch
+        self._pending_examples: int | None = None
+        self._pending_tokens: int | None = None
+        self._pending_backward_s: float = 0.0
+        self._last_step_end: float | None = None
+
+        # JSONL sink (main process only; crash-safe append)
+        self._jsonl = None
+        self._jsonl_path = None
+        if logging_dir is not None and _is_main_process():
+            tel_dir = os.path.join(logging_dir, "telemetry")
+            os.makedirs(tel_dir, exist_ok=True)
+            self._jsonl_path = os.path.join(tel_dir, "telemetry.jsonl")
+            self._jsonl = open(self._jsonl_path, "a")
+
+        from .lazy import set_compile_callback
+
+        set_compile_callback(self._on_compile)
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _emit(self, record: dict, fan_out: bool = True, step: int | None = None):
+        record.setdefault("ts", time.time())
+        self.records.append(record)
+        if self._jsonl is not None:
+            try:
+                self._jsonl.write(json.dumps(record, default=_json_default) + "\n")
+                self._jsonl.flush()
+            except ValueError:  # closed file (end_training raced a record)
+                pass
+        if fan_out and self._tracker_sink is not None and _is_main_process():
+            values = {
+                f"telemetry/{k}": v
+                for k, v in record.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool) and k != "ts"
+            }
+            if values:
+                try:
+                    self._tracker_sink(values, step)
+                except Exception:  # tracker failures must not kill training
+                    logger.warning("telemetry tracker fan-out failed", exc_info=True)
+
+    # -- compile events (lazy.py miss callback) ------------------------------
+
+    def _on_compile(self, facts: dict):
+        self.recompile_count += 1
+        self._static_keys.add(facts.get("static_key"))
+        total_s = float(facts.get("lower_s") or 0.0) + float(facts.get("compile_s") or 0.0)
+        self.compile_seconds_total += total_s
+        if facts.get("label") in _STEP_LABELS and facts.get("flops"):
+            self._step_flops = float(facts["flops"])
+            self._step_collective_bytes = facts.get("collective_bytes")
+        self._emit(
+            {
+                "type": "compile",
+                "label": facts.get("label"),
+                "static_key": facts.get("static_key"),
+                "lower_s": facts.get("lower_s"),
+                "compile_s": facts.get("compile_s"),
+                "total_s": total_s,
+                "flops": facts.get("flops"),
+                "bytes_accessed": facts.get("bytes_accessed"),
+                "collective_bytes": facts.get("collective_bytes"),
+                "recompiles": self.recompile_count,
+            },
+            step=self.optimizer_step_count,
+        )
+
+    # -- per-step plumbing ---------------------------------------------------
+
+    def note_batch(self, examples: int | None, tokens: int | None):
+        """Batch geometry of the loss about to be stepped (fed by
+        ``Accelerator.backward`` from the deferred graph's inputs)."""
+        self._pending_examples = examples
+        self._pending_tokens = tokens
+
+    def note_backward(self, seconds: float):
+        """Host time spent inside ``backward()`` (graph bookkeeping on the
+        fused path; grad dispatch on the split path) — folded into the next
+        step record's ``dispatch_s``."""
+        self._pending_backward_s += float(seconds)
+
+    def record_step(
+        self,
+        dispatch_s: float,
+        device_s: float | None = None,
+        sync_gradients: bool = True,
+        skipped: bool | None = False,  # None = unknown (fp16 flag on device)
+    ):
+        now = time.perf_counter()
+        self.step_count += 1
+        if sync_gradients and not skipped:
+            self.optimizer_step_count += 1
+        dispatch_s = float(dispatch_s) + self._pending_backward_s
+        self._pending_backward_s = 0.0
+        # true loop cadence when available (includes the user's host work);
+        # first step falls back to the instrumented spans
+        if self._last_step_end is not None:
+            step_time_s = now - self._last_step_end
+        else:
+            step_time_s = dispatch_s + (device_s or 0.0)
+        self._last_step_end = now
+
+        examples, tokens = self._pending_examples, self._pending_tokens
+        self._pending_examples = self._pending_tokens = None
+
+        record = {
+            "type": "step",
+            "step": self.step_count,
+            "optimizer_steps": self.optimizer_step_count,
+            "step_time_s": step_time_s,
+            "dispatch_s": dispatch_s,
+            "device_s": device_s,
+            "sync_gradients": bool(sync_gradients),
+            "accum_phase": "sync" if sync_gradients else "accumulate",
+            "skipped": None if skipped is None else bool(skipped),
+            "recompiles": self.recompile_count,
+        }
+        self._step_times.append(step_time_s)
+        self._dispatch_times.append(dispatch_s)
+        if device_s is not None:
+            self._device_times.append(device_s)
+        if examples and step_time_s > 0:
+            record["examples"] = examples
+            record["examples_per_sec"] = examples / step_time_s
+            self._examples_rates.append(record["examples_per_sec"])
+        if tokens and step_time_s > 0:
+            record["tokens"] = tokens
+            record["tokens_per_sec"] = tokens / step_time_s
+            self._tokens_rates.append(record["tokens_per_sec"])
+        mfu = self._mfu(step_time_s)
+        if mfu is not None:
+            record["mfu"] = mfu
+        self._emit(record, step=self.optimizer_step_count)
+
+        if self.memory_interval and self.step_count % self.memory_interval == 0:
+            self.record_memory()
+
+    def _resolve_peak_flops(self) -> float | None:
+        if self._peak_flops is not None:
+            return self._peak_flops
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:
+            return None
+        for key, peak in PEAK_FLOPS_TABLE:
+            if key in kind:
+                self._peak_flops = peak
+                return peak
+        return None  # unknown chip (or a CPU host): no credible MFU
+
+    def _mfu(self, step_time_s: float) -> float | None:
+        peak = self._resolve_peak_flops()
+        if peak is None or not self._step_flops or step_time_s <= 0:
+            return None
+        try:
+            import jax
+
+            n_dev = jax.device_count()
+        except Exception:
+            n_dev = 1
+        # cost_analysis reports the whole (sharded) program's FLOPs; peak is
+        # per chip, so normalise by the device count the program spans
+        return float(self._step_flops) / step_time_s / (peak * n_dev)
+
+    # -- interval / event records -------------------------------------------
+
+    def record_memory(self):
+        device_in_use = device_peak = None
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            device_in_use = stats.get("bytes_in_use")
+            device_peak = stats.get("peak_bytes_in_use")
+        except Exception:
+            pass
+        self._emit(
+            {
+                "type": "memory",
+                "step": self.step_count,
+                "device_bytes_in_use": device_in_use,
+                "device_peak_bytes": device_peak,
+                "host_rss_bytes": _host_rss_bytes(),
+            },
+            step=self.optimizer_step_count,
+        )
+
+    def record_generation(
+        self,
+        mode: str,
+        new_tokens: int,
+        seconds: float,
+        accept_rate: float | None = None,
+        verify_rounds: int | None = None,
+    ):
+        record = {
+            "type": "generate",
+            "mode": mode,
+            "new_tokens": int(new_tokens),
+            "seconds": float(seconds),
+            "tokens_per_sec": (new_tokens / seconds) if seconds > 0 else None,
+        }
+        if accept_rate is not None:
+            record["accept_rate"] = float(accept_rate)
+        if verify_rounds is not None:
+            record["verify_rounds"] = int(verify_rounds)
+        self._emit(record, step=self.optimizer_step_count)
+
+    def record_profile(self, trace_dir: str, steps: int, active_steps: int = 0):
+        self._emit(
+            {
+                "type": "profile",
+                "trace_dir": trace_dir,
+                "steps": int(steps),
+                "active_steps": int(active_steps),
+            },
+            step=self.optimizer_step_count,
+        )
+
+    def record_event(self, kind: str, **fields):
+        self._emit({"type": "event", "kind": kind, **fields}, step=self.optimizer_step_count)
+
+    # -- queries -------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Aggregate view over the ring buffer: step-time percentiles,
+        median throughput, cumulative recompile/compile accounting, and the
+        latest memory sample."""
+        out: dict = {
+            "steps": self.step_count,
+            "optimizer_steps": self.optimizer_step_count,
+            "recompiles": self.recompile_count,
+            "distinct_static_keys": len(self._static_keys),
+            "compile_seconds_total": self.compile_seconds_total,
+        }
+        if self._step_times:
+            out["step_time_s"] = _percentiles(self._step_times)
+            out["dispatch_s"] = _percentiles(self._dispatch_times)
+        if self._device_times:
+            out["device_s"] = _percentiles(self._device_times)
+        if self._examples_rates:
+            out["examples_per_sec"] = float(np.median(list(self._examples_rates)))
+        if self._tokens_rates:
+            out["tokens_per_sec"] = float(np.median(list(self._tokens_rates)))
+        if self._step_flops:
+            out["step_flops"] = self._step_flops
+            if self._step_collective_bytes is not None:
+                out["step_collective_bytes"] = self._step_collective_bytes
+        for record in reversed(self.records):
+            if record.get("type") == "memory":
+                out["memory"] = {
+                    k: record[k]
+                    for k in ("device_bytes_in_use", "device_peak_bytes", "host_rss_bytes")
+                }
+                break
+        return out
+
+    @property
+    def jsonl_path(self) -> str | None:
+        return self._jsonl_path
+
+    def close(self):
+        from .lazy import get_compile_callback, set_compile_callback
+
+        if get_compile_callback() is self._on_compile:
+            set_compile_callback(None)
+        if _ACTIVE is self:
+            set_active_recorder(None)
+        if self._jsonl is not None:
+            try:
+                self._jsonl.close()
+            except Exception:
+                pass
+            self._jsonl = None
+
+
+def _json_default(obj):
+    if hasattr(obj, "item"):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+def batch_geometry(input_values) -> tuple[int | None, int | None]:
+    """(examples, tokens) of a step's input leaves: examples from the first
+    array's leading dim; tokens from the first rank-2 integer array
+    (``input_ids``-shaped). Best-effort — None when nothing matches."""
+    examples = tokens = None
+    for leaf in input_values:
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            continue
+        if examples is None and len(shape) >= 1 and shape[0] > 0:
+            examples = int(shape[0])
+        dtype = str(getattr(leaf, "dtype", ""))
+        if tokens is None and len(shape) == 2 and ("int" in dtype):
+            tokens = int(shape[0]) * int(shape[1])
+        if examples is not None and tokens is not None:
+            break
+    return examples, tokens

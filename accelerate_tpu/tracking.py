@@ -83,6 +83,46 @@ class GeneralTracker:
         pass
 
 
+class JSONLTracker(GeneralTracker):
+    """Dependency-free built-in (``log_with="jsonl"``): one JSON object per
+    ``log()`` call appended to ``{logging_dir}/{run_name}/metrics.jsonl``,
+    flushed per record so a crash loses at most the in-flight line. The
+    same file format the telemetry subsystem writes — a run with both
+    enabled yields a complete, greppable trail with zero extra services."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.logging_dir, exist_ok=True)
+        self._file = open(os.path.join(self.logging_dir, "metrics.jsonl"), "a")
+
+    @property
+    def tracker(self):
+        return getattr(self, "_file", None)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self._file.write(json.dumps({"event": "init", "config": _jsonable(values)}) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        self._file.write(
+            json.dumps({"step": step, "ts": time.time(), **_jsonable(_flatten_scalars(values))})
+            + "\n"
+        )
+        self._file.flush()
+
+    @on_main_process
+    def finish(self):
+        self._file.close()
+
+
 class TensorBoardTracker(GeneralTracker):
     """(Reference ``tracking.py:165``.) Uses tensorboardX / tf summary if
     available, else falls back to JSONL scalars that TensorBoard's scalars
@@ -444,6 +484,7 @@ LOGGER_TYPE_TO_CLASS.update(
         "wandb": WandBTracker,
         "clearml": ClearMLTracker,
         "dvclive": DVCLiveTracker,
+        "jsonl": JSONLTracker,
     }
 )
 
@@ -455,6 +496,7 @@ _AVAILABILITY = {
     "aim": _imports.is_aim_available,
     "clearml": _imports.is_clearml_available,
     "dvclive": _imports.is_dvclive_available,
+    "jsonl": lambda: True,  # stdlib-only
 }
 
 

@@ -1,0 +1,54 @@
+"""Version-compatibility shims for the jax API surface this codebase uses.
+
+The framework targets current jax (top-level ``jax.shard_map`` with the
+``check_vma`` kwarg), but must keep working on the previous generation
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``) — CI images
+and user clusters lag the flagship TPU toolchain. Every use site imports
+:func:`shard_map` from here instead of touching ``jax.shard_map`` directly,
+so the fallback logic lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+else:
+
+    def set_mesh(mesh):
+        """``jax.set_mesh`` for older jax: a ``Mesh`` is itself the
+        activation context manager (the legacy resource-env path)."""
+        return mesh
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(axis_name) -> int:
+        """``jax.lax.axis_size`` for older jax: ``psum`` of a unit constant
+        folds to the concrete axis extent at trace time (the historical
+        idiom this helper replaces at call sites)."""
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None, **kwargs):
+        """``jax.shard_map`` signature adapter over the experimental API:
+        same semantics; ``check_vma`` was spelled ``check_rep``, and the
+        manual-axes selection ``axis_names`` was its complement ``auto``."""
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        return _experimental_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+            **kwargs,
+        )

@@ -142,7 +142,24 @@ class ProfileKwargs(KwargsHandler):
     """``jax.profiler`` configuration (reference: torch.profiler builder,
     ``dataclasses.py:406-513``). ``output_trace_dir`` receives TensorBoard /
     Perfetto traces; schedule fields mimic the reference's wait/warmup/active
-    stepping so user code ports unchanged."""
+    stepping so user code ports unchanged.
+
+    Example — trace steps 3-4 of every 5-step cycle, with per-program FLOPs
+    dumped to ``flops.json`` (and, when telemetry is on, a ``profile``
+    record appended to the JSONL trail when the session closes)::
+
+        kwargs = ProfileKwargs(
+            wait=1, warmup=2, active=2, repeat=1,
+            with_flops=True, output_trace_dir="/tmp/trace",
+        )
+        accelerator = Accelerator(kwargs_handlers=[kwargs])
+        with accelerator.profile() as prof:
+            for batch in dataloader:
+                accelerator.backward(model(**batch).loss)
+                optimizer.step()
+                optimizer.zero_grad()
+                prof.step()
+    """
 
     wait: int = 0
     warmup: int = 0

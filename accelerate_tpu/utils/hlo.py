@@ -1,10 +1,16 @@
 """Tiny HLO/StableHLO text introspection helpers.
 
-Used by the comm-hook wire-bytes proof (tests) and the bench's
-``dp_grad_compression_wire_bytes_ratio`` row: both need "how many bytes do
-the all-reduce ops in this module move, by dtype" — one parser so the
-regexes can't drift apart. No reference analog (torch exposes comm bytes
-via NCCL debug env; XLA exposes the program text).
+Used by the comm-hook wire-bytes proof (tests), the bench's
+``dp_grad_compression_wire_bytes_ratio`` row, and the telemetry
+recorder's per-compile collective accounting: all need "how many bytes do
+the collective ops in this module move, by dtype" — one parser so the
+regexes can't drift apart. Matched ops: ``all-reduce``, ``all-gather``,
+``reduce-scatter`` (the FSDP pair — a sharded step's traffic is mostly
+gather/scatter, not all-reduce). Bytes are the ops' RESULT-shape bytes: an
+ICI/DCN traffic proxy, not an exact wire model (a ring all-reduce moves
+~2x the buffer, an all-gather's result is the already-concatenated
+buffer). No reference analog (torch exposes comm bytes via NCCL debug
+env; XLA exposes the program text).
 """
 
 from __future__ import annotations
@@ -21,13 +27,21 @@ _DTYPE_BYTES = {
 #: ``"stablehlo.all_reduce"(%x) ... : (tensor<32x32xbf16>) -> ...`` —
 #: pre-optimization module: the wire dtype as TRACED (what TPU executes;
 #: XLA:CPU's backend pass may later promote bf16 collectives to f32)
-_STABLEHLO_ALLREDUCE = re.compile(
-    r"stablehlo\.all_reduce.*?\(tensor<([0-9x]*)x?(\w+)>\)\s*->", re.DOTALL
+_STABLEHLO_COLLECTIVE = re.compile(
+    r"stablehlo\.(all_reduce|all_gather|reduce_scatter)"
+    r".*?\(tensor<([0-9x]*)x?(\w+)>\)\s*->",
+    re.DOTALL,
 )
 
 #: ``%ar = (f32[], f32[32,32]) all-reduce(...)`` — compiled HLO form,
-#: including tuple-shaped combined all-reduces
-_HLO_ALLREDUCE = re.compile(r"=\s*\(?((?:\w+\[[0-9,]*\][^)=]*?,?\s*)+)\)?\s*all-reduce\(")
+#: including tuple-shaped combined collectives
+#: the optional ``-start`` suffix matches the async forms TPU's compiler
+#: emits (``all-reduce-start``/``all-gather-start``/...); without it the
+#: parser reads 0 bytes on exactly the platform that matters
+_HLO_COLLECTIVE = re.compile(
+    r"=\s*\(?((?:\w+\[[0-9,]*\][^)=]*?,?\s*)+)\)?\s*"
+    r"(all-reduce|all-gather|reduce-scatter)(-start)?\("
+)
 _HLO_SHAPE = re.compile(r"(\w+)\[([0-9,]*)\]")
 
 
@@ -39,20 +53,46 @@ def _numel(dims: str, sep: str) -> int:
     return n
 
 
+def stablehlo_collective_bytes(text: str) -> dict[str, dict[str, int]]:
+    """{op: {dtype: operand bytes}} over every StableHLO collective op."""
+    out: dict[str, dict[str, int]] = {}
+    for m in _STABLEHLO_COLLECTIVE.finditer(text):
+        op, dims, dtype = m.group(1), m.group(2), m.group(3)
+        per_op = out.setdefault(op.replace("_", "-"), {})
+        per_op[dtype] = per_op.get(dtype, 0) + _numel(dims, "x") * _DTYPE_BYTES.get(dtype, 4)
+    return out
+
+
+def hlo_collective_bytes(text: str) -> dict[str, dict[str, int]]:
+    """{op: {dtype: result bytes}} over every compiled-HLO collective op.
+    Sync tuple forms are combined collectives (every element is a result);
+    async ``-start`` forms return ``(operand-alias, result)`` — only the
+    result element counts, or TPU modules would double-report."""
+    out: dict[str, dict[str, int]] = {}
+    for m in _HLO_COLLECTIVE.finditer(text):
+        per_op = out.setdefault(m.group(2), {})
+        shapes = list(_HLO_SHAPE.finditer(m.group(1)))
+        if m.group(3) and len(shapes) > 1:  # -start: last element is the result
+            shapes = shapes[-1:]
+        for t in shapes:
+            dtype, dims = t.group(1), t.group(2)
+            per_op[dtype] = per_op.get(dtype, 0) + _numel(dims, ",") * _DTYPE_BYTES.get(dtype, 4)
+    return out
+
+
+def total_collective_bytes(text: str) -> int:
+    """Sum of all collective-op bytes in a compiled-HLO module (the single
+    number the telemetry compile record carries)."""
+    return sum(
+        b for per_op in hlo_collective_bytes(text).values() for b in per_op.values()
+    )
+
+
 def stablehlo_allreduce_bytes(text: str) -> dict[str, int]:
     """{dtype: operand bytes} over every ``stablehlo.all_reduce`` op."""
-    out: dict[str, int] = {}
-    for m in _STABLEHLO_ALLREDUCE.finditer(text):
-        dims, dtype = m.group(1), m.group(2)
-        out[dtype] = out.get(dtype, 0) + _numel(dims, "x") * _DTYPE_BYTES.get(dtype, 4)
-    return out
+    return stablehlo_collective_bytes(text).get("all-reduce", {})
 
 
 def hlo_allreduce_bytes(text: str) -> dict[str, int]:
     """{dtype: result bytes} over every compiled-HLO ``all-reduce`` op."""
-    out: dict[str, int] = {}
-    for m in _HLO_ALLREDUCE.finditer(text):
-        for t in _HLO_SHAPE.finditer(m.group(1)):
-            dtype, dims = t.group(1), t.group(2)
-            out[dtype] = out.get(dtype, 0) + _numel(dims, ",") * _DTYPE_BYTES.get(dtype, 4)
-    return out
+    return hlo_collective_bytes(text).get("all-reduce", {})

@@ -528,6 +528,46 @@ def _mode_decode(platform: str) -> None:
     print(f"BENCH_DECODE {decode_tok_s:.1f} {t_short:.4f} {t_long:.4f}")
 
 
+def _mode_telemetry(platform: str) -> None:
+    """Telemetry overhead row: the SAME toy train loop timed with telemetry
+    off and on. The instrumentation cost is host-side and per-step, so a
+    tiny model over many steps is the honest worst case — on a real model
+    the same absolute microseconds vanish into the device step. The ON
+    figure includes the per-step param sync the dispatch/device split
+    costs; OFF must stay within noise of the pre-telemetry loop (the no-op
+    recorder is one attribute read per step)."""
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils import RegressionModel
+
+    def timed_loop(telemetry: bool) -> float:
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        accelerator = Accelerator(telemetry=telemetry)
+        model, opt = accelerator.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+        x = np.linspace(-1, 1, 64).astype(np.float32)
+        batch = {"x": x, "y": (2 * x + 3).astype(np.float32)}
+
+        def step():
+            out = model(**batch)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            return out.loss.force()
+
+        n = 200
+        t = _timed_steps(step, n_warmup=10, n_steps=n) / n
+        accelerator.telemetry.close()
+        return t
+
+    t_off = timed_loop(False)
+    t_on = timed_loop(True)
+    print(f"BENCH_TELEMETRY {t_off:.8f} {t_on:.8f}")
+
+
 def _mode_commhook(platform: str) -> None:
     """DDP comm-hook analog (BENCH row for VERDICT r4 #8): bytes-on-wire of
     the data-parallel gradient sync on a simulated 2-slice mesh (dp=2 over
@@ -786,6 +826,26 @@ def main():
         except Exception:
             pass
     try:
+        tel = _run_subprocess("telemetry", platform, attempts=2)
+        t_off, t_on = (float(v) for v in tel["BENCH_TELEMETRY"])
+        extra_rows.append(
+            {
+                "metric": "telemetry_overhead_pct",
+                "value": round((t_on - t_off) / t_off * 100.0, 2) if t_off else None,
+                "unit": "%",
+                "step_s_telemetry_off": t_off,
+                "step_s_telemetry_on": t_on,
+                "note": "toy 2-param train loop, 200 steps: enabled-vs-"
+                "disabled step time (host-side worst case; the ON figure "
+                "includes the per-step param sync the dispatch/device "
+                "split costs — ACCELERATE_TELEMETRY_NO_SYNC=1 removes it). "
+                "Disabled mode is a no-op recorder: one attribute read per "
+                "step",
+            }
+        )
+    except Exception:
+        pass
+    try:
         ch = _run_subprocess("commhook", platform, attempts=2)
         hook_bytes, base_bytes = (int(v) for v in ch["BENCH_COMMHOOK"])
         extra_rows.append(
@@ -895,6 +955,7 @@ def main():
         "mrpc_train_steps_per_sec": ("mrpc_steps_per_sec", "value"),
         "cv_train_steps_per_sec": ("cv_steps_per_sec", "value"),
         "dp_grad_compression_wire_bytes_ratio": ("commhook_wire_ratio", "value"),
+        "telemetry_overhead_pct": ("telemetry_overhead_pct", "value"),
         "llama_decode_tokens_per_sec_kv_cache": ("decode_tok_s", "value"),
         "disk_offload_fp32_disk_effective_stream_gb_per_s": ("offload_fp32_s_per_token", "s_per_token"),
         "disk_offload_int8_disk_effective_stream_gb_per_s": ("offload_int8_s_per_token", "s_per_token"),
@@ -914,7 +975,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
-        "decode",
+        "decode", "telemetry",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -927,6 +988,7 @@ if __name__ == "__main__":
             "offload": _mode_offload,
             "commhook": _mode_commhook,
             "decode": _mode_decode,
+            "telemetry": _mode_telemetry,
         }
         dispatch[mode](platform)
         sys.stdout.flush()

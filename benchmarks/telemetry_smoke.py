@@ -1,0 +1,67 @@
+"""``make telemetry-smoke``: a 5-step toy train loop with telemetry on,
+asserting the JSONL trail is well-formed — every line parses, the compile
+event carries cost facts, step records carry throughput, and the summary
+agrees with the trail. Exit code is the CI signal; prints a one-line OK."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+
+    tmp = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    acc = Accelerator(project_dir=tmp, telemetry=True)
+
+    # 5 fixed-shape steps of a 2-parameter regression (y = 2x + 3)
+    def make_model():
+        from accelerate_tpu.test_utils import RegressionModel
+
+        return RegressionModel(a=0.0, b=0.0)
+
+    model, opt = acc.prepare(make_model(), optax.sgd(0.1))
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        x = rng.standard_normal(16).astype(np.float32)
+        out = model(x=x, y=(2 * x + 3).astype(np.float32))
+        acc.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+
+    path = acc.telemetry.jsonl_path
+    assert path and os.path.exists(path), "no JSONL trail was written"
+    records = [json.loads(line) for line in open(path)]
+    assert all("type" in r and "ts" in r for r in records), "malformed record"
+
+    steps = [r for r in records if r["type"] == "step"]
+    compiles = [r for r in records if r["type"] == "compile"]
+    assert len(steps) == 5, f"expected 5 step records, got {len(steps)}"
+    assert compiles, "no compile event was recorded"
+    assert "flops" in compiles[0] and "collective_bytes" in compiles[0]
+    assert all(r["step_time_s"] > 0 for r in steps)
+    assert all(r.get("examples_per_sec", 0) > 0 for r in steps)
+
+    s = acc.telemetry.summary()
+    assert s["steps"] == 5 and s["recompiles"] == len(compiles)
+    assert {"p50", "p95", "max"} <= set(s["step_time_s"])
+
+    print(
+        f"telemetry-smoke OK: {len(records)} records "
+        f"({len(steps)} steps, {len(compiles)} compiles), "
+        f"p50 step {s['step_time_s']['p50'] * 1e3:.2f} ms, trail at {path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

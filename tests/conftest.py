@@ -15,12 +15,36 @@ import sys
 #: tests (e.g. the bf16-over-ICI GPipe smoke) actually execute.
 _TEST_BACKEND = os.environ.get("ACCELERATE_TEST_BACKEND", "cpu").lower()
 
+def _xla_flag_supported(flag: str) -> bool:
+    """XLA ABORTS the process on unknown flags in XLA_FLAGS (no exception to
+    catch), and older jaxlibs lack the CPU collective-timeout flag — probe in
+    a throwaway subprocess so an unsupported flag degrades to 'not set'
+    instead of killing the whole pytest session at collection."""
+    import subprocess
+
+    env = dict(os.environ, XLA_FLAGS=flag, JAX_PLATFORMS="cpu")
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env,
+                capture_output=True,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False
+
+
 if _TEST_BACKEND == "cpu":
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-    if "collective_call_terminate_timeout" not in flags:
+    if "collective_call_terminate_timeout" not in flags and _xla_flag_supported(
+        "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+    ):
         # single-core machines time-slice all 8 device threads: a heavy
         # program can exceed XLA CPU's default 40s collective rendezvous
         # window, which ABORTS the process. Give the scheduler room.

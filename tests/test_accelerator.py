@@ -79,7 +79,7 @@ def test_gradient_accumulation_matches_full_batch():
     acc1, model1, opt1, _ = _make(lr=0.1)
     x = np.linspace(-1, 1, 32).astype(np.float32)
     y = (2 * x + 3).astype(np.float32)
-    shard = jax.NamedSharding(acc1.mesh, jax.P(("dp", "fsdp")))
+    shard = jax.sharding.NamedSharding(acc1.mesh, jax.sharding.PartitionSpec(("dp", "fsdp")))
     big = {"x": jax.device_put(jnp.asarray(x), shard), "y": jax.device_put(jnp.asarray(y), shard)}
     out = model1(**big)
     acc1.backward(out.loss)
@@ -93,8 +93,8 @@ def test_gradient_accumulation_matches_full_batch():
     acc2, model2, opt2, _ = _make(accum=2, lr=0.1)
     for half in (slice(0, 16), slice(16, 32)):
         mb = {
-            "x": jax.device_put(jnp.asarray(x[half]), jax.NamedSharding(acc2.mesh, jax.P(("dp", "fsdp")))),
-            "y": jax.device_put(jnp.asarray(y[half]), jax.NamedSharding(acc2.mesh, jax.P(("dp", "fsdp")))),
+            "x": jax.device_put(jnp.asarray(x[half]), jax.sharding.NamedSharding(acc2.mesh, jax.sharding.PartitionSpec(("dp", "fsdp")))),
+            "y": jax.device_put(jnp.asarray(y[half]), jax.sharding.NamedSharding(acc2.mesh, jax.sharding.PartitionSpec(("dp", "fsdp")))),
         }
         out = model2(**mb)
         acc2.backward(out.loss)

@@ -339,14 +339,22 @@ def test_ring_with_dp_downgrades_without_timeout_flag(monkeypatch):
         )
         assert get_attention_context().cp_mode == "allgather"
 
-    # with the flag present (the test env default): real ring, even dp>1
+    # with the flag present: real ring, even dp>1. Set it explicitly (not
+    # every jaxlib supports it, so conftest may have left it out — safe to
+    # fake here because only the Accelerator's regex reads it; XLA parsed
+    # XLA_FLAGS once at backend init, long before this test)
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    acc = Accelerator(
-        mesh_plugin=MeshPlugin(dp=2, fsdp=2, cp=2),
-        context_parallel_plugin=ContextParallelPlugin(mode="ring"),
-    )
-    assert get_attention_context().cp_mode == "ring"
+    with monkeypatch.context() as m:
+        m.setenv(
+            "XLA_FLAGS",
+            (bare + " --xla_cpu_collective_call_terminate_timeout_seconds=600").strip(),
+        )
+        Accelerator(
+            mesh_plugin=MeshPlugin(dp=2, fsdp=2, cp=2),
+            context_parallel_plugin=ContextParallelPlugin(mode="ring"),
+        )
+        assert get_attention_context().cp_mode == "ring"
 
 
 def test_fsdp_activation_checkpointing_wires_model_remat():

@@ -45,7 +45,7 @@ def test_llama_trains_under_accelerator_with_tp_fsdp_mesh():
 
     # params actually sharded: wq [L, h, nh*hd] → P(None, fsdp, tp)
     wq = model.params["layers"]["wq"]
-    assert wq.sharding.spec == jax.P(None, "fsdp", "tp")
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "tp")
 
     from accelerate_tpu.mesh import data_sharding
 

@@ -106,7 +106,7 @@ def test_send_to_device_sharding():
 def test_jops_psum_inside_shard_map():
     state = PartialState()
     mesh = state.mesh
-    from jax import shard_map
+    from accelerate_tpu.utils.compat import shard_map
 
     x = jax.device_put(
         jnp.arange(8.0).reshape(8, 1), NamedSharding(mesh, P(("dp",), None))
@@ -124,7 +124,7 @@ def test_jops_psum_inside_shard_map():
 def test_jops_ring_shift():
     state = PartialState()
     mesh = state.mesh
-    from jax import shard_map
+    from accelerate_tpu.utils.compat import shard_map
 
     x = jax.device_put(jnp.arange(8.0).reshape(8, 1), NamedSharding(mesh, P(("dp",), None)))
 

@@ -1,0 +1,304 @@
+"""Telemetry subsystem tests (no reference analog — the reference's
+observability is host-side tracking only): recompile counting under forced
+static-shape changes, JSONL schema round-trip, summary percentiles,
+strict-no-op disabled mode, and tracker fan-out with main-process gating."""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.telemetry import (
+    NULL_TELEMETRY,
+    TelemetryRecorder,
+    get_active_recorder,
+    set_active_recorder,
+)
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, SimpleLoader
+
+
+@pytest.fixture(autouse=True)
+def _clear_telemetry_globals():
+    """The recorder registers a process-wide compile callback + active
+    recorder; tests must not leak them into each other."""
+    yield
+    from accelerate_tpu import lazy
+
+    lazy.set_compile_callback(None)
+    set_active_recorder(None)
+
+
+def _train(acc, model, opt, dl, epochs=2):
+    for epoch in range(epochs):
+        for batch in dl:
+            out = model(**batch)
+            acc.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+
+
+def _toy(tmp_path, telemetry=True, **kwargs):
+    acc = Accelerator(project_dir=str(tmp_path), telemetry=telemetry, **kwargs)
+    model, opt, dl = acc.prepare(
+        RegressionModel(a=0.0, b=0.0),
+        optax.sgd(0.1),
+        SimpleLoader(RegressionDataset(length=64), batch_size=16),
+    )
+    return acc, model, opt, dl
+
+
+def test_toy_loop_produces_jsonl_trail_and_summary(tmp_path):
+    """The acceptance loop: step records + ≥1 compile event with FLOPs and
+    collective-bytes fields; summary has percentiles and throughput."""
+    acc, model, opt, dl = _toy(tmp_path)
+    _train(acc, model, opt, dl)
+
+    path = acc.telemetry.jsonl_path
+    assert path and os.path.exists(path)
+    records = [json.loads(line) for line in open(path)]
+    compiles = [r for r in records if r["type"] == "compile"]
+    steps = [r for r in records if r["type"] == "step"]
+    assert len(compiles) >= 1
+    assert "flops" in compiles[0] and "collective_bytes" in compiles[0]
+    assert compiles[0]["lower_s"] >= 0 and compiles[0]["compile_s"] > 0
+    assert len(steps) == 8
+    for r in steps:
+        assert r["step_time_s"] > 0 and r["dispatch_s"] > 0
+        assert r["accum_phase"] == "sync" and r["sync_gradients"] is True
+        assert r["examples"] == 16 and r["examples_per_sec"] > 0
+
+    s = acc.telemetry.summary()
+    assert s["steps"] == 8 and s["optimizer_steps"] == 8
+    assert {"p50", "p95", "max"} <= set(s["step_time_s"])
+    assert s["step_time_s"]["p50"] <= s["step_time_s"]["max"]
+    assert s["examples_per_sec"] > 0
+    assert s["recompiles"] >= 1
+
+
+def test_recompile_count_tracks_distinct_static_shapes(tmp_path):
+    """Feeding N distinct batch shapes through the same loop compiles N
+    step programs — the recorder's recompile count must equal N."""
+    acc, model, opt, _ = _toy(tmp_path)
+
+    shapes = (16, 8, 4)
+    for n in shapes:
+        x = np.linspace(-1, 1, n).astype(np.float32)
+        out = model(x=x, y=(2 * x + 3).astype(np.float32))
+        acc.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+
+    s = acc.telemetry.summary()
+    assert s["recompiles"] == len(shapes)
+    assert s["distinct_static_keys"] == len(shapes)
+    # re-feeding an already-seen shape must NOT recompile
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    out = model(x=x, y=(2 * x + 3).astype(np.float32))
+    acc.backward(out.loss)
+    opt.step()
+    opt.zero_grad()
+    assert acc.telemetry.summary()["recompiles"] == len(shapes)
+
+
+def test_summary_percentiles_from_synthetic_steps(tmp_path):
+    rec = TelemetryRecorder(logging_dir=None, memory_interval=0)
+    try:
+        for ms in range(1, 101):  # 1..100 ms dispatch times
+            rec._last_step_end = None  # isolate each step's own spans
+            rec.record_step(dispatch_s=ms / 1000.0, device_s=0.0)
+        s = rec.summary()
+        assert s["steps"] == 100
+        assert s["step_time_s"]["p50"] == pytest.approx(0.0505, rel=0.02)
+        assert s["step_time_s"]["p95"] == pytest.approx(0.09505, rel=0.02)
+        assert s["step_time_s"]["max"] == pytest.approx(0.1)
+    finally:
+        rec.close()
+
+
+def test_disabled_mode_is_strict_noop(tmp_path):
+    """telemetry=False: the accelerator holds the NULL singleton, no
+    telemetry directory is created, no compile callback is registered."""
+    from accelerate_tpu import lazy
+
+    acc, model, opt, dl = _toy(tmp_path, telemetry=False)
+    assert acc.telemetry is NULL_TELEMETRY
+    assert not acc.telemetry
+    assert lazy.get_compile_callback() is None
+    _train(acc, model, opt, dl, epochs=1)
+    assert acc.telemetry.summary() == {}
+    assert not os.path.exists(os.path.join(str(tmp_path), "telemetry"))
+    # the loop still trains
+    assert float(np.asarray(model.params["a"])) != 0.0
+
+
+def test_jsonl_schema_roundtrip(tmp_path):
+    """Every record parses, carries type+ts, and the kinds the recorder
+    claims to emit all appear for a loop that exercises them."""
+    acc, model, opt, dl = _toy(tmp_path)
+    acc.telemetry.memory_interval = 2  # force memory sampling in 8 steps
+    _train(acc, model, opt, dl)
+    acc.telemetry.record_event("custom", note="hello")
+    records = [json.loads(line) for line in open(acc.telemetry.jsonl_path)]
+    kinds = {r["type"] for r in records}
+    assert {"step", "compile", "memory", "event"} <= kinds
+    for r in records:
+        assert "type" in r and "ts" in r
+    mem = [r for r in records if r["type"] == "memory"][-1]
+    assert "host_rss_bytes" in mem and "device_bytes_in_use" in mem
+
+
+def test_tracker_fanout_with_main_process_gating(tmp_path, monkeypatch):
+    """Telemetry metrics flow through Accelerator.log() into initialized
+    trackers, prefixed telemetry/; a non-main process writes nothing —
+    the same gate as tracking.on_main_process."""
+    logged = []
+
+    from accelerate_tpu.tracking import GeneralTracker
+
+    class Capture(GeneralTracker):
+        name = "capture"
+        requires_logging_directory = False
+
+        def __init__(self):
+            super().__init__()
+
+        def log(self, values, step=None, **kw):
+            logged.append((values, step))
+
+    tracker = Capture()
+    acc, model, opt, dl = _toy(tmp_path, log_with=tracker)
+    acc.init_trackers("proj")
+    _train(acc, model, opt, dl, epochs=1)
+
+    tel_logs = [v for v, _ in logged if any(k.startswith("telemetry/") for k in v)]
+    assert tel_logs, "no telemetry records were fanned out to trackers"
+    assert any("telemetry/step_time_s" in v for v in tel_logs)
+    assert any("telemetry/flops" in v for v in tel_logs)  # compile events too
+
+    # non-main process: the recorder's gate must suppress the fan-out
+    from accelerate_tpu import telemetry as tel_mod
+
+    monkeypatch.setattr(tel_mod, "_is_main_process", lambda: False)
+    before = len(logged)
+    acc.telemetry.record_step(dispatch_s=0.001, device_s=0.0)
+    assert len(logged) == before
+
+
+def test_generation_records_tokens_per_sec(tmp_path):
+    """The decode loop reports through the process-wide active recorder."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    rec = TelemetryRecorder(logging_dir=str(tmp_path), memory_interval=0)
+    set_active_recorder(rec)
+    try:
+        config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=1, heads=2, seq=32)
+        model = LlamaForCausalLM.from_config(config, seed=0)
+        ids = np.arange(8, dtype=np.int32)[None, :]
+        out = generate(model, ids, max_new_tokens=4, use_cache=True)
+        gen = [r for r in rec.records if r["type"] == "generate"]
+        assert len(gen) == 1
+        assert gen[0]["mode"] == "kv_cache"
+        assert gen[0]["new_tokens"] == out.shape[1] - 8
+        assert gen[0]["tokens_per_sec"] > 0
+    finally:
+        rec.close()
+        assert get_active_recorder() is NULL_TELEMETRY
+
+
+def test_speculative_decode_reports_accept_rate(tmp_path):
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    rec = TelemetryRecorder(logging_dir=None, memory_interval=0)
+    set_active_recorder(rec)
+    try:
+        config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=1, heads=2, seq=64)
+        model = LlamaForCausalLM.from_config(config, seed=0)
+        draft = LlamaForCausalLM.from_config(config, seed=0)  # same model: all accepted
+        ids = np.arange(8, dtype=np.int32)[None, :]
+        generate(model, ids, max_new_tokens=8, draft_model=draft, num_draft_tokens=3)
+        gen = [r for r in rec.records if r["type"] == "generate"]
+        assert gen and gen[0]["mode"] == "speculative"
+        assert gen[0]["verify_rounds"] >= 1
+        assert 0.0 < gen[0]["accept_rate"] <= 1.0
+    finally:
+        rec.close()
+
+
+def test_profile_session_emits_telemetry_record(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    acc, model, opt, dl = _toy(tmp_path)
+    handler = ProfileKwargs(wait=1, active=1, output_trace_dir=str(tmp_path / "trace"))
+    with acc.profile(handler) as prof:
+        _train(acc, model, opt, dl, epochs=1)
+        for _ in range(3):
+            prof.step()
+    prof_records = [r for r in acc.telemetry.records if r["type"] == "profile"]
+    assert len(prof_records) == 1
+    assert prof_records[0]["steps"] == 3
+    # wait/active cycle of 2: only the middle of the 3 steps was active
+    assert prof_records[0]["active_steps"] == 1
+    assert prof_records[0]["trace_dir"] == str(tmp_path / "trace")
+
+
+def test_grad_accumulation_phase_recorded(tmp_path):
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        telemetry=True,
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2),
+    )
+    model, opt, dl = acc.prepare(
+        RegressionModel(a=0.0, b=0.0),
+        optax.sgd(0.1),
+        SimpleLoader(RegressionDataset(length=64), batch_size=16),
+    )
+    for batch in dl:
+        with acc.accumulate(model):
+            out = model(**batch)
+            acc.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+    steps = [r for r in acc.telemetry.records if r["type"] == "step"]
+    phases = [r["accum_phase"] for r in steps]
+    assert "accumulate" in phases and "sync" in phases
+    assert acc.telemetry.summary()["optimizer_steps"] == phases.count("sync")
+
+
+def test_disabled_accelerator_silences_stale_recorder(tmp_path):
+    """A new telemetry=False Accelerator must clear a prior instance's
+    process-wide recorder + compile callback (Borg takeover), or 'disabled'
+    keeps appending to the old run's trail."""
+    from accelerate_tpu import lazy
+
+    acc1, *_ = _toy(tmp_path / "run1", telemetry=True)
+    assert get_active_recorder() is acc1.telemetry
+    assert lazy.get_compile_callback() is not None
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2 = Accelerator(telemetry=False)
+    assert acc2.telemetry is NULL_TELEMETRY
+    assert get_active_recorder() is NULL_TELEMETRY
+    assert lazy.get_compile_callback() is None
+
+
+def test_null_telemetry_survives_every_call():
+    NULL_TELEMETRY.note_batch(1, 2)
+    NULL_TELEMETRY.note_backward(0.1)
+    NULL_TELEMETRY.record_step(dispatch_s=0.1)
+    NULL_TELEMETRY.record_generation("full", 1, 0.1)
+    NULL_TELEMETRY.record_profile("/tmp", 1)
+    NULL_TELEMETRY.record_event("k")
+    NULL_TELEMETRY.record_memory()
+    NULL_TELEMETRY.close()
+    assert NULL_TELEMETRY.summary() == {}
+    assert not NULL_TELEMETRY

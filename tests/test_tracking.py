@@ -39,13 +39,31 @@ class JSONTracker(GeneralTracker):
             f.write(json.dumps({"step": step, **values}) + "\n")
 
 
-def test_zoo_has_all_seven_reference_trackers():
+def test_zoo_has_reference_trackers_plus_jsonl():
     assert set(LOGGER_TYPE_TO_CLASS) == {
         "tensorboard", "wandb", "mlflow", "comet_ml", "aim", "clearml", "dvclive",
+        "jsonl",
     }
     for cls in LOGGER_TYPE_TO_CLASS.values():
         assert issubclass(cls, GeneralTracker)
         assert isinstance(cls.requires_logging_directory, bool)
+
+
+def test_jsonl_tracker_by_name_roundtrip(tmp_path):
+    """The built-in "jsonl" tracker resolves by string name and appends one
+    parseable JSON object per log call."""
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("run1", config={"lr": 0.1})
+    acc.log({"loss": 1.5, "nested": {"acc": 0.5}}, step=0)
+    acc.log({"loss": 0.5}, step=1)
+    acc.end_training()
+
+    path = tmp_path / "run1" / "metrics.jsonl"
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows[0] == {"event": "init", "config": {"lr": 0.1}}
+    assert rows[1]["step"] == 0 and rows[1]["loss"] == 1.5
+    assert rows[1]["nested/acc"] == 0.5
+    assert rows[2]["step"] == 1 and rows[2]["loss"] == 0.5
 
 
 def test_filter_trackers_resolution_rules():
