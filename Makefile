@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -22,3 +22,6 @@ test_all: test test_slow test_examples
 
 telemetry-smoke:  ## 5-step toy loop with telemetry on; asserts the JSONL trail is well-formed
 	python benchmarks/telemetry_smoke.py
+
+ckpt-smoke:       ## save -> SIGTERM mid-training -> auto-resume round-trip on a CPU mesh
+	python benchmarks/ckpt_smoke.py

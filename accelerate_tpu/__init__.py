@@ -16,6 +16,7 @@ from .utils.dataclasses import (
     DataLoaderConfiguration,
     DeepSpeedPlugin,
     DistributedType,
+    FaultTolerancePlugin,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     InitProcessGroupKwargs,
@@ -87,4 +88,12 @@ def __getattr__(name):
         from . import telemetry
 
         return getattr(telemetry, name)
+    if name == "PreemptionHandler":
+        from .resilience.preemption import PreemptionHandler
+
+        return PreemptionHandler
+    if name == "wait_for_checkpoint":
+        from .checkpointing import wait_for_checkpoint
+
+        return wait_for_checkpoint
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -42,6 +42,7 @@ from .utils.dataclasses import (
     DeepSpeedPlugin,
     DistributedDataParallelKwargs,
     DistributedType,
+    FaultTolerancePlugin,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     GradScalerKwargs,
@@ -205,6 +206,7 @@ class Accelerator:
         even_batches: bool = True,
         use_seedable_sampler: bool = False,
         telemetry: bool | None = None,
+        fault_tolerance: FaultTolerancePlugin | bool | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -508,6 +510,33 @@ class Accelerator:
             set_active_recorder(None)
             set_compile_callback(None)
 
+        # fault tolerance (resilience subsystem): opt-in via the
+        # constructor, ACCELERATE_FAULT_TOLERANCE=1, or — so launcher
+        # restarts are preemption-safe too — ACCELERATE_AUTO_RESUME=1
+        if fault_tolerance is None and (
+            parse_flag_from_env("ACCELERATE_FAULT_TOLERANCE")
+            or parse_flag_from_env("ACCELERATE_AUTO_RESUME")
+        ):
+            fault_tolerance = True
+        if fault_tolerance is True:
+            fault_tolerance = FaultTolerancePlugin()
+        elif fault_tolerance is False:
+            fault_tolerance = None
+        self.fault_tolerance_plugin: FaultTolerancePlugin | None = fault_tolerance
+        self._preemption_handler = None
+        self._ft_boundary_count = 0
+        if fault_tolerance is not None:
+            fault_tolerance.export_io_env()
+            from .resilience.preemption import PreemptionHandler
+
+            self._preemption_handler = PreemptionHandler(
+                handle_sigint=fault_tolerance.handle_sigint,
+                monitor_maintenance=fault_tolerance.monitor_maintenance,
+                poll_seconds=fault_tolerance.maintenance_poll_seconds,
+                handle_signals=fault_tolerance.handle_signals,
+            )
+            self._preemption_handler.install()
+
     # ------------------------------------------------------------------
     # properties delegating to state (reference :525-760)
     # ------------------------------------------------------------------
@@ -769,6 +798,104 @@ class Accelerator:
             )
         return result[0] if len(result) == 1 else tuple(result)
 
+    # ------------------------------------------------------------------
+    # fault tolerance (resilience subsystem)
+    # ------------------------------------------------------------------
+
+    @property
+    def preemption_requested(self) -> bool:
+        """Has a SIGTERM/SIGINT/maintenance event raised the LOCAL
+        preemption flag? (Cross-host agreement happens in
+        :meth:`check_preemption`.)"""
+        return (
+            self._preemption_handler is not None
+            and self._preemption_handler.preemption_requested
+        )
+
+    def check_preemption(self):
+        """Step-boundary preemption check (called from ``backward``; user
+        loops that never call backward — eval sweeps — may call it
+        directly). Every ``consensus_interval`` boundaries the local flag
+        is all-reduced across hosts; on agreement, ONE synchronized
+        emergency ``save_state()`` runs and the process exits cleanly with
+        a sentinel file. Collective cadence: all processes count the same
+        boundaries, so the all-reduce lines up.
+
+        Mid-accumulation the save is DEFERRED to the window boundary (a
+        save with half a gradient window pending would drop those
+        micro-batches' work while their dataloader positions stay
+        consumed), bounded at 2× the window so a pathological loop still
+        saves before the preemption deadline. The batch whose ``backward``
+        triggered the check never trains — resume is within ONE optimizer
+        step of the kill, never worse."""
+        handler = self._preemption_handler
+        if handler is None:
+            return
+        plugin = self.fault_tolerance_plugin
+        self._ft_boundary_count += 1
+        multi = self.num_processes > 1
+        if multi:
+            if self._ft_boundary_count % plugin.consensus_interval != 0:
+                return
+            preempt = handler.consensus()
+        else:
+            preempt = handler.preemption_requested
+        if not preempt:
+            return
+        # clean window boundary: no parked loss, no accumulated grads
+        # (deterministic across hosts — every host runs the same schedule)
+        clean = all(
+            o._pending_loss is None and o._grads is None for o in self._optimizers
+        )
+        if not clean:
+            self._ft_deferred_boundaries = getattr(self, "_ft_deferred_boundaries", 0) + 1
+            if self._ft_deferred_boundaries <= max(2 * self.gradient_accumulation_steps, 4):
+                return
+            logger.warning(
+                "emergency save forced mid-accumulation after %d deferrals "
+                "(the partial gradient window is dropped)",
+                self._ft_deferred_boundaries,
+            )
+        self._emergency_save_and_exit()
+
+    def _emergency_save_and_exit(self):
+        handler = self._preemption_handler
+        plugin = self.fault_tolerance_plugin
+        reason = handler.reason or "preemption"
+        logger.warning("preemption consensus (%s): emergency checkpoint", reason)
+        checkpoint = None
+        if plugin.save_on_preemption:
+            if self.project_dir is None:
+                logger.warning(
+                    "emergency save skipped: no project_dir configured on "
+                    "this Accelerator"
+                )
+            else:
+                try:
+                    # synchronous on purpose: durability outranks step time
+                    # when the host is about to disappear
+                    checkpoint = self.save_state()
+                except Exception:
+                    logger.error("emergency save FAILED", exc_info=True)
+        if self.telemetry:
+            self.telemetry.record_event(
+                "preemption", reason=reason, checkpoint=checkpoint, step=self.step
+            )
+            self.telemetry.close()
+        sentinel_dir = (
+            os.path.join(self.project_dir, "checkpoints")
+            if self.project_dir is not None
+            else os.getcwd()
+        )
+        if self.is_main_process:
+            handler.write_sentinel(sentinel_dir, checkpoint, self.step)
+        handler.uninstall()
+        logger.warning(
+            "exiting cleanly after preemption (checkpoint=%s, exit_code=%d)",
+            checkpoint, plugin.exit_code,
+        )
+        raise SystemExit(plugin.exit_code)
+
     def _maybe_auto_resume(self):
         """Launcher fault tolerance: a run re-exec'd by ``accelerate-tpu
         launch --max_restarts`` carries ``ACCELERATE_AUTO_RESUME=true``; once
@@ -787,23 +914,52 @@ class Accelerator:
         # calls must NOT clobber live training state with the checkpoint.
         if getattr(self, "_training_started", False):
             return
-        if not parse_flag_from_env("ACCELERATE_AUTO_RESUME"):
+        plugin_resume = (
+            self.fault_tolerance_plugin is not None
+            and self.fault_tolerance_plugin.auto_resume
+        )
+        if not (plugin_resume or parse_flag_from_env("ACCELERATE_AUTO_RESUME")):
             return
         if self.project_dir is None:
             return
-        from .checkpointing import _sorted_checkpoints
+        from .resilience.manifest import SENTINEL_NAME, find_latest_valid_checkpoint
 
-        checkpoints = _sorted_checkpoints(os.path.join(self.project_dir, "checkpoints"))
-        if not checkpoints:
+        checkpoints_dir = os.path.join(self.project_dir, "checkpoints")
+        # manifest-validated selection: corrupt/partial checkpoints (and
+        # `.tmp` dirs from an interrupted save) are skipped for the newest
+        # one that verifies completely. Multi-host: the MAIN process alone
+        # validates (one CRC pass over the candidates, not host_count of
+        # them) and broadcasts its choice — per-host selection could
+        # diverge if validation raced a commit/rotation, silently resuming
+        # different checkpoints on different hosts.
+        if self.num_processes > 1:
+            from .operations import broadcast_object_list
+
+            choice = [
+                find_latest_valid_checkpoint(checkpoints_dir)
+                if self.is_main_process
+                else None
+            ]
+            latest = broadcast_object_list(choice)[0]
+        else:
+            latest = find_latest_valid_checkpoint(checkpoints_dir)
+        if latest is None:
             if not getattr(self, "_auto_resume_warned", False):
                 self._auto_resume_warned = True
                 logger.warning(
-                    "ACCELERATE_AUTO_RESUME is set but no checkpoint_* exists under "
-                    "%s; starting fresh", os.path.join(self.project_dir, "checkpoints")
+                    "auto-resume is on but no valid checkpoint_* exists under "
+                    "%s; starting fresh", checkpoints_dir
                 )
             return
-        logger.info("auto-resuming from %s", checkpoints[-1])
-        self.load_state(checkpoints[-1])
+        logger.info("auto-resuming from %s", latest)
+        self.load_state(latest)
+        sentinel = os.path.join(checkpoints_dir, SENTINEL_NAME)
+        if self.is_main_process and os.path.exists(sentinel):
+            # consumed: this run IS the resume the sentinel asked for
+            try:
+                os.remove(sentinel)
+            except OSError:
+                pass
 
     def _fill_deepspeed_auto(self):
         """Resolve ``"auto"`` entries of an ingested DeepSpeed config file
@@ -928,6 +1084,10 @@ class Accelerator:
                 "model outputs (e.g. model(**batch).loss)."
             )
         self._training_started = True  # freezes auto-resume (see _maybe_auto_resume)
+        if self._preemption_handler is not None:
+            # step boundary: the previous step is fully applied, this one
+            # hasn't staged yet — the one consistent point to emergency-save
+            self.check_preemption()
         if self.telemetry:
             self._backward_instrumented(loss)
             return
@@ -1357,6 +1517,13 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.finish()
         self.telemetry.close()
+        if self._preemption_handler is not None:
+            self._preemption_handler.uninstall()
+        from .checkpointing import _join_writer_then_barrier
+
+        # a trailing async save must land AND commit before exit — the
+        # barriered join is the only place a multi-host commit is safe
+        _join_writer_then_barrier(self)
         self.wait_for_everyone()
 
     # ------------------------------------------------------------------

@@ -16,11 +16,14 @@ FSDP ``SHARDED_STATE_DICT``/rank-0 consolidation split).
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import pickle
 import random
+import re
 import shutil
+import time
 from pathlib import Path
 from typing import Any
 
@@ -180,12 +183,98 @@ def _restore_rng_state(states: dict[str, Any]):
 # ---------------------------------------------------------------------------
 
 
-#: in-flight async checkpoint write (single-worker: saves are ordered)
-_ASYNC_SAVE: dict[str, Any] = {"executor": None, "future": None}
+#: in-flight async checkpoint write (single-worker: saves are ordered).
+#: ``pending_commit`` is the (tmp_dir, final_dir, meta) of a written-but-not-
+#: yet-committed async save; ``pending_dirs`` protects those directories
+#: from rotation until the commit lands.
+_ASYNC_SAVE: dict[str, Any] = {
+    "executor": None,
+    "future": None,
+    "pending_commit": None,
+    "pending_dirs": set(),
+}
+
+
+def _pending_checkpoint_dirs() -> set[str]:
+    """Directories with an async write or commit still in flight — rotation
+    must never delete these (the write would land in a deleted directory,
+    or worse, resurrect it half-empty)."""
+    return set(_ASYNC_SAVE["pending_dirs"])
+
+
+def _commit_checkpoint_dir(tmp_dir: str, final_dir: str):
+    """The commit step. Fresh ``final_dir`` (the automatic-naming /
+    rotation path — the preemption-safety case): ONE atomic ``os.rename``,
+    so the checkpoint exists completely or not at all. Existing
+    ``final_dir`` (an explicitly reused directory, or the non-automatic
+    default ``checkpoints/``): per-entry merge-overwrite — deleting the
+    directory wholesale would take unrelated content (older ``checkpoint_N``
+    dirs, a not-yet-consumed sentinel, user files kept alongside) with it,
+    which the pre-manifest code never did."""
+    from .resilience.retry import run_with_retries
+
+    def _commit():
+        if not os.path.isdir(final_dir):
+            os.rename(tmp_dir, final_dir)
+            return
+        from .resilience.manifest import MANIFEST_NAME
+
+        # the manifest moves LAST: a crash mid-merge leaves old-manifest-
+        # vs-new-files (or no manifest), which validation fails closed
+        entries = sorted(os.listdir(tmp_dir), key=lambda e: e == MANIFEST_NAME)
+        for entry in entries:
+            src = os.path.join(tmp_dir, entry)
+            dst = os.path.join(final_dir, entry)
+            if os.path.isdir(dst):
+                shutil.rmtree(dst)
+            os.replace(src, dst)
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    run_with_retries(_commit, what=f"commit {final_dir}")
+    # fsync the parent so the rename itself survives a host crash
+    try:
+        parent_fd = os.open(os.path.dirname(os.path.abspath(final_dir)) or ".", os.O_RDONLY)
+        try:
+            os.fsync(parent_fd)
+        finally:
+            os.close(parent_fd)
+    except OSError:
+        pass
+
+
+def _finish_pending_commit(cross_process_safe: bool):
+    """Perform a deferred async-save commit. Multi-process commits need the
+    cross-host barrier first (every host's writer joined) — callers that
+    barriered pass ``cross_process_safe=True``; single-process commits are
+    always safe."""
+    pending = _ASYNC_SAVE["pending_commit"]
+    if pending is None:
+        return
+    tmp_dir, final_dir, meta = pending
+    if not cross_process_safe:
+        try:
+            from .state import PartialState
+
+            if PartialState().num_processes > 1:
+                return  # the next barriered join point commits
+        except Exception:
+            pass
+    _ASYNC_SAVE["pending_commit"] = None
+    try:
+        if meta.get("is_main", True):
+            if meta.get("build_manifest", True):
+                _write_checkpoint_manifest(tmp_dir, meta)
+            _commit_checkpoint_dir(tmp_dir, final_dir)
+            _record_checkpoint_telemetry("save", final_dir, meta)
+        logger.info(f"Committed checkpoint {final_dir}")
+    finally:
+        _ASYNC_SAVE["pending_dirs"].discard(final_dir)
+        _ASYNC_SAVE["pending_dirs"].discard(tmp_dir)
 
 
 def wait_for_checkpoint():
-    """Block until a pending ``async_save`` finished writing (orbax-style
+    """Block until a pending ``async_save`` finished writing AND (when this
+    process can do so safely) committed its directory (orbax-style
     contract: training continues while files land; the next save/load —
     or an explicit call — joins the writer). Multi-process note: this
     joins the LOCAL writer; ``load_accelerator_state`` additionally
@@ -194,24 +283,208 @@ def wait_for_checkpoint():
     if future is not None:
         try:
             future.result()
+        except BaseException:
+            # the write failed: NEVER promote its half-written tmp dir —
+            # abort the commit (the .tmp stays on disk for diagnosis)
+            _abort_pending_commit()
+            raise
         finally:
             # a failed write must not poison every later save/load — the
             # exception surfaces once, then the slot clears
             _ASYNC_SAVE["future"] = None
+    _finish_pending_commit(cross_process_safe=False)
+
+
+def _atexit_drain_async_saves():
+    """Clean interpreter exit must not silently abandon an in-flight async
+    save: join the writer, finish the commit, and say what happened — a
+    lost checkpoint at exit is exactly the failure this subsystem exists
+    to prevent."""
+    future = _ASYNC_SAVE["future"]
+    pending = _ASYNC_SAVE["pending_commit"]
+    if future is None and pending is None:
+        return
+    try:
+        wait_for_checkpoint()
+        # Single-process: commit — a fully-written local save must not be
+        # stranded as a .tmp forever. Multi-process: there is NO barrier
+        # available at exit, and committing would let the manifest certify
+        # a checkpoint other hosts are still writing — leave the .tmp
+        # uncommitted (auto-resume falls back to the previous checkpoint).
+        try:
+            from .state import PartialState
+
+            multi = PartialState().num_processes > 1
+        except Exception:
+            multi = False
+        if multi and _ASYNC_SAVE["pending_commit"] is not None:
+            logger.warning(
+                "multi-host async checkpoint save left UNCOMMITTED (.tmp) at "
+                "interpreter exit — no cross-host barrier is available here; "
+                "resume will use the previous committed checkpoint"
+            )
+        else:
+            _finish_pending_commit(cross_process_safe=True)
+        logger.info("joined in-flight async checkpoint save at interpreter exit")
+    except Exception:
+        logger.error(
+            "in-flight async checkpoint save FAILED during interpreter exit "
+            "— the last checkpoint may be lost",
+            exc_info=True,
+        )
+    finally:
+        executor = _ASYNC_SAVE["executor"]
+        if executor is not None:
+            executor.shutdown(wait=True)
+            _ASYNC_SAVE["executor"] = None
+
+
+def _async_executor():
+    if _ASYNC_SAVE["executor"] is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _ASYNC_SAVE["executor"] = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="checkpoint-writer"
+        )
+        atexit.register(_atexit_drain_async_saves)
+    return _ASYNC_SAVE["executor"]
+
+
+def _abort_pending_commit():
+    """Drop the pending-commit bookkeeping without promoting the ``.tmp``
+    (a torn save must stay invisible to checkpoint discovery)."""
+    pending = _ASYNC_SAVE["pending_commit"]
+    _ASYNC_SAVE["pending_commit"] = None
+    if pending is not None:
+        _ASYNC_SAVE["pending_dirs"].discard(pending[0])
+        _ASYNC_SAVE["pending_dirs"].discard(pending[1])
 
 
 def _join_writer_then_barrier(accelerator):
     """Join the local async writer, ALWAYS reach the cross-process barrier,
     then surface any local write failure — raising before the barrier would
-    leave the other processes hanging in it forever."""
+    leave the other processes hanging in it forever.
+
+    A deferred multi-process async commit lands here. The commit decision
+    is COLLECTIVE: after the barrier, the hosts all-reduce "did any writer
+    fail?" — if yes, every process aborts the commit (the torn save stays a
+    ``.tmp``; committing would let the manifest certify whatever subset of
+    shard files happens to exist); if no, the main process renames and a
+    second barrier makes the rename visible before anyone reads. Any
+    commit-side failure is parked until after that barrier too, so no
+    process ever raises while the others still wait."""
+    # symmetric across processes (every process submitted the same async
+    # save) — safe to branch the collectives on
+    had_async = (
+        _ASYNC_SAVE["future"] is not None or _ASYNC_SAVE["pending_commit"] is not None
+    )
     error = None
     try:
         wait_for_checkpoint()
     except Exception as e:  # noqa: BLE001 — surfaced after the barrier
         error = e
     accelerator.wait_for_everyone()
+    if had_async and accelerator.num_processes > 1:
+        from .state import PartialState
+
+        any_failed = PartialState().consensus_any(error is not None)
+        commit_error = None
+        if any_failed:
+            _abort_pending_commit()
+        elif _ASYNC_SAVE["pending_commit"] is not None:
+            try:
+                _finish_pending_commit(cross_process_safe=True)
+            except Exception as e:  # noqa: BLE001 — surfaced after the barrier
+                commit_error = e
+        accelerator.wait_for_everyone()
+        if error is None:
+            error = commit_error
     if error is not None:
         raise error
+
+
+def _record_checkpoint_telemetry(kind: str, path: str, meta: dict):
+    from .telemetry import get_active_recorder
+
+    recorder = get_active_recorder()
+    if not recorder:
+        return
+    # async saves commit at the NEXT join point — wall time since t0 would
+    # count arbitrary intervening training; the writer stamps its true
+    # duration into write_seconds when the files land
+    seconds = meta.get("write_seconds")
+    if seconds is None and "t0" in meta:
+        seconds = time.perf_counter() - meta["t0"]
+    recorder.record_checkpoint(
+        kind=kind,
+        seconds=seconds,
+        bytes_written=meta.get("bytes"),
+        shard_count=meta.get("shard_count"),
+        is_async=meta.get("is_async", False),
+        path=path,
+    )
+
+
+def _write_checkpoint_manifest(tmp_dir: str, meta: dict):
+    """Merge per-host piece tables (sharded saves) and write the manifest —
+    the last file before the commit rename."""
+    from .resilience.manifest import build_manifest, write_manifest
+
+    arrays = None
+    shard_count = 0
+    if meta.get("sharded"):
+        from .resilience.distributed import merge_piece_tables
+
+        arrays = {}
+        tables_by_component: dict[str, list] = {}
+        for entry in sorted(os.listdir(tmp_dir)):
+            table_path = os.path.join(tmp_dir, entry, "piece_table.json")
+            if not (entry.startswith("shard_") and os.path.exists(table_path)):
+                continue
+            shard_count += 1
+            with open(table_path) as f:
+                for component, table in json.load(f).items():
+                    tables_by_component.setdefault(component, []).append(table)
+        for component, tables in tables_by_component.items():
+            arrays[component] = merge_piece_tables(tables)
+    manifest = build_manifest(
+        tmp_dir,
+        kind="sharded" if meta.get("sharded") else "gathered",
+        step=meta.get("step"),
+        iteration=meta.get("iteration"),
+        host_count=meta.get("host_count", 1),
+        arrays=arrays,
+    )
+    meta["bytes"] = sum(f["bytes"] for f in manifest["files"].values())
+    meta["shard_count"] = shard_count
+    write_manifest(tmp_dir, manifest)
+
+
+def _resolve_sharded(accelerator, sharded) -> bool:
+    if sharded is not None:
+        return bool(sharded)
+    plugin = getattr(accelerator, "fault_tolerance_plugin", None)
+    return bool(plugin is not None and getattr(plugin, "sharded_io", False))
+
+
+def _rotate_checkpoints(checkpoints_dir: str, total_limit: int, incoming: int = 1):
+    """Delete oldest committed checkpoints so that ``incoming`` more fit
+    under ``total_limit``. Checkpoints with a pending async write/commit
+    are NEVER deleted — rotation must not race the writer."""
+    existing = _sorted_checkpoints(checkpoints_dir)
+    pending = _pending_checkpoint_dirs()
+    pending_paths = {os.path.abspath(p) for p in pending}
+    excess = len(existing) + incoming - total_limit
+    for path in existing:
+        if excess <= 0:
+            break
+        if os.path.abspath(path) in pending_paths:
+            logger.warning(
+                "rotation: keeping %s (async checkpoint write in flight)", path
+            )
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        excess -= 1
 
 
 def save_accelerator_state(
@@ -219,20 +492,36 @@ def save_accelerator_state(
     output_dir: str | None = None,
     safe_serialization: bool = True,
     async_save: bool = False,
+    sharded: bool | None = None,
 ):
     """(Reference ``save_accelerator_state`` ``checkpointing.py:53`` +
     rotation ``accelerator.py:3004-3028``.)
 
-    ``async_save=True`` → the device→host gather (a collective, main-thread
-    only) runs now, the file writes land on a background worker, and the
-    call returns immediately; see :func:`wait_for_checkpoint`.
+    Every save is **atomic**: files land in ``<output_dir>.tmp``, a
+    manifest (per-file sizes + CRC32s — see ``resilience/manifest.py``) is
+    written last, and the directory is ``os.rename``'d into place after a
+    cross-host barrier. A crash mid-save leaves only a ``.tmp`` that
+    checkpoint discovery ignores.
+
+    ``async_save=True`` → the device→host snapshot (a collective in
+    gathered mode, main-thread only) runs now, the file writes land on a
+    background worker, and the call returns immediately; see
+    :func:`wait_for_checkpoint`.
+
+    ``sharded=True`` (default when the Accelerator carries a
+    ``FaultTolerancePlugin(sharded_io=True)``) → each host writes only its
+    addressable shards into ``shard_<host>/`` instead of gathering every
+    array to the main host — no full-gather OOM/wall-clock spike on
+    multi-host FSDP.
     """
+    t0 = time.perf_counter()
     # join the previous writer, then barrier — saves are ordered, and the
     # barrier bounds cross-process skew to ONE in-flight checkpoint (the
     # rotation below deletes directories other processes may otherwise
     # still be writing into). A local write failure must surface AFTER the
     # barrier, or the other processes hang in it while this one raises.
     _join_writer_then_barrier(accelerator)
+    sharded = _resolve_sharded(accelerator, sharded)
     if output_dir is None:
         if accelerator.project_dir is None:
             raise ValueError("pass output_dir or set project_dir on the Accelerator")
@@ -241,19 +530,41 @@ def save_accelerator_state(
         if config.automatic_checkpoint_naming:
             output_dir = os.path.join(checkpoints_dir, f"checkpoint_{config.iteration}")
             if accelerator.is_main_process and config.total_limit is not None:
-                existing = _sorted_checkpoints(checkpoints_dir)
-                while len(existing) + 1 > config.total_limit:
-                    shutil.rmtree(existing.pop(0), ignore_errors=True)
+                _rotate_checkpoints(checkpoints_dir, config.total_limit)
         else:
             output_dir = checkpoints_dir
-    os.makedirs(output_dir, exist_ok=True)
+    output_dir = os.path.normpath(output_dir)
+    tmp_dir = output_dir + ".tmp"
+    if accelerator.is_main_process and os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)  # leftover from an interrupted save
+    accelerator.wait_for_everyone()
+    os.makedirs(tmp_dir, exist_ok=True)
 
-    # Flatten/gather on ALL processes (collective for multi-host shards)…
-    model_flats = [_flatten_tree(m.params) for m in accelerator._models]
-    opt_flats = [_flatten_tree(o.opt_state) for o in accelerator._optimizers]
+    from .resilience.retry import run_with_retries
 
-    # Snapshot every host-side state NOW (the background writer must see
-    # this step's values, not whatever the training loop mutates next)…
+    is_main = accelerator.is_main_process
+    process_index = accelerator.process_index
+
+    # Snapshot device state NOW, on the calling thread…
+    model_pieces: list = []
+    opt_pieces: list = []
+    model_flats: list = []
+    opt_flats: list = []
+    if sharded:
+        # …local addressable shards only: no gather, no collective
+        from .resilience.distributed import collect_addressable_pieces
+
+        model_pieces = [collect_addressable_pieces(m.params) for m in accelerator._models]
+        opt_pieces = [collect_addressable_pieces(o.opt_state) for o in accelerator._optimizers]
+    else:
+        # …full arrays on the main host (collective for multi-host shards)
+        model_flats = [_flatten_tree(m.params) for m in accelerator._models]
+        opt_flats = [_flatten_tree(o.opt_state) for o in accelerator._optimizers]
+        if not is_main:  # only the main process touches the array files
+            model_flats, opt_flats = [], []
+
+    # …and every host-side state (the background writer must see this
+    # step's values, not whatever the training loop mutates next)
     sched_states = [s.state_dict() for s in accelerator._schedulers]
     # deep sampler/loader state: epoch + mid-epoch position, so load_state
     # resumes without a manual skip_first_batches (reference saves
@@ -267,88 +578,184 @@ def save_accelerator_state(
     )
     meta = {"step": accelerator.step, "iteration": accelerator.save_iteration}
     rng_state = _collect_rng_state()
-    is_main = accelerator.is_main_process
-    process_index = accelerator.process_index
-    if not is_main:  # only the main process touches the array files
-        model_flats, opt_flats = [], []
+    num_processes = accelerator.num_processes
+
+    commit_meta = {
+        "t0": t0,
+        "is_main": is_main,
+        "is_async": bool(async_save),
+        "sharded": sharded,
+        "step": meta["step"],
+        "iteration": meta["iteration"],
+        "host_count": num_processes,
+    }
+
+    def _pickle_to(path: str, state):
+        def _write():
+            with open(path, "wb") as f:
+                pickle.dump(state, f)
+
+        run_with_retries(_write, what=f"write {path}")
 
     def _write_files():
-        if is_main:
+        from .resilience.distributed import shard_dirname
+
+        if sharded:
+            shard_dir = os.path.join(tmp_dir, shard_dirname(process_index))
+            os.makedirs(shard_dir, exist_ok=True)
+            piece_tables: dict[str, Any] = {}
+            for name, per_obj in ((MODEL_NAME, model_pieces), (OPTIMIZER_NAME, opt_pieces)):
+                for i, (pieces, table) in enumerate(per_obj):
+                    suffix = "" if i == 0 else f"_{i}"
+                    written = run_with_retries(
+                        lambda p=pieces, s=suffix, n=name: save_array_dict(
+                            p, os.path.join(shard_dir, f"{n}{s}"), safe_serialization
+                        ),
+                        what=f"write {name}{suffix} shard",
+                    )
+                    rel = os.path.relpath(written, tmp_dir).replace(os.sep, "/")
+                    for entry in table.values():
+                        for piece in entry["pieces"]:
+                            piece["file"] = rel
+                    piece_tables[f"{name}_{i}"] = table
+            with open(os.path.join(shard_dir, "piece_table.json"), "w") as f:
+                json.dump(piece_tables, f)
+        else:
             for i, flat in enumerate(model_flats):
                 suffix = "" if i == 0 else f"_{i}"
-                save_array_dict(flat, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), safe_serialization)
+                run_with_retries(
+                    lambda fl=flat, s=suffix: save_array_dict(
+                        fl, os.path.join(tmp_dir, f"{MODEL_NAME}{s}"), safe_serialization
+                    ),
+                    what=f"write {MODEL_NAME}{suffix}",
+                )
             for i, flat in enumerate(opt_flats):
                 suffix = "" if i == 0 else f"_{i}"
-                save_array_dict(flat, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), safe_serialization)
+                run_with_retries(
+                    lambda fl=flat, s=suffix: save_array_dict(
+                        fl, os.path.join(tmp_dir, f"{OPTIMIZER_NAME}{s}"), safe_serialization
+                    ),
+                    what=f"write {OPTIMIZER_NAME}{suffix}",
+                )
+        if is_main:
             for i, state in enumerate(sched_states):
-                with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
-                    pickle.dump(state, f)
+                _pickle_to(os.path.join(tmp_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), state)
             for i, state in enumerate(dl_states):
-                with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
-                    pickle.dump(state, f)
+                _pickle_to(os.path.join(tmp_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), state)
             for i, state in enumerate(custom_states):
-                with open(os.path.join(output_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), "wb") as f:
-                    pickle.dump(state, f)
+                _pickle_to(os.path.join(tmp_dir, f"{CUSTOM_STATES_NAME}_{i}.pkl"), state)
             if scaler_state is not None:
-                with open(os.path.join(output_dir, f"{SCALER_NAME}.bin"), "wb") as f:
-                    pickle.dump(scaler_state, f)
-            with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
+                _pickle_to(os.path.join(tmp_dir, f"{SCALER_NAME}.bin"), scaler_state)
+            with open(os.path.join(tmp_dir, "accelerator_state.json"), "w") as f:
                 json.dump(meta, f)
         # per-process RNG bundle (every process writes its own, like the
         # reference's random_states_{i}.pkl)
-        with open(os.path.join(output_dir, f"{RNG_STATE_NAME}_{process_index}.pkl"), "wb") as f:
-            pickle.dump(rng_state, f)
-        logger.info(f"Saved state to {output_dir}")
+        _pickle_to(os.path.join(tmp_dir, f"{RNG_STATE_NAME}_{process_index}.pkl"), rng_state)
+        # async: stamp the true write duration (snapshot → files on disk)
+        # now — the commit (and telemetry record) may happen much later.
+        # Sync saves keep the full save_state duration measured at record
+        # time (manifest + commit included).
+        if commit_meta["is_async"]:
+            commit_meta["write_seconds"] = time.perf_counter() - t0
+        logger.info(f"Saved state to {tmp_dir} (pending commit to {output_dir})")
 
     accelerator.project_configuration.iteration += 1
     if async_save:
-        from concurrent.futures import ThreadPoolExecutor
-
-        if _ASYNC_SAVE["executor"] is None:
-            _ASYNC_SAVE["executor"] = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="checkpoint-writer"
-            )
-        _ASYNC_SAVE["future"] = _ASYNC_SAVE["executor"].submit(_write_files)
+        _ASYNC_SAVE["pending_dirs"].update({output_dir, tmp_dir})
+        _ASYNC_SAVE["pending_commit"] = (tmp_dir, output_dir, commit_meta)
+        _ASYNC_SAVE["future"] = _async_executor().submit(_write_files)
         return output_dir
 
     _write_files()
     accelerator.wait_for_everyone()
+    if is_main:
+        _write_checkpoint_manifest(tmp_dir, commit_meta)
+        _commit_checkpoint_dir(tmp_dir, output_dir)
+    accelerator.wait_for_everyone()
+    _record_checkpoint_telemetry("save", output_dir, commit_meta)
     return output_dir
 
 
+_CHECKPOINT_DIR_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
 def _sorted_checkpoints(checkpoints_dir: str) -> list[str]:
+    """Committed ``checkpoint_<i>`` dirs, oldest→newest. Entries with a
+    non-numeric suffix — e.g. a ``checkpoint_12.tmp`` left by an
+    interrupted save — are NOT checkpoints and are skipped instead of
+    crashing the listing with a ``ValueError``."""
     if not os.path.isdir(checkpoints_dir):
         return []
-    entries = [
-        os.path.join(checkpoints_dir, d)
-        for d in os.listdir(checkpoints_dir)
-        if d.startswith("checkpoint_")
-    ]
-    return sorted(entries, key=lambda p: int(p.rsplit("_", 1)[-1]))
+    entries = []
+    for d in os.listdir(checkpoints_dir):
+        match = _CHECKPOINT_DIR_RE.match(d)
+        if match:
+            entries.append((int(match.group(1)), os.path.join(checkpoints_dir, d)))
+    return [path for _, path in sorted(entries)]
+
+
+def _piece_loader(input_dir: str):
+    """``piece_entry → np.ndarray`` with a per-call cache of opened shard
+    files (several pieces usually share one file)."""
+    cache: dict[str, dict[str, np.ndarray]] = {}
+
+    def load_piece(piece: dict) -> np.ndarray:
+        rel = piece["file"]
+        if rel not in cache:
+            cache[rel] = load_array_dict(os.path.join(input_dir, rel))
+        return cache[rel][piece["piece"]]
+
+    return load_piece
 
 
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
-    """(Reference ``load_accelerator_state`` ``checkpointing.py:165``.)"""
+    """(Reference ``load_accelerator_state`` ``checkpointing.py:165``.)
+
+    With ``input_dir=None`` the newest checkpoint whose manifest
+    **validates** is selected — corrupt or partial ones (and ``.tmp`` dirs
+    from interrupted saves) are skipped. Sharded checkpoints (see
+    ``resilience/distributed.py``) are reassembled from their per-host
+    shard files, onto the live arrays' shardings.
+    """
+    t0 = time.perf_counter()
     # an in-flight async save must land on EVERY process before ANY
     # process reads (each joins its own writer, then all meet)
     _join_writer_then_barrier(accelerator)
     if input_dir is None:
+        from .resilience.manifest import find_latest_valid_checkpoint
+
         if accelerator.project_dir is None:
             raise ValueError("pass input_dir or set project_dir on the Accelerator")
         checkpoints_dir = os.path.join(accelerator.project_dir, "checkpoints")
-        existing = _sorted_checkpoints(checkpoints_dir)
-        if not existing:
-            raise FileNotFoundError(f"no checkpoints under {checkpoints_dir}")
-        input_dir = existing[-1]
+        input_dir = find_latest_valid_checkpoint(checkpoints_dir)
+        if input_dir is None:
+            raise FileNotFoundError(f"no valid checkpoints under {checkpoints_dir}")
 
-    for i, model in enumerate(accelerator._models):
-        suffix = "" if i == 0 else f"_{i}"
-        flat = load_array_dict(os.path.join(input_dir, f"{MODEL_NAME}{suffix}"))
-        model.params = _restore_tree_like(model.params, flat)
-    for i, opt in enumerate(accelerator._optimizers):
-        suffix = "" if i == 0 else f"_{i}"
-        flat = load_array_dict(os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}"))
-        opt.opt_state = _restore_tree_like(opt.opt_state, flat)
+    from .resilience.manifest import read_manifest
+
+    manifest = read_manifest(input_dir)
+    if manifest is not None and manifest.get("kind") == "sharded":
+        from .resilience.distributed import restore_tree_from_pieces
+
+        load_piece = _piece_loader(input_dir)
+        arrays = manifest.get("arrays", {})
+        for i, model in enumerate(accelerator._models):
+            model.params = restore_tree_from_pieces(
+                model.params, arrays[f"{MODEL_NAME}_{i}"], load_piece
+            )
+        for i, opt in enumerate(accelerator._optimizers):
+            opt.opt_state = restore_tree_from_pieces(
+                opt.opt_state, arrays[f"{OPTIMIZER_NAME}_{i}"], load_piece
+            )
+    else:
+        for i, model in enumerate(accelerator._models):
+            suffix = "" if i == 0 else f"_{i}"
+            flat = load_array_dict(os.path.join(input_dir, f"{MODEL_NAME}{suffix}"))
+            model.params = _restore_tree_like(model.params, flat)
+        for i, opt in enumerate(accelerator._optimizers):
+            suffix = "" if i == 0 else f"_{i}"
+            flat = load_array_dict(os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}"))
+            opt.opt_state = _restore_tree_like(opt.opt_state, flat)
     for i, sched in enumerate(accelerator._schedulers):
         path = os.path.join(input_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin")
         with open(path, "rb") as f:
@@ -387,6 +794,20 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
         with open(rng_file, "rb") as f:
             _restore_rng_state(pickle.load(f))
     logger.info(f"Loaded state from {input_dir}")
+    _record_checkpoint_telemetry(
+        "restore",
+        input_dir,
+        {
+            "t0": t0,
+            "bytes": sum(f["bytes"] for f in manifest["files"].values()) if manifest else None,
+            "shard_count": (
+                sum(1 for d in os.listdir(input_dir) if d.startswith("shard_"))
+                if manifest is not None and manifest.get("kind") == "sharded"
+                else 0
+            ),
+            "is_async": False,
+        },
+    )
     return input_dir
 
 

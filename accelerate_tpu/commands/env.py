@@ -30,6 +30,17 @@ def env_command(args) -> int:
             if parse_flag_from_env("ACCELERATE_TELEMETRY")
             else "inactive (set ACCELERATE_TELEMETRY=1 or Accelerator(telemetry=True))"
         ),
+        "Fault tolerance": (
+            "active (ACCELERATE_FAULT_TOLERANCE=1)"
+            if parse_flag_from_env("ACCELERATE_FAULT_TOLERANCE")
+            else "inactive (set ACCELERATE_FAULT_TOLERANCE=1 or "
+            "Accelerator(fault_tolerance=FaultTolerancePlugin(...)))"
+        ),
+        "Auto-resume": (
+            "active (ACCELERATE_AUTO_RESUME)"
+            if parse_flag_from_env("ACCELERATE_AUTO_RESUME")
+            else "inactive (set ACCELERATE_AUTO_RESUME=1 or launch --auto-resume)"
+        ),
     }
     try:
         import flax

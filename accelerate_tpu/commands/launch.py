@@ -67,6 +67,14 @@ def launch_command_parser(subparsers=None):
         "runs get ACCELERATE_AUTO_RESUME=true so an Accelerator with a "
         "project_dir reloads the latest checkpoint after prepare()",
     )
+    p.add_argument(
+        "--auto-resume", "--auto_resume", dest="auto_resume",
+        action="store_true", default=None,
+        help="set ACCELERATE_AUTO_RESUME=true from the FIRST run (not just "
+        "restarts): the Accelerator resumes from the newest checkpoint "
+        "whose manifest validates, skipping corrupt/partial ones — the "
+        "resume half of the resilience subsystem's preemption flow",
+    )
     # misc
     p.add_argument("--debug", action="store_true", default=None, help="collective shape verification")
     p.add_argument("-m", "--module", action="store_true", help="script is a python module")
@@ -159,6 +167,8 @@ def simple_launcher(cmd: list[str], env: dict[str, str], max_restarts: int = 0) 
 def launch_command(args) -> int:
     cfg = _merge_args_into_config(args, _load_config(args))
     env = prepare_environment(args, cfg)
+    if getattr(args, "auto_resume", None):
+        env["ACCELERATE_AUTO_RESUME"] = "true"
 
     if args.pod:
         from .tpu import pod_fanout

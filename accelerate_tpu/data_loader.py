@@ -579,10 +579,29 @@ class DataLoaderShard(DataLoaderStateMixin):
     # -- stateful resume (reference StatefulDataLoader support,
     # ``data_loader.py:449``; sampler state in checkpoints :116-143) ---------
 
+    @property
+    def epoch(self) -> int:
+        """The epoch a resume would land in (alias of ``iteration`` for the
+        resilience tooling's position checks)."""
+        return self.iteration
+
+    @property
+    def position(self) -> int:
+        """Absolute batch position within the current epoch — what a
+        checkpoint records and what auto-resume restores. Between a
+        ``load_state_dict`` and the next ``__iter__`` this reports the
+        position the next iteration will resume FROM."""
+        if self._resume_skip:
+            return self.skip_batches + self._resume_skip
+        return self.batches_yielded
+
     def state_dict(self) -> dict:
         return {
             "iteration": self.iteration,
             "batches_yielded": self.batches_yielded,
+            # alias of batches_yielded under the resume-surface name, so
+            # external tooling reading checkpoints gets the documented key
+            "position": self.batches_yielded,
             "skip_batches": self.skip_batches,
         }
 
@@ -592,9 +611,8 @@ class DataLoaderShard(DataLoaderStateMixin):
         # batches_yielded counts the ABSOLUTE epoch position (including the
         # structural skip_batches this loader re-applies on every iter);
         # only the delta beyond that is the resume skip
-        self._resume_skip = max(
-            0, state.get("batches_yielded", 0) - self.skip_batches
-        )
+        position = state.get("batches_yielded", state.get("position", 0))
+        self._resume_skip = max(0, position - self.skip_batches)
 
 
 def to_global_array(batch, sharding):

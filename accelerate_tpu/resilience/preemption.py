@@ -1,0 +1,212 @@
+"""Preemption detection: signal handlers + GCE maintenance-event poller.
+
+TPU pods are preempted for boring reasons — maintenance events, spot VM
+reclamation — and the warning arrives as SIGTERM (or, ~60s earlier, on the
+GCE metadata server). The handler only *sets a flag*; the training loop
+observes it at step boundaries (``Accelerator.backward`` →
+``check_preemption``), reaches cross-host consensus with a tiny all-gather,
+and triggers ONE synchronized emergency ``save_state()`` followed by a
+clean exit with a sentinel file. Saving from inside a signal handler would
+race the step in flight; saving at the boundary is always consistent.
+
+A second SIGINT while the flag is already set restores the previous
+handler's behaviour (usually KeyboardInterrupt) so a user mashing Ctrl-C
+still gets out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any
+
+from ..logging import get_logger
+from .manifest import SENTINEL_NAME
+
+logger = get_logger(__name__)
+
+#: GCE metadata endpoint announcing host maintenance (returns ``NONE`` or
+#: ``TERMINATE_ON_HOST_MAINTENANCE``); absent outside GCE.
+GCE_MAINTENANCE_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/maintenance-event"
+)
+
+#: the handler currently owning the process signals (one per process; a new
+#: install replaces the previous one, Borg-style like AcceleratorState)
+_ACTIVE_HANDLER: "PreemptionHandler | None" = None
+
+
+def get_active_handler() -> "PreemptionHandler | None":
+    return _ACTIVE_HANDLER
+
+
+class PreemptionHandler:
+    """Installs SIGTERM/SIGINT handlers (and optionally a maintenance-event
+    poller thread) that raise a preemption flag for the training loop.
+
+    Args:
+        handle_sigint: also treat Ctrl-C as a preemption request (second
+            SIGINT falls through to the previous handler).
+        monitor_maintenance: poll the GCE metadata server for host
+            maintenance events on a daemon thread.
+        poll_seconds: maintenance poll interval.
+    """
+
+    def __init__(
+        self,
+        handle_sigint: bool = True,
+        monitor_maintenance: bool = False,
+        poll_seconds: float = 30.0,
+        handle_signals: bool = True,
+    ):
+        self.handle_signals = bool(handle_signals)
+        self.handle_sigint = bool(handle_sigint)
+        self.monitor_maintenance = bool(monitor_maintenance)
+        self.poll_seconds = float(poll_seconds)
+        self._flag = threading.Event()
+        self._reason: str | None = None
+        self._previous: dict[int, Any] = {}
+        self._poller: threading.Thread | None = None
+        self._stop_poller = threading.Event()
+        self._installed = False
+
+    # -- flag ---------------------------------------------------------------
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._flag.is_set()
+
+    @property
+    def reason(self) -> str | None:
+        return self._reason
+
+    def request_preemption(self, reason: str = "manual"):
+        """Raise the flag programmatically (tests; in-band watchdogs)."""
+        self._reason = reason
+        self._flag.set()
+
+    def reset(self):
+        self._flag.clear()
+        self._reason = None
+
+    # -- install/uninstall ---------------------------------------------------
+
+    def install(self) -> bool:
+        """Register the signal handlers. Signals can only be bound from the
+        main thread — elsewhere this degrades to flag-only operation (the
+        poller still works) and returns False."""
+        global _ACTIVE_HANDLER
+        if _ACTIVE_HANDLER is not None and _ACTIVE_HANDLER is not self:
+            _ACTIVE_HANDLER.uninstall()
+        _ACTIVE_HANDLER = self
+        ok = True
+        if not self._installed and self.handle_signals:
+            signals = [signal.SIGTERM]
+            if self.handle_sigint:
+                signals.append(signal.SIGINT)
+            try:
+                for sig in signals:
+                    self._previous[sig] = signal.signal(sig, self._on_signal)
+                self._installed = True
+            except ValueError:  # not the main thread
+                logger.warning(
+                    "PreemptionHandler.install() outside the main thread: "
+                    "signal handlers not registered (flag-only mode)"
+                )
+                ok = False
+        if self.monitor_maintenance and self._poller is None:
+            self._stop_poller.clear()
+            self._poller = threading.Thread(
+                target=self._poll_maintenance, name="preemption-poller", daemon=True
+            )
+            self._poller.start()
+        return ok
+
+    def uninstall(self):
+        global _ACTIVE_HANDLER
+        if self._installed:
+            for sig, previous in self._previous.items():
+                try:
+                    signal.signal(sig, previous)
+                except (ValueError, TypeError):
+                    pass
+            self._previous.clear()
+            self._installed = False
+        if self._poller is not None:
+            self._stop_poller.set()
+            self._poller = None
+        if _ACTIVE_HANDLER is self:
+            _ACTIVE_HANDLER = None
+
+    # -- signal path ---------------------------------------------------------
+
+    def _on_signal(self, signum, frame):
+        if self._flag.is_set() and signum == signal.SIGINT:
+            # second Ctrl-C: the user wants OUT, now — fall through
+            previous = self._previous.get(signum)
+            if callable(previous):
+                previous(signum, frame)
+                return
+            raise KeyboardInterrupt
+        self._reason = signal.Signals(signum).name
+        self._flag.set()
+        logger.warning(
+            "%s received — emergency checkpoint at the next step boundary",
+            self._reason,
+        )
+
+    def _poll_maintenance(self):
+        import urllib.request
+
+        while not self._stop_poller.wait(self.poll_seconds):
+            try:
+                req = urllib.request.Request(
+                    GCE_MAINTENANCE_URL, headers={"Metadata-Flavor": "Google"}
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    event = resp.read().decode().strip()
+            except Exception:
+                continue  # not on GCE / transient metadata failure
+            if event and event != "NONE":
+                self._reason = f"maintenance-event:{event}"
+                self._flag.set()
+                return
+
+    # -- cross-host agreement ------------------------------------------------
+
+    def consensus(self) -> bool:
+        """Do ANY hosts want to preempt? A tiny all-gather of the local
+        flag — collective, so every process must call it at the same step
+        boundary (the Accelerator's consensus cadence guarantees that).
+        Single-process: just the local flag."""
+        from ..state import PartialState
+
+        state = PartialState()
+        return state.consensus_any(self._flag.is_set())
+
+    # -- sentinel ------------------------------------------------------------
+
+    def write_sentinel(self, directory: str, checkpoint: str | None, step: int | None):
+        """Drop ``PREEMPTED.json`` next to the checkpoints: the restarted
+        run (and the operator) can see why the process exited and where the
+        emergency save landed."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, SENTINEL_NAME)
+        payload = {
+            "reason": self._reason or "preemption",
+            "checkpoint": checkpoint,
+            "step": step,
+            "pid": os.getpid(),
+            "timestamp": time.time(),
+        }
+        try:
+            with open(path, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            logger.warning("could not write preemption sentinel %s", path, exc_info=True)
+        return path

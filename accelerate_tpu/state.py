@@ -155,6 +155,21 @@ class PartialState:
 
             multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
 
+    def consensus_any(self, flag: bool) -> bool:
+        """Does ANY process assert ``flag``? A tiny all-gather of one int —
+        the primitive behind preemption consensus (resilience subsystem)
+        and any one-host-decides breaker. COLLECTIVE when multi-process:
+        every process must call it at the same point."""
+        if self.num_processes <= 1:
+            return bool(flag)
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            np.asarray([1 if flag else 0], dtype=np.int32)
+        )
+        return bool(np.asarray(gathered).any())
+
     @contextlib.contextmanager
     def main_process_first(self):
         """Main process runs the body before others (download-then-load idiom;

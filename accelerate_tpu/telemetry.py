@@ -41,7 +41,13 @@ Record schema (every record carries ``type`` and ``ts``):
                ``verify_rounds``.
 ``profile``  — ``trace_dir``, ``steps``, ``active_steps`` (one record per
                finished ``accelerator.profile()`` session).
-``event``    — free-form (``kind`` + fields), e.g. the ``prepare`` timing.
+``checkpoint`` — ``kind`` (``save``/``restore``), ``seconds``, ``bytes``,
+               ``shard_count``, ``async``, ``path`` (emitted by
+               ``checkpointing.py`` on every save/restore; async saves
+               report at commit time, so ``seconds`` spans snapshot →
+               durable rename).
+``event``    — free-form (``kind`` + fields), e.g. the ``prepare`` timing
+               and the ``preemption`` emergency-save marker.
 """
 
 from __future__ import annotations
@@ -131,6 +137,9 @@ class _NullTelemetry:
         pass
 
     def record_profile(self, *a, **k):
+        pass
+
+    def record_checkpoint(self, *a, **k):
         pass
 
     def record_event(self, *a, **k):
@@ -447,6 +456,31 @@ class TelemetryRecorder:
                 "trace_dir": trace_dir,
                 "steps": int(steps),
                 "active_steps": int(active_steps),
+            },
+            step=self.optimizer_step_count,
+        )
+
+    def record_checkpoint(
+        self,
+        kind: str,
+        seconds: float | None = None,
+        bytes_written: int | None = None,
+        shard_count: int | None = None,
+        is_async: bool = False,
+        path: str | None = None,
+    ):
+        """One record per checkpoint save/restore (fed by
+        ``checkpointing.py``): how long, how many bytes, how many per-host
+        shard dirs, and whether the write rode the async writer."""
+        self._emit(
+            {
+                "type": "checkpoint",
+                "kind": kind,
+                "seconds": None if seconds is None else float(seconds),
+                "bytes": None if bytes_written is None else int(bytes_written),
+                "shard_count": None if shard_count is None else int(shard_count),
+                "async": bool(is_async),
+                "path": path,
             },
             step=self.optimizer_step_count,
         )

@@ -1,0 +1,119 @@
+"""``make ckpt-smoke``: the save → kill → auto-resume round-trip on a CPU
+mesh, as a single CI-signal script (exit code 0 = the committed-checkpoint
+invariant and auto-resume both held).
+
+Phase 1 (child, ``train`` mode): a fault-tolerant Accelerator trains a toy
+regression; at step 3 the process sends itself SIGTERM (standing in for a
+TPU preemption notice). The handler's flag fires at the next step
+boundary → ONE emergency ``save_state()`` → clean exit 143 with a
+``PREEMPTED.json`` sentinel.
+
+Phase 2 (parent): asserts the checkpoints dir holds exactly one committed,
+manifest-valid checkpoint and no partial ``.tmp`` was promoted.
+
+Phase 3 (child, ``resume`` mode): ``ACCELERATE_AUTO_RESUME=1`` — a fresh
+Accelerator restores inside ``prepare()`` and reports the restored step
+counter, which must be the 3 optimizer steps phase 1 completed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+KILL_AT_STEP = 3
+
+
+def child(mode: str, project_dir: str) -> int:
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, FaultTolerancePlugin, ProjectConfiguration
+
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True
+        ),
+        fault_tolerance=FaultTolerancePlugin(),
+    )
+    model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+    if mode == "resume":
+        # auto-resume already fired inside prepare()
+        print(f"RESUMED_STEP {acc.step}", flush=True)
+        return 0
+
+    x = np.linspace(-1, 1, 32).astype(np.float32)
+    y = (2 * x + 3).astype(np.float32)
+    for i in range(10):
+        if i == KILL_AT_STEP:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)  # simulated preemption
+        out = model(x=x, y=y)
+        acc.backward(out.loss)  # boundary check fires here at i == KILL_AT_STEP
+        opt.step()
+        opt.zero_grad()
+        acc.step += 1
+    print("ERROR: trained past the preemption", flush=True)
+    return 1
+
+
+def main() -> int:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="ckpt_smoke_")
+    project_dir = os.path.join(tmp, "proj")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+
+    rc = subprocess.run(
+        [sys.executable, __file__, "train", project_dir], env=env, timeout=600
+    )
+    assert rc.returncode == 143, f"expected clean preemption exit 143, got {rc.returncode}"
+
+    from accelerate_tpu.checkpointing import _sorted_checkpoints
+    from accelerate_tpu.resilience.manifest import SENTINEL_NAME, validate_checkpoint
+
+    checkpoints_dir = os.path.join(project_dir, "checkpoints")
+    names = sorted(os.listdir(checkpoints_dir))
+    committed = _sorted_checkpoints(checkpoints_dir)
+    assert len(committed) == 1, f"expected exactly one committed checkpoint, got {names}"
+    assert not any(n.endswith(".tmp") for n in names), f"a .tmp was left committed-looking: {names}"
+    ok, reason = validate_checkpoint(committed[0])
+    assert ok, f"emergency checkpoint failed validation: {reason}"
+    sentinel = json.load(open(os.path.join(checkpoints_dir, SENTINEL_NAME)))
+    assert sentinel["step"] == KILL_AT_STEP, sentinel
+
+    env["ACCELERATE_AUTO_RESUME"] = "1"
+    out = subprocess.run(
+        [sys.executable, __file__, "resume", project_dir],
+        env=env, timeout=600, capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    resumed_step = next(
+        int(line.split()[1]) for line in out.stdout.splitlines()
+        if line.startswith("RESUMED_STEP")
+    )
+    assert resumed_step == KILL_AT_STEP, f"resumed at step {resumed_step}, saved at {KILL_AT_STEP}"
+
+    manifest = json.load(open(os.path.join(committed[0], "manifest.json")))
+    print(
+        f"ckpt-smoke OK: SIGTERM at step {KILL_AT_STEP} → emergency save "
+        f"({manifest['kind']}, {sum(f['bytes'] for f in manifest['files'].values())} bytes, "
+        f"exit 143) → auto-resume restored step {resumed_step}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] in ("train", "resume"):
+        sys.exit(child(sys.argv[1], sys.argv[2]))
+    sys.exit(main())

@@ -79,3 +79,8 @@ def reset_state():
     from accelerate_tpu.parallel.pipeline import set_default_microbatches
 
     set_default_microbatches(0)
+    from accelerate_tpu.resilience.preemption import get_active_handler
+
+    handler = get_active_handler()
+    if handler is not None:  # restore the process signal handlers
+        handler.uninstall()

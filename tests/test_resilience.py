@@ -1,0 +1,717 @@
+"""Fault-tolerance subsystem: manifest validation, atomic commit,
+preemption handling, auto-resume, IO retries (``accelerate_tpu/resilience``).
+
+The committed-checkpoint invariant under test throughout: a checkpoint
+directory either exists completely (manifest validates) or not at all
+(only ever a ``.tmp`` that discovery ignores) — a SIGKILL mid-save must
+never produce a loadable-looking partial directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import random
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, FaultTolerancePlugin, ProjectConfiguration
+from accelerate_tpu.checkpointing import _ASYNC_SAVE, _rotate_checkpoints, _sorted_checkpoints
+from accelerate_tpu.resilience.manifest import (
+    SENTINEL_NAME,
+    build_manifest,
+    find_latest_valid_checkpoint,
+    validate_checkpoint,
+    write_manifest,
+)
+from accelerate_tpu.resilience.preemption import PreemptionHandler, get_active_handler
+from accelerate_tpu.resilience.retry import run_with_retries
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Loader:
+    def __init__(self, dataset, batch_size):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = False
+        self.sampler = None
+        self.batch_sampler = None
+        self.collate_fn = None
+
+
+# ---------------------------------------------------------------------------
+# satellite: _sorted_checkpoints robustness
+# ---------------------------------------------------------------------------
+
+
+def test_sorted_checkpoints_skips_non_numeric_entries(tmp_path):
+    """A leftover ``checkpoint_12.tmp`` from an interrupted save (or any
+    stray ``checkpoint_*`` name) must be skipped, not ``int()``-ed into a
+    ValueError."""
+    for name in ("checkpoint_3", "checkpoint_12.tmp", "checkpoint_abc",
+                 "checkpoint_1", "checkpoint_"):
+        (tmp_path / name).mkdir()
+    result = _sorted_checkpoints(str(tmp_path))
+    assert [os.path.basename(p) for p in result] == ["checkpoint_1", "checkpoint_3"]
+
+
+# ---------------------------------------------------------------------------
+# manifest validation
+# ---------------------------------------------------------------------------
+
+
+def _fake_checkpoint(path, payload=b"x" * 256):
+    os.makedirs(path)
+    with open(os.path.join(path, "model.safetensors"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(path, "accelerator_state.json"), "w") as f:
+        json.dump({"step": 1}, f)
+    write_manifest(str(path), build_manifest(str(path), kind="gathered", step=1))
+
+
+def test_manifest_validation_rejects_truncation_and_corruption(tmp_path):
+    ckpt = tmp_path / "checkpoint_0"
+    _fake_checkpoint(str(ckpt))
+    ok, reason = validate_checkpoint(str(ckpt))
+    assert ok, reason
+
+    # truncation → size mismatch
+    model_file = ckpt / "model.safetensors"
+    model_file.write_bytes(b"x" * 10)
+    ok, reason = validate_checkpoint(str(ckpt))
+    assert not ok and "size mismatch" in reason
+
+    # same-size bit rot → checksum mismatch
+    model_file.write_bytes(b"y" * 256)
+    ok, reason = validate_checkpoint(str(ckpt))
+    assert not ok and "checksum mismatch" in reason
+
+    # missing file
+    model_file.unlink()
+    ok, reason = validate_checkpoint(str(ckpt))
+    assert not ok and "missing" in reason
+
+    # a .tmp dir is never valid, manifest or not
+    tmp_ckpt = tmp_path / "checkpoint_1.tmp"
+    _fake_checkpoint(str(tmp_ckpt))
+    ok, reason = validate_checkpoint(str(tmp_ckpt))
+    assert not ok and ".tmp" in reason
+
+
+def test_find_latest_valid_skips_corrupt_for_previous(tmp_path):
+    """Auto-resume selection: newest checkpoint is corrupt → fall back to
+    the previous valid one; interrupted ``.tmp`` dirs are invisible."""
+    _fake_checkpoint(str(tmp_path / "checkpoint_0"))
+    _fake_checkpoint(str(tmp_path / "checkpoint_1"))
+    (tmp_path / "checkpoint_2.tmp").mkdir()  # interrupted save leftover
+    # corrupt the newest committed one
+    (tmp_path / "checkpoint_1" / "model.safetensors").write_bytes(b"z")
+    chosen = find_latest_valid_checkpoint(str(tmp_path))
+    assert chosen is not None and os.path.basename(chosen) == "checkpoint_0"
+
+    # corrupt that too → nothing valid
+    (tmp_path / "checkpoint_0" / "model.safetensors").unlink()
+    assert find_latest_valid_checkpoint(str(tmp_path)) is None
+
+
+def test_legacy_checkpoint_without_manifest_accepted(tmp_path):
+    ckpt = tmp_path / "checkpoint_0"
+    ckpt.mkdir()
+    (ckpt / "accelerator_state.json").write_text(json.dumps({"step": 2}))
+    ok, reason = validate_checkpoint(str(ckpt))
+    assert ok and "legacy" in reason
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+# ---------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    sleeps: list[float] = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("stale NFS handle")
+        return "ok"
+
+    assert run_with_retries(flaky, attempts=4, backoff=0.25, sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [0.25, 0.5]  # exponential
+
+
+def test_retry_exhausts_and_raises():
+    def always_fails():
+        raise OSError("gone")
+
+    with pytest.raises(OSError, match="gone"):
+        run_with_retries(always_fails, attempts=3, backoff=0.0)
+
+
+def test_retry_does_not_catch_programming_errors():
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        run_with_retries(buggy, attempts=5, backoff=0.0)
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption handler
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_handler_flag_and_uninstall(tmp_path):
+    previous = signal.getsignal(signal.SIGTERM)
+    handler = PreemptionHandler(handle_sigint=False)
+    try:
+        assert handler.install()
+        assert get_active_handler() is handler
+        assert not handler.preemption_requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.preemption_requested
+        assert handler.reason == "SIGTERM"
+        sentinel = handler.write_sentinel(str(tmp_path), "/ck/checkpoint_3", step=7)
+        payload = json.loads(open(sentinel).read())
+        assert payload["reason"] == "SIGTERM" and payload["step"] == 7
+    finally:
+        handler.uninstall()
+    assert get_active_handler() is None
+    assert signal.getsignal(signal.SIGTERM) == previous
+
+
+def test_fault_tolerance_plugin_env_hydration(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_FT_SHARDED_IO", "false")
+    monkeypatch.setenv("ACCELERATE_FT_IO_ATTEMPTS", "7")
+    monkeypatch.setenv("ACCELERATE_FT_CONSENSUS_INTERVAL", "16")
+    plugin = FaultTolerancePlugin()
+    assert plugin.sharded_io is False
+    assert plugin.io_attempts == 7
+    assert plugin.consensus_interval == 16
+
+
+def test_launch_parser_accepts_auto_resume():
+    from accelerate_tpu.commands.launch import launch_command_parser
+
+    parser = launch_command_parser()
+    args = parser.parse_args(["--auto-resume", "train.py"])
+    assert args.auto_resume is True
+    args = parser.parse_args(["train.py"])
+    assert args.auto_resume is None
+
+
+# ---------------------------------------------------------------------------
+# sharded piece collection / restore (no files, no Accelerator)
+# ---------------------------------------------------------------------------
+
+
+def test_collect_and_restore_pieces_same_and_cross_sharding():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from accelerate_tpu.resilience.distributed import (
+        collect_addressable_pieces,
+        restore_tree_from_pieces,
+    )
+
+    devices = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devices, ("x",))
+    value = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(value, NamedSharding(mesh, PartitionSpec("x")))
+    replicated = jax.device_put(np.float32(3.5), NamedSharding(mesh, PartitionSpec()))
+    tree = {"w": sharded, "s": replicated}
+
+    pieces, table = collect_addressable_pieces(tree)
+    # 8 one-row pieces of w (one per device) + 1 deduplicated scalar piece
+    assert sum(1 for k in pieces if k.startswith("w::")) == 8
+    assert sum(1 for k in pieces if k.startswith("s::")) == 1
+    assert table["w"]["global_shape"] == [8, 8]
+
+    def load_piece(piece):
+        return pieces[piece["piece"]]
+
+    # same-sharding fast path
+    restored = restore_tree_from_pieces(tree, table, load_piece)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), value)
+    assert float(restored["s"]) == 3.5
+
+    # cross-sharding: restore onto a 2-way sharding (gather-from-manifest)
+    mesh2 = Mesh(devices.reshape(2, 4), ("a", "b"))
+    target = {
+        "w": jax.device_put(np.zeros((8, 8), np.float32), NamedSharding(mesh2, PartitionSpec("b"))),
+        "s": jax.device_put(np.float32(0), NamedSharding(mesh2, PartitionSpec())),
+    }
+    restored2 = restore_tree_from_pieces(target, table, load_piece)
+    np.testing.assert_array_equal(np.asarray(restored2["w"]), value)
+    assert restored2["w"].sharding.spec == PartitionSpec("b")
+
+
+def test_assemble_rejects_partial_single_piece():
+    """A lone piece that does NOT cover the full array (torn multi-host
+    checkpoint) must raise, never hand back np.empty garbage."""
+    from accelerate_tpu.resilience.distributed import _assemble_full
+
+    data = {"w::p0": np.ones((2, 4), np.float32)}
+    entry = {
+        "global_shape": [4, 4],
+        "dtype": "float32",
+        "pieces": [{"piece": "w::p0", "offsets": [[0, 2], [0, 4]]}],
+    }
+    with pytest.raises(ValueError, match="cover"):
+        _assemble_full(entry, lambda p: data[p["piece"]])
+    # the same piece covering the whole array is fine
+    entry_full = {
+        "global_shape": [2, 4],
+        "dtype": "float32",
+        "pieces": [{"piece": "w::p0", "offsets": [[0, 2], [0, 4]]}],
+    }
+    np.testing.assert_array_equal(
+        _assemble_full(entry_full, lambda p: data[p["piece"]]), data["w::p0"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# rotation vs pending async writes
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_never_deletes_pending_async_checkpoint(tmp_path):
+    for i in range(4):
+        (tmp_path / f"checkpoint_{i}").mkdir()
+    pending = str(tmp_path / "checkpoint_0")
+    _ASYNC_SAVE["pending_dirs"].add(pending)
+    try:
+        _rotate_checkpoints(str(tmp_path), total_limit=2)
+    finally:
+        _ASYNC_SAVE["pending_dirs"].discard(pending)
+    remaining = sorted(d for d in os.listdir(tmp_path) if d.startswith("checkpoint_"))
+    # the pending one survives even though it is oldest; enough others go
+    assert "checkpoint_0" in remaining
+    assert "checkpoint_1" not in remaining and "checkpoint_2" not in remaining
+
+
+# ---------------------------------------------------------------------------
+# end-to-end (in-process): emergency save, validated auto-resume, telemetry
+# ---------------------------------------------------------------------------
+
+
+def _fresh_accelerator(**kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _step(accelerator, model, opt, x, y):
+    out = model(x=x, y=y)
+    accelerator.backward(out.loss)
+    opt.step()
+    opt.zero_grad()
+    accelerator.step += 1
+
+
+def test_sigterm_triggers_emergency_save_and_clean_exit(tmp_path):
+    config = ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True)
+    accelerator = _fresh_accelerator(
+        project_config=config, fault_tolerance=FaultTolerancePlugin(exit_code=143)
+    )
+    try:
+        model, opt = accelerator.prepare(RegressionModel(a=1.0, b=2.0), optax.adam(0.05))
+        x = np.arange(16, dtype=np.float32)
+        y = 2 * x + 3
+        _step(accelerator, model, opt, x, y)
+        os.kill(os.getpid(), signal.SIGTERM)  # simulated preemption notice
+        assert accelerator.preemption_requested
+        with pytest.raises(SystemExit) as exc:
+            _step(accelerator, model, opt, x, y)
+        assert exc.value.code == 143
+    finally:
+        if accelerator._preemption_handler is not None:
+            accelerator._preemption_handler.uninstall()
+
+    checkpoints_dir = tmp_path / "checkpoints"
+    names = sorted(os.listdir(checkpoints_dir))
+    assert "checkpoint_0" in names and SENTINEL_NAME in names
+    ok, reason = validate_checkpoint(str(checkpoints_dir / "checkpoint_0"))
+    assert ok, reason
+    sentinel = json.loads((checkpoints_dir / SENTINEL_NAME).read_text())
+    assert sentinel["reason"] == "SIGTERM" and sentinel["step"] == 1
+
+
+def test_preemption_defers_until_accumulation_window_closes(tmp_path):
+    """Mid-window (parked loss / accumulated grads) the emergency save is
+    deferred — acting there would drop the partial gradient window."""
+    config = ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True)
+    accelerator = _fresh_accelerator(
+        project_config=config,
+        fault_tolerance=FaultTolerancePlugin(handle_signals=False),
+    )
+    try:
+        model, opt = accelerator.prepare(RegressionModel(a=1.0, b=2.0), optax.sgd(0.1))
+        accelerator._preemption_handler.request_preemption("test")
+        opt._grads = {"a": np.zeros(()), "b": np.zeros(())}  # mid-window
+        accelerator.check_preemption()  # deferred: no SystemExit
+        opt._grads = None  # window closed
+        with pytest.raises(SystemExit):
+            accelerator.check_preemption()
+    finally:
+        accelerator._preemption_handler.uninstall()
+
+
+def test_auto_resume_skips_corrupt_checkpoint_for_valid_one(tmp_path, monkeypatch):
+    """The full loop: two saves, newest corrupted on disk → a fresh
+    fault-tolerant Accelerator resumes from the OLDER valid one."""
+    config = ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True)
+    accelerator = _fresh_accelerator(project_config=config)
+    model, opt = accelerator.prepare(RegressionModel(a=1.0, b=2.0), optax.adam(0.05))
+    x = np.arange(16, dtype=np.float32)
+    y = 2 * x + 3
+    _step(accelerator, model, opt, x, y)
+    accelerator.save_state(sharded=True)  # checkpoint_0
+    good = {k: np.asarray(v) for k, v in model.params.items()}
+    _step(accelerator, model, opt, x, y)
+    accelerator.save_state(sharded=True)  # checkpoint_1
+
+    # corrupt the newest: flip bytes in its shard file, keep the size
+    ck1 = tmp_path / "checkpoints" / "checkpoint_1"
+    shard_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(ck1)
+        for f in files
+        if f.startswith("model")
+    ]
+    assert shard_files
+    data = bytearray(open(shard_files[0], "rb").read())
+    data[-8:] = b"\xff" * 8
+    open(shard_files[0], "wb").write(bytes(data))
+
+    resumed = _fresh_accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+        fault_tolerance=FaultTolerancePlugin(handle_signals=False),
+    )
+    try:
+        model2, opt2 = resumed.prepare(RegressionModel(a=0.0, b=0.0), optax.adam(0.05))
+    finally:
+        if resumed._preemption_handler is not None:
+            resumed._preemption_handler.uninstall()
+    assert resumed.step == 1  # checkpoint_0's step, not checkpoint_1's
+    for k in good:
+        np.testing.assert_array_equal(np.asarray(model2.params[k]), good[k])
+
+
+def test_commit_into_existing_dir_preserves_unrelated_content(tmp_path):
+    """Non-automatic naming resolves save_state to ``checkpoints/`` itself:
+    the commit must merge-overwrite there, never delete unrelated content
+    (a pending sentinel, user files) the way a wholesale replace would."""
+    accelerator = _fresh_accelerator(project_dir=str(tmp_path))
+    model, opt = accelerator.prepare(RegressionModel(a=1.0, b=2.0), optax.adam(0.05))
+    ckdir = tmp_path / "checkpoints"
+    ckdir.mkdir()
+    (ckdir / SENTINEL_NAME).write_text("{}")
+    (ckdir / "user_notes.txt").write_text("keep me")
+    out = accelerator.save_state()
+    assert os.path.samefile(out, ckdir)
+    assert (ckdir / SENTINEL_NAME).exists() and (ckdir / "user_notes.txt").exists()
+    ok, reason = validate_checkpoint(str(ckdir), check_crc=True)
+    assert ok, reason
+    accelerator.save_state()  # overwrite-in-place round-trips too
+    accelerator.load_state(str(ckdir))
+
+
+def test_checkpoint_telemetry_records_save_and_restore(tmp_path):
+    accelerator = _fresh_accelerator(project_dir=str(tmp_path), telemetry=True)
+    model, opt = accelerator.prepare(RegressionModel(a=1.0, b=2.0), optax.adam(0.05))
+    out = accelerator.save_state(str(tmp_path / "ck"), sharded=True)
+    accelerator.load_state(out)
+    records = [json.loads(line) for line in open(accelerator.telemetry.jsonl_path)]
+    ckpt_records = [r for r in records if r["type"] == "checkpoint"]
+    kinds = [r["kind"] for r in ckpt_records]
+    assert "save" in kinds and "restore" in kinds
+    save = next(r for r in ckpt_records if r["kind"] == "save")
+    assert save["bytes"] > 0 and save["shard_count"] == 1 and save["seconds"] > 0
+    accelerator.telemetry.close()
+
+
+def test_sharded_save_resume_trajectory_identical(tmp_path):
+    """6 straight steps == save@3 (sharded) → fresh accelerator → resume →
+    3 more, with the dataloader position coming back from the checkpoint."""
+
+    def build():
+        accelerator = _fresh_accelerator()
+        return accelerator, *accelerator.prepare(
+            RegressionModel(), optax.adam(0.05), _Loader(RegressionDataset(length=96), 16)
+        )
+
+    def train(accelerator, model, opt, dl, n):
+        it = iter(dl)
+        for _ in range(n):
+            batch = next(it)
+            out = model(**batch)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+
+    acc1, m1, o1, d1 = build()
+    train(acc1, m1, o1, d1, 6)
+    straight = {k: np.asarray(v) for k, v in m1.params.items()}
+
+    acc2, m2, o2, d2 = build()
+    train(acc2, m2, o2, d2, 3)
+    acc2.save_state(str(tmp_path / "mid"), sharded=True)
+
+    acc3, m3, o3, d3 = build()
+    acc3.load_state(str(tmp_path / "mid"))
+    assert d3.position == 3  # restored mid-epoch position, no manual skip
+    train(acc3, m3, o3, d3, 3)
+    for k in straight:
+        np.testing.assert_allclose(np.asarray(m3.params[k]), straight[k], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# subprocess invariants (slow lane): kill -9 mid-save, SIGTERM mid-training,
+# atexit draining
+# ---------------------------------------------------------------------------
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+_KILL_DURING_SAVE_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    import numpy as np, optax
+    from accelerate_tpu import Accelerator, ProjectConfiguration
+    from accelerate_tpu.test_utils import RegressionModel
+    import accelerate_tpu.checkpointing as ckpt
+
+    project_dir = sys.argv[1]
+    acc = Accelerator(project_config=ProjectConfiguration(
+        project_dir=project_dir, automatic_checkpoint_naming=True))
+    model, opt = acc.prepare(RegressionModel(a=1.0, b=2.0), optax.adam(0.05))
+    x = np.arange(16, dtype=np.float32)
+    out = model(x=x, y=2 * x + 3)
+    acc.backward(out.loss)
+    opt.step(); opt.zero_grad()
+    acc.save_state()            # checkpoint_0: committed, valid
+    acc.step = 99
+
+    real = ckpt.save_array_dict
+    def slow_save(flat, path, safe):
+        real(flat, path, safe)
+        print("MID_WRITE", flush=True)   # parent kills us here
+        time.sleep(60)
+    ckpt.save_array_dict = slow_save
+    acc.save_state()            # checkpoint_1: killed mid-write
+    print("UNREACHABLE", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_kill_during_save_never_leaves_partial_checkpoint(tmp_path):
+    """SIGKILL mid-write: the interrupted save exists only as a ``.tmp``,
+    discovery skips it, and auto-resume selects the previous committed
+    checkpoint."""
+    script = tmp_path / "victim.py"
+    script.write_text(_KILL_DURING_SAVE_SCRIPT)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(tmp_path / "proj")],
+        env=_subprocess_env(),
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        for line in proc.stdout:
+            if "MID_WRITE" in line:
+                proc.kill()  # SIGKILL: no handlers, no cleanup
+                break
+            assert "UNREACHABLE" not in line
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    checkpoints_dir = str(tmp_path / "proj" / "checkpoints")
+    names = sorted(os.listdir(checkpoints_dir))
+    assert "checkpoint_1" not in names, "partial save must never be committed"
+    assert "checkpoint_1.tmp" in names, f"expected interrupted .tmp, got {names}"
+    assert [os.path.basename(p) for p in _sorted_checkpoints(checkpoints_dir)] == ["checkpoint_0"]
+    chosen = find_latest_valid_checkpoint(checkpoints_dir)
+    assert chosen is not None and os.path.basename(chosen) == "checkpoint_0"
+    meta = json.loads(open(os.path.join(chosen, "accelerator_state.json")).read())
+    assert meta["step"] != 99  # the pre-kill state, not the doomed save's
+
+
+_KILL_RESUME_SCRIPT = textwrap.dedent(
+    """
+    import hashlib, json, os, pickle, random, signal, sys
+    import numpy as np, optax
+    from accelerate_tpu import Accelerator, FaultTolerancePlugin, ProjectConfiguration
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+    mode, project_dir, out_path = sys.argv[1:4]
+
+    class Loader:
+        def __init__(self, dataset, batch_size):
+            self.dataset = dataset
+            self.batch_size = batch_size
+            self.drop_last = False
+            self.sampler = None
+            self.batch_sampler = None
+            self.collate_fn = None
+
+    def rng_fingerprint():
+        return {
+            "python": hashlib.sha256(pickle.dumps(random.getstate())).hexdigest(),
+            "numpy": hashlib.sha256(pickle.dumps(np.random.get_state())).hexdigest(),
+        }
+
+    random.seed(1234); np.random.seed(5678)
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True),
+        fault_tolerance=FaultTolerancePlugin(),
+    )
+    model, opt, dl = acc.prepare(
+        RegressionModel(), optax.adam(0.05), Loader(RegressionDataset(length=96), 16))
+
+    if mode == "resume":
+        # auto-resume already fired inside prepare()
+        report = {
+            "step": acc.step,
+            "dl_position": dl.position,
+            "rng": rng_fingerprint(),
+        }
+        json.dump(report, open(out_path, "w"))
+        sys.exit(0)
+
+    it = iter(dl)
+    for i in range(6):
+        if i == 3 and mode == "train":
+            # completed exactly 3 optimizer steps; record ground truth,
+            # then the preemption notice arrives
+            json.dump(
+                {"dl_position_at_kill": dl.batches_yielded, "step_at_kill": acc.step,
+                 "rng": rng_fingerprint()},
+                open(out_path, "w"))
+            os.kill(os.getpid(), signal.SIGTERM)
+        batch = next(it)
+        out = model(**batch)
+        acc.backward(out.loss)   # i==3: boundary check fires here -> save+exit
+        opt.step(); opt.zero_grad()
+        acc.step += 1
+    print("FINISHED_ALL_STEPS", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_sigterm_mid_training_emergency_save_then_auto_resume(tmp_path):
+    """The acceptance invariant end-to-end, across real processes:
+    SIGTERM mid-training → synchronized emergency save + clean exit 143 →
+    a fresh auto-resume process restores step counter, RNG, and dataloader
+    position to within one optimizer step (the one fetched-but-unstepped
+    batch), never touching a ``.tmp``."""
+    project_dir = str(tmp_path / "proj")
+    script = tmp_path / "job.py"
+    script.write_text(_KILL_RESUME_SCRIPT)
+    train_report = tmp_path / "train.json"
+    rc = subprocess.run(
+        [sys.executable, str(script), "train", project_dir, str(train_report)],
+        env=_subprocess_env(),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert rc.returncode == 143, rc.stderr[-2000:]
+    assert "FINISHED_ALL_STEPS" not in rc.stdout
+
+    checkpoints_dir = os.path.join(project_dir, "checkpoints")
+    names = sorted(os.listdir(checkpoints_dir))
+    assert SENTINEL_NAME in names
+    committed = _sorted_checkpoints(checkpoints_dir)
+    assert len(committed) == 1
+    assert not any(n.endswith(".tmp") for n in names)
+    ok, reason = validate_checkpoint(committed[0])
+    assert ok, reason
+
+    truth = json.loads(train_report.read_text())
+    assert truth["step_at_kill"] == 3
+
+    resume_report = tmp_path / "resume.json"
+    rc = subprocess.run(
+        [sys.executable, str(script), "resume", project_dir, str(resume_report)],
+        env=_subprocess_env(),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    resumed = json.loads(resume_report.read_text())
+    # step counter and RNG restore exactly; the dataloader is within one
+    # batch of the kill point (batch 3 was fetched but its step never ran)
+    assert resumed["step"] == truth["step_at_kill"]
+    assert resumed["rng"] == truth["rng"]
+    assert resumed["dl_position"] == truth["dl_position_at_kill"] + 1
+    # the sentinel was consumed by the successful resume
+    assert not os.path.exists(os.path.join(checkpoints_dir, SENTINEL_NAME))
+
+
+_ATEXIT_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import numpy as np, optax
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = Accelerator()
+    model, opt = acc.prepare(RegressionModel(a=4.0, b=1.0), optax.sgd(0.1))
+    acc.save_state(sys.argv[1], async_save=True)
+    sys.exit(0)   # no wait_for_checkpoint: atexit must drain + commit
+    """
+)
+
+
+@pytest.mark.slow
+def test_atexit_joins_and_commits_inflight_async_save(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    script = tmp_path / "exiter.py"
+    script.write_text(_ATEXIT_SCRIPT)
+    rc = subprocess.run(
+        [sys.executable, str(script), ckpt],
+        env=_subprocess_env(),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert os.path.isdir(ckpt), "async save abandoned at interpreter exit"
+    assert not os.path.isdir(ckpt + ".tmp")
+    ok, reason = validate_checkpoint(ckpt)
+    assert ok, reason
