@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -25,3 +25,6 @@ telemetry-smoke:  ## 5-step toy loop with telemetry on; asserts the JSONL trail 
 
 ckpt-smoke:       ## save -> SIGTERM mid-training -> auto-resume round-trip on a CPU mesh
 	python benchmarks/ckpt_smoke.py
+
+trace-smoke:      ## 20-step loop with diagnostics on; asserts the merged trace validates + watchdog quiet
+	python benchmarks/trace_smoke.py

@@ -15,6 +15,7 @@ from .utils.dataclasses import (
     ContextParallelPlugin,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
+    DiagnosticsPlugin,
     DistributedType,
     FaultTolerancePlugin,
     FullyShardedDataParallelPlugin,
@@ -92,6 +93,10 @@ def __getattr__(name):
         from .resilience.preemption import PreemptionHandler
 
         return PreemptionHandler
+    if name in ("Tracer", "Watchdog", "NULL_TRACER", "trace_span", "get_tracer"):
+        from . import diagnostics
+
+        return getattr(diagnostics, name)
     if name == "wait_for_checkpoint":
         from .checkpointing import wait_for_checkpoint
 

@@ -40,6 +40,7 @@ from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DeepSpeedPlugin,
+    DiagnosticsPlugin,
     DistributedDataParallelKwargs,
     DistributedType,
     FaultTolerancePlugin,
@@ -207,6 +208,7 @@ class Accelerator:
         use_seedable_sampler: bool = False,
         telemetry: bool | None = None,
         fault_tolerance: FaultTolerancePlugin | bool | None = None,
+        diagnostics: DiagnosticsPlugin | bool | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -510,6 +512,54 @@ class Accelerator:
             set_active_recorder(None)
             set_compile_callback(None)
 
+        # diagnostics (tracing + hang watchdog, diagnostics/): opt-in via
+        # the constructor or ACCELERATE_DIAGNOSTICS=1; same Borg takeover
+        # semantics as telemetry — the newest Accelerator owns the
+        # process-wide tracer/watchdog
+        from .diagnostics import NULL_TRACER, Tracer, Watchdog, get_tracer, set_active_tracer
+        from .diagnostics.watchdog import get_active_watchdog
+
+        if diagnostics is None:
+            diagnostics = parse_flag_from_env("ACCELERATE_DIAGNOSTICS")
+        if diagnostics is True:
+            diagnostics = DiagnosticsPlugin()
+        elif diagnostics is False:
+            diagnostics = None
+        self.diagnostics_plugin: DiagnosticsPlugin | None = diagnostics
+        self.tracer = NULL_TRACER
+        self.watchdog = None
+        stale_watchdog = get_active_watchdog()
+        if stale_watchdog is not None:
+            stale_watchdog.stop()
+        stale_tracer = get_tracer()
+        if stale_tracer:
+            # flush+close BEFORE a new tracer appends its clock_sync: the
+            # old instance's buffered events must not land after the new
+            # epoch marker, or the merge shifts them with the wrong offset
+            stale_tracer.close()
+        if diagnostics is not None and diagnostics.tracing:
+            self.tracer = Tracer(
+                logging_dir=self.logging_dir,
+                buffer_events=diagnostics.trace_buffer_events,
+            )
+            set_active_tracer(self.tracer)
+        else:
+            set_active_tracer(None)
+        if diagnostics is not None and diagnostics.watchdog:
+            self.watchdog = Watchdog(
+                logging_dir=self.logging_dir,
+                multiplier=diagnostics.watchdog_multiplier,
+                floor_seconds=diagnostics.watchdog_floor_seconds,
+                check_interval_seconds=diagnostics.watchdog_check_seconds,
+                ema_alpha=diagnostics.watchdog_ema_alpha,
+                heartbeat_interval_seconds=diagnostics.heartbeat_interval_seconds,
+                grace_seconds=diagnostics.watchdog_grace_seconds,
+                telemetry_tail=diagnostics.watchdog_telemetry_tail,
+                preempt_on_hang=diagnostics.preempt_on_hang,
+                telemetry=self.telemetry if self.telemetry else None,
+            )
+            self.watchdog.start()
+
         # fault tolerance (resilience subsystem): opt-in via the
         # constructor, ACCELERATE_FAULT_TOLERANCE=1, or — so launcher
         # restarts are preemption-safe too — ACCELERATE_AUTO_RESUME=1
@@ -712,6 +762,14 @@ class Accelerator:
         ``accelerator.py:1225``). Pass any combination of models
         (:class:`Model` / flax module+params), optax transformations,
         dataloaders and schedule fns; order is preserved."""
+        from .diagnostics.tracing import trace_span
+
+        # the module-level entry point (not self.tracer.span) so a
+        # watchdog-only configuration still sees prepare as live progress
+        with trace_span("prepare", n_objects=len(args)):
+            return self._prepare_inner(*args, device_placement=device_placement)
+
+    def _prepare_inner(self, *args, device_placement: list[bool] | None = None):
         import time as _time
 
         _prepare_t0 = _time.perf_counter()
@@ -863,6 +921,10 @@ class Accelerator:
         plugin = self.fault_tolerance_plugin
         reason = handler.reason or "preemption"
         logger.warning("preemption consensus (%s): emergency checkpoint", reason)
+        if self.watchdog is not None:
+            # the emergency save may legitimately take longer than a step
+            # deadline; a hang report fired *during* the save would be noise
+            self.watchdog.stop()
         checkpoint = None
         if plugin.save_on_preemption:
             if self.project_dir is None:
@@ -882,6 +944,9 @@ class Accelerator:
                 "preemption", reason=reason, checkpoint=checkpoint, step=self.step
             )
             self.telemetry.close()
+        if self.tracer:
+            self.tracer.instant("preemption", reason=reason)
+            self.tracer.close()
         sentinel_dir = (
             os.path.join(self.project_dir, "checkpoints")
             if self.project_dir is not None
@@ -1026,6 +1091,10 @@ class Accelerator:
             wrapped.comm_hook = (self._grad_comm_hook, self.mesh)
         if self.telemetry:
             wrapped.telemetry = self.telemetry
+        if self.tracer:
+            wrapped.tracer = self.tracer
+        if self.watchdog is not None:
+            wrapped.watchdog = self.watchdog
         self._optimizers.append(wrapped)
         return wrapped
 
@@ -1088,10 +1157,13 @@ class Accelerator:
             # step boundary: the previous step is fully applied, this one
             # hasn't staged yet — the one consistent point to emergency-save
             self.check_preemption()
-        if self.telemetry:
-            self._backward_instrumented(loss)
-            return
-        self._backward_core(loss)
+        from .diagnostics.tracing import trace_span
+
+        with trace_span("backward/dispatch"):
+            if self.telemetry:
+                self._backward_instrumented(loss)
+                return
+            self._backward_core(loss)
 
     def _backward_core(self, loss):
         opt = self._fusable_optimizer(loss)
@@ -1517,6 +1589,9 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.finish()
         self.telemetry.close()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.tracer.close()
         if self._preemption_handler is not None:
             self._preemption_handler.uninstall()
         from .checkpointing import _join_writer_then_barrier

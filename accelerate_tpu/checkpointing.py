@@ -30,6 +30,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from .diagnostics.tracing import traced
 from .logging import get_logger
 from .utils.imports import is_safetensors_available
 
@@ -487,6 +488,10 @@ def _rotate_checkpoints(checkpoints_dir: str, total_limit: int, incoming: int = 
         excess -= 1
 
 
+# diagnostics spans around the checkpoint entry points (an async save's
+# span covers the snapshot+dispatch half; the background writes report
+# through the checkpoint telemetry record at commit time)
+@traced("checkpoint/save")
 def save_accelerator_state(
     accelerator,
     output_dir: str | None = None,
@@ -708,6 +713,7 @@ def _piece_loader(input_dir: str):
     return load_piece
 
 
+@traced("checkpoint/restore")
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """(Reference ``load_accelerator_state`` ``checkpointing.py:165``.)
 

@@ -41,6 +41,12 @@ def env_command(args) -> int:
             if parse_flag_from_env("ACCELERATE_AUTO_RESUME")
             else "inactive (set ACCELERATE_AUTO_RESUME=1 or launch --auto-resume)"
         ),
+        "Diagnostics": (
+            "active (ACCELERATE_DIAGNOSTICS=1)"
+            if parse_flag_from_env("ACCELERATE_DIAGNOSTICS")
+            else "inactive (set ACCELERATE_DIAGNOSTICS=1 or "
+            "Accelerator(diagnostics=True) for tracing + hang watchdog)"
+        ),
     }
     try:
         import flax

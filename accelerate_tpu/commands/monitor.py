@@ -1,0 +1,96 @@
+"""``accelerate-tpu monitor`` + ``accelerate-tpu trace`` — the operator
+surface of the diagnostics subsystem.
+
+* ``monitor <logging_dir>`` tails the telemetry JSONL and the per-host
+  heartbeat files into a live terminal summary (step rate, MFU, per-host
+  lag, recompiles, hang reports). Pure file reads — works on a wedged or
+  dead run, and from any machine that can see the logging dir.
+* ``trace merge <logging_dir>`` fuses ``traces/host_*.trace.json`` into
+  one Perfetto/``chrome://tracing``-loadable timeline with host-clock-
+  offset correction.
+
+Neither command imports jax — they must run on a laptop against a synced
+logging dir without a TPU (or any accelerator) in sight.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def monitor_command(args) -> int:
+    from ..diagnostics.monitor import collect_status, render_status
+
+    logging_dir = args.logging_dir
+    if not os.path.isdir(logging_dir):
+        print(f"monitor: {logging_dir} is not a directory", file=sys.stderr)
+        return 1
+    try:
+        while True:
+            status = collect_status(logging_dir)
+            text = render_status(status)
+            if args.once:
+                print(text)
+                return 2 if (status["wedged"] or status["hang_reports"]) else 0
+            # repaint in place: clear screen + home, like `watch`
+            sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def trace_merge_command(args) -> int:
+    from ..diagnostics.tracing import merge_traces, validate_chrome_trace
+
+    trace_dir = args.logging_dir
+    # accept either the logging dir or its traces/ subdir directly
+    subdir = os.path.join(trace_dir, "traces")
+    if os.path.isdir(subdir):
+        trace_dir = subdir
+    output = args.output or os.path.join(trace_dir, "merged.trace.json")
+    try:
+        trace = merge_traces(trace_dir, output_path=output)
+    except FileNotFoundError as e:
+        print(f"trace merge: {e}", file=sys.stderr)
+        return 1
+    validate_chrome_trace(trace)
+    hosts = trace["metadata"]["merged_hosts"]
+    print(
+        f"merged {len(trace['traceEvents'])} events from "
+        f"{len(hosts) or '?'} host(s) -> {output}\n"
+        f"open in https://ui.perfetto.dev or chrome://tracing"
+    )
+    return 0
+
+
+def add_parser(subparsers):
+    monitor = subparsers.add_parser(
+        "monitor", help="Live terminal status of a training run's logging dir"
+    )
+    monitor.add_argument("logging_dir", help="the run's logging/project dir")
+    monitor.add_argument(
+        "--interval", type=float, default=2.0, help="refresh period in seconds"
+    )
+    monitor.add_argument(
+        "--once",
+        action="store_true",
+        help="print one snapshot and exit (exit code 2 when a host is "
+        "wedged or a hang report exists — scriptable health check)",
+    )
+    monitor.set_defaults(func=monitor_command)
+
+    trace = subparsers.add_parser(
+        "trace", help="Operate on diagnostics trace files"
+    )
+    trace_sub = trace.add_subparsers(dest="trace_command")
+    merge = trace_sub.add_parser(
+        "merge",
+        help="fuse per-host trace files into one Perfetto-loadable timeline",
+    )
+    merge.add_argument("logging_dir", help="the run's logging dir (or its traces/ subdir)")
+    merge.add_argument("-o", "--output", default=None, help="merged output path")
+    merge.set_defaults(func=trace_merge_command)
+    return monitor

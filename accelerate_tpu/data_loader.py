@@ -37,6 +37,7 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 import jax
 import numpy as np
 
+from .diagnostics.tracing import trace_span
 from .logging import get_logger
 from .state import GradientState, PartialState
 from .utils.random import synchronize_rng_states
@@ -551,8 +552,17 @@ class DataLoaderShard(DataLoaderStateMixin):
             it = itertools.islice(it, skip, None)
         use_thread = self.prefetch_batches > 0 and self._prefetch_safe
         stream = self._prefetched(it) if use_thread else self._synchronous(it)
+        _DONE = object()
         try:
-            for batch, is_last in stream:
+            while True:
+                # span = time the training loop BLOCKS waiting on data (on
+                # the prefetch path a warm queue makes this ~0; a fat span
+                # here reads "input-bound" on the flame graph)
+                with trace_span("dataloader/fetch", prefetch=use_thread):
+                    item = next(stream, _DONE)
+                if item is _DONE:
+                    break
+                batch, is_last = item
                 if is_last:
                     self.end_of_dataloader = True
                     if self.gradient_state.sync_with_dataloader:

@@ -1,0 +1,56 @@
+"""Diagnostics subsystem: distributed tracing, hang watchdog, live monitor.
+
+The observability ladder this package completes (ROADMAP: production
+traffic, "fast as the hardware allows"):
+
+1. **telemetry** (PR 1, :mod:`accelerate_tpu.telemetry`) — aggregate
+   counters and percentiles: *how fast is the loop*.
+2. **tracing** (:mod:`.tracing`) — per-host Chrome/Perfetto span timelines
+   over prepare/compile/step/dataloader/collectives/checkpoints: *where a
+   step's time went*, mergeable across hosts with clock-offset correction.
+3. **watchdog** (:mod:`.watchdog`) — a deadline armed around every step;
+   on expiry, ``HANG_REPORT_<host>.json`` with all-thread stacks and the
+   open span stack, heartbeat files naming the straggler, and optionally
+   the resilience subsystem's emergency-save path: *why nothing is
+   happening and who is responsible*.
+4. **monitor** (:mod:`.monitor`, ``accelerate-tpu monitor``) — a live
+   terminal view over the artifacts the other three write.
+
+Enable with ``Accelerator(diagnostics=True)`` (or a configured
+:class:`~accelerate_tpu.utils.dataclasses.DiagnosticsPlugin`, or
+``ACCELERATE_DIAGNOSTICS=1``). Disabled, every ``trace_span`` call site
+costs one global read + a shared no-op context manager, and the watchdog
+call sites cost a ``None`` check.
+"""
+
+from .tracing import (
+    NULL_TRACER,
+    Tracer,
+    get_tracer,
+    merge_traces,
+    parse_trace_file,
+    set_active_tracer,
+    trace_instant,
+    trace_span,
+    traced,
+    validate_chrome_trace,
+)
+from .watchdog import Watchdog, get_active_watchdog
+from .monitor import collect_status, render_status
+
+__all__ = [
+    "NULL_TRACER",
+    "Tracer",
+    "Watchdog",
+    "collect_status",
+    "get_active_watchdog",
+    "get_tracer",
+    "merge_traces",
+    "parse_trace_file",
+    "render_status",
+    "set_active_tracer",
+    "trace_instant",
+    "trace_span",
+    "traced",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,189 @@
+"""Live run status from a ``logging_dir`` — the `accelerate-tpu monitor`
+engine.
+
+Everything here reads the observability artifacts the training processes
+already write (telemetry JSONL, heartbeat files, hang reports) — the
+monitor never talks to the job, so it works on a run that is wedged, from
+a different machine over a shared filesystem, or post-mortem on a dead
+one. Pure functions (collect → render) so tests and other tooling can
+consume the status dict directly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any
+
+from .watchdog import HEARTBEAT_SUBDIR
+
+#: a heartbeat older than max(multiplier · host EMA, floor) flags the host
+STALE_FLOOR_S = 30.0
+STALE_MULTIPLIER = 10.0
+#: a live host this many steps behind the front-runner is named a straggler
+STRAGGLER_LAG_STEPS = 10
+
+
+def _tail_jsonl(path: str, max_records: int = 500) -> list[dict]:
+    """Last ``max_records`` parsed records of a JSONL file without reading
+    a multi-GB trail into memory (bounded backward seek)."""
+    records: list[dict] = []
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            # ~300 bytes/record is generous; clamp the read window
+            window = min(size, max_records * 512)
+            f.seek(size - window)
+            chunk = f.read().decode("utf-8", errors="replace")
+        lines = chunk.splitlines()
+        if window < size and lines:
+            lines = lines[1:]  # first line may be torn by the seek
+        for line in lines[-max_records:]:
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    except OSError:
+        pass
+    return records
+
+
+def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]:
+    """One snapshot of run health:
+
+    * ``steps``/``step_rate``/``mfu``/``tokens_per_sec``/``recompiles`` from
+      the telemetry JSONL tail (main-process trail),
+    * per-host ``hosts`` entries from the heartbeat files, each with
+      ``lag_steps`` (behind the front-runner) and ``stale_s``,
+    * ``stragglers`` / ``wedged`` — hosts behind on steps / heartbeat-silent
+      beyond their own deadline,
+    * ``hang_reports`` — any ``HANG_REPORT_*.json`` with its stalled phase.
+    """
+    now = time.time() if now is None else now
+    status: dict[str, Any] = {
+        "logging_dir": logging_dir,
+        "ts": now,
+        "steps": None,
+        "optimizer_steps": None,
+        "step_time_s": None,
+        "step_rate": None,
+        "examples_per_sec": None,
+        "tokens_per_sec": None,
+        "mfu": None,
+        "recompiles": None,
+        "last_record_age_s": None,
+        "hosts": [],
+        "stragglers": [],
+        "wedged": [],
+        "hang_reports": [],
+    }
+
+    # -- telemetry tail ------------------------------------------------------
+    jsonl = os.path.join(logging_dir, "telemetry", "telemetry.jsonl")
+    records = _tail_jsonl(jsonl)
+    steps = [r for r in records if r.get("type") == "step"]
+    if steps:
+        last = steps[-1]
+        status["steps"] = last.get("step")
+        status["optimizer_steps"] = last.get("optimizer_steps")
+        status["recompiles"] = last.get("recompiles")
+        recent = steps[-20:]
+        times = [r["step_time_s"] for r in recent if r.get("step_time_s")]
+        if times:
+            times.sort()
+            median = times[len(times) // 2]
+            status["step_time_s"] = median
+            status["step_rate"] = 1.0 / median if median > 0 else None
+        for key in ("examples_per_sec", "tokens_per_sec", "mfu"):
+            vals = [r[key] for r in recent if r.get(key) is not None]
+            if vals:
+                status[key] = vals[-1]
+        if last.get("ts"):
+            status["last_record_age_s"] = max(0.0, now - float(last["ts"]))
+
+    # -- heartbeats ----------------------------------------------------------
+    hb_glob = os.path.join(logging_dir, HEARTBEAT_SUBDIR, "heartbeat_*.json")
+    hosts: list[dict] = []
+    for path in sorted(glob.glob(hb_glob)):
+        try:
+            with open(path) as f:
+                hb = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        hb["stale_s"] = max(0.0, now - float(hb.get("ts", 0.0)))
+        hosts.append(hb)
+    max_step = max((h.get("step") or 0 for h in hosts), default=0)
+    for h in hosts:
+        h["lag_steps"] = max_step - (h.get("step") or 0)
+        ema = h.get("ema_step_s")
+        deadline = max(STALE_MULTIPLIER * ema, STALE_FLOOR_S) if ema else STALE_FLOOR_S
+        if h["stale_s"] > deadline or h.get("fired"):
+            status["wedged"].append(h["host"])
+        elif h["lag_steps"] > STRAGGLER_LAG_STEPS:
+            status["stragglers"].append(h["host"])
+    status["hosts"] = hosts
+
+    # -- hang reports --------------------------------------------------------
+    for path in sorted(glob.glob(os.path.join(logging_dir, "HANG_REPORT_*.json"))):
+        try:
+            with open(path) as f:
+                report = json.load(f)
+            status["hang_reports"].append(
+                {
+                    "path": path,
+                    "host": report.get("host"),
+                    "stalled_phase": report.get("stalled_phase"),
+                    "elapsed_s": report.get("elapsed_s"),
+                    "ts": report.get("ts"),
+                }
+            )
+        except (OSError, json.JSONDecodeError):
+            status["hang_reports"].append({"path": path})
+    return status
+
+
+def _fmt(value, pattern="{:.3g}", none="-") -> str:
+    return none if value is None else pattern.format(value)
+
+
+def render_status(status: dict[str, Any]) -> str:
+    """The terminal summary `accelerate-tpu monitor` repaints."""
+    lines = [
+        f"accelerate-tpu monitor — {status['logging_dir']}",
+        f"  steps {_fmt(status['steps'], '{}')} "
+        f"(opt {_fmt(status['optimizer_steps'], '{}')})   "
+        f"step {_fmt(status['step_time_s'], '{:.4f}')}s   "
+        f"rate {_fmt(status['step_rate'], '{:.2f}')}/s   "
+        f"recompiles {_fmt(status['recompiles'], '{}')}",
+        f"  throughput: {_fmt(status['examples_per_sec'], '{:.1f}')} ex/s   "
+        f"{_fmt(status['tokens_per_sec'], '{:.0f}')} tok/s   "
+        f"MFU {_fmt(status['mfu'], '{:.1%}')}   "
+        f"last record {_fmt(status['last_record_age_s'], '{:.0f}')}s ago",
+    ]
+    if status["hosts"]:
+        lines.append(f"  hosts ({len(status['hosts'])}):")
+        for h in status["hosts"]:
+            marks = []
+            if h["host"] in status["wedged"]:
+                marks.append("WEDGED")
+            if h["host"] in status["stragglers"]:
+                marks.append("STRAGGLER")
+            if h.get("fired"):
+                marks.append("watchdog-fired")
+            lines.append(
+                f"    host {h.get('host')}: step {h.get('step')} "
+                f"(lag {h.get('lag_steps')})  heartbeat {h['stale_s']:.0f}s ago  "
+                f"ema {_fmt(h.get('ema_step_s'), '{:.3f}')}s"
+                + ("   [" + ", ".join(marks) + "]" if marks else "")
+            )
+    else:
+        lines.append("  hosts: no heartbeat files (diagnostics off or run not started)")
+    for r in status["hang_reports"]:
+        lines.append(
+            f"  !! HANG host {r.get('host')}: stalled in "
+            f"{r.get('stalled_phase') or '?'} after {_fmt(r.get('elapsed_s'), '{:.0f}')}s "
+            f"— {r['path']}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,531 @@
+"""Span-based distributed tracing — Chrome/Perfetto ``trace_event`` JSON.
+
+PR 1's telemetry answers "how fast is the loop" in aggregates; it cannot
+answer "where did step 412 spend its 90 ms" or "which host's collective is
+the one everybody else is waiting in". This module adds the causal layer:
+lightweight spans around the framework's hot phases — ``prepare()``, the
+AOT trace/lower/compile phases in :mod:`accelerate_tpu.lazy`, ``backward``
+dispatch vs device-blocked time, dataloader fetch, the eager collectives in
+:mod:`accelerate_tpu.operations`, and checkpoint save/restore — emitted as
+Chrome ``trace_event`` records so a whole training step renders as a flame
+graph in Perfetto / ``chrome://tracing``.
+
+File contract (crash-safety first, like the telemetry JSONL):
+
+* one file per host: ``{logging_dir}/traces/host_<n>.trace.json``
+* JSON *array format*: a ``[`` line followed by one event object per line,
+  each terminated by ``,\n`` and flushed — Perfetto and ``chrome://tracing``
+  both accept a trailing comma / missing ``]``, so a SIGKILL'd run's trace
+  is loadable as-is.  ``accelerate-tpu trace merge`` additionally fuses the
+  per-host files into one well-formed timeline.
+* event ``ts``/``dur`` are **monotonic** microseconds (``perf_counter``);
+  a ``clock_sync`` metadata event records this host's wall-minus-monotonic
+  offset so the merge tool can place all hosts on one wall-clock axis
+  (host-clock-offset correction).
+
+The disabled path is a single module-global read returning a shared no-op
+context manager — cheap enough to leave ``trace_span`` calls in every hot
+path unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+#: file name pattern for per-host traces (the merge tool globs on this)
+TRACE_FILE_PATTERN = "host_{host}.trace.json"
+TRACE_SUBDIR = "traces"
+
+
+def _host_index() -> int:
+    """This process's host index without forcing backend init: prefer an
+    initialized PartialState, fall back to the launcher's env."""
+    try:
+        from ..state import PartialState
+
+        if PartialState._shared_state:  # don't *create* state just to trace
+            return int(PartialState().process_index)
+    except Exception:
+        pass
+    return int(os.environ.get("ACCELERATE_PROCESS_INDEX", os.environ.get("JAX_PROCESS_INDEX", 0)))
+
+
+class _NullSpan:
+    """Shared no-op context manager held by the disabled tracer."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, **attrs):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullTracer:
+    """Disabled-mode tracer: ``bool()`` is False, spans are the shared
+    no-op (mirrors telemetry's NULL_TELEMETRY contract)."""
+
+    enabled = False
+
+    def __bool__(self):
+        return False
+
+    def span(self, name, **attrs):
+        return _NULL_SPAN
+
+    def instant(self, name, **attrs):
+        pass
+
+    def counter(self, name, value):
+        pass
+
+    def open_spans(self):
+        return {}
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_TRACER = _NullTracer()
+
+#: process-wide active tracer (Borg like telemetry's active recorder): free
+#: functions (lazy.py, operations.py, data_loader.py) trace through this
+_ACTIVE_TRACER: "_NullTracer | Tracer" = NULL_TRACER
+
+
+def get_tracer():
+    return _ACTIVE_TRACER
+
+
+def set_active_tracer(tracer) -> None:
+    global _ACTIVE_TRACER
+    _ACTIVE_TRACER = tracer if tracer is not None else NULL_TRACER
+
+
+class _Span:
+    """One open span: records entry on ``__enter__``, emits a complete
+    Chrome ``ph:"X"`` event on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_tid")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+        self._tid = 0
+
+    def set_attr(self, **attrs):
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._tid = threading.get_ident()
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        self._tracer._pop(self)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._emit_complete(self.name, self._t0, t1 - self._t0, self.attrs)
+        return False
+
+
+class Tracer:
+    """Per-host Chrome ``trace_event`` writer with an open-span registry.
+
+    Args:
+        logging_dir: root under which ``traces/host_<n>.trace.json`` is
+            appended. ``None`` disables the file sink (spans still maintain
+            the open-span registry the watchdog dumps into hang reports).
+        host: process index used as the trace ``pid``; default resolves
+            from ``PartialState``/env.
+        buffer_events: batch this many events per write+flush (1 = flush
+            every event, the crash-safest; the default batches a little to
+            keep the hot path cheap without risking more than a step's
+            worth of spans on a crash).
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        logging_dir: str | None = None,
+        host: int | None = None,
+        buffer_events: int = 16,
+    ):
+        self.host = _host_index() if host is None else int(host)
+        self._file = None
+        self.path = None
+        self._lock = threading.Lock()
+        self._buffer: list[str] = []
+        self._buffer_events = max(1, int(buffer_events))
+        #: thread ident -> list of open _Span (innermost last); read by the
+        #: watchdog from ITS thread, so mutations hold the GIL-atomic list
+        #: ops only (append/remove) and readers copy
+        self._open: dict[int, list] = {}
+        self._closed = False
+
+        if logging_dir is not None:
+            trace_dir = os.path.join(logging_dir, TRACE_SUBDIR)
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                self.path = os.path.join(
+                    trace_dir, TRACE_FILE_PATTERN.format(host=self.host)
+                )
+                fresh = not os.path.exists(self.path)
+                self._file = open(self.path, "a")
+                if fresh:
+                    self._file.write("[\n")
+            except OSError:
+                logger.warning("tracing disabled: cannot write under %s", trace_dir, exc_info=True)
+                self._file = None
+                self.path = None
+        # metadata: name the process after the host, and record the
+        # wall-vs-monotonic clock offset the merge tool corrects with
+        self._write_event(
+            {
+                "name": "process_name", "ph": "M", "pid": self.host, "tid": 0,
+                "args": {"name": f"host_{self.host}"},
+            },
+            flush=True,
+        )
+        self.clock_offset_s = time.time() - time.perf_counter()
+        self._write_event(
+            {
+                "name": "clock_sync", "ph": "M", "pid": self.host, "tid": 0,
+                "args": {"wall_minus_mono_s": self.clock_offset_s, "pid_os": os.getpid()},
+            },
+            flush=True,
+        )
+        # crash paths must not lose the buffered tail (same contract as the
+        # telemetry recorder's atexit close; close() unregisters)
+        import atexit
+
+        atexit.register(self.close)
+
+    # -- span surface --------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> _Span:
+        return _Span(self, name, attrs)
+
+    def instant(self, name: str, **attrs):
+        """A zero-duration marker (``ph:"i"``) — recompiles, preemption
+        flags, watchdog firings."""
+        self._write_event(
+            {
+                "name": name, "ph": "i", "s": "p",
+                "ts": time.perf_counter() * 1e6,
+                "pid": self.host, "tid": threading.get_ident(),
+                "args": attrs,
+            }
+        )
+
+    def counter(self, name: str, value: float):
+        self._write_event(
+            {
+                "name": name, "ph": "C",
+                "ts": time.perf_counter() * 1e6,
+                "pid": self.host, "tid": threading.get_ident(),
+                "args": {"value": value},
+            }
+        )
+
+    def open_spans(self) -> dict[int, list[dict]]:
+        """Snapshot of currently-open spans per thread (outermost first) —
+        the watchdog writes this into hang reports to name the stalled
+        phase."""
+        now = time.perf_counter()
+        out: dict[int, list[dict]] = {}
+        for tid, stack in list(self._open.items()):
+            frames = [
+                {
+                    "name": s.name,
+                    "age_s": now - s._t0,
+                    "attrs": dict(s.attrs),
+                }
+                for s in list(stack)
+            ]
+            if frames:
+                out[tid] = frames
+        return out
+
+    # -- internals -----------------------------------------------------------
+
+    def _push(self, span: _Span):
+        self._open.setdefault(span._tid, []).append(span)
+        wd = _active_watchdog()
+        if wd is not None:
+            wd.touch(span.name)
+
+    def _pop(self, span: _Span):
+        stack = self._open.get(span._tid)
+        if stack is not None:
+            try:
+                stack.remove(span)
+            except ValueError:
+                pass
+        wd = _active_watchdog()
+        if wd is not None:
+            wd.touch(None)
+
+    def _emit_complete(self, name: str, t0: float, dur: float, attrs: dict):
+        event = {
+            "name": name, "ph": "X",
+            "ts": t0 * 1e6, "dur": dur * 1e6,
+            "pid": self.host, "tid": threading.get_ident(),
+        }
+        if attrs:
+            event["args"] = attrs
+        self._write_event(event)
+
+    def _write_event(self, event: dict, flush: bool = False):
+        if self._file is None:
+            return
+        try:
+            line = json.dumps(event, default=str) + ",\n"
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            if self._file is None:
+                return
+            self._buffer.append(line)
+            if flush or len(self._buffer) >= self._buffer_events:
+                self._drain_locked()
+
+    def _drain_locked(self):
+        if self._file is None or not self._buffer:
+            self._buffer.clear()
+            return
+        try:
+            self._file.write("".join(self._buffer))
+            self._file.flush()
+        except (OSError, ValueError):
+            pass
+        self._buffer.clear()
+
+    def flush(self):
+        with self._lock:
+            self._drain_locked()
+
+    def close(self):
+        """Idempotent; leaves the file in the same trailing-comma format a
+        crash would (the array format tolerates it, merge normalizes it)."""
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        with self._lock:
+            self._drain_locked()
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+        global _ACTIVE_TRACER
+        if _ACTIVE_TRACER is self:
+            _ACTIVE_TRACER = NULL_TRACER
+
+
+def _active_watchdog():
+    from .watchdog import get_active_watchdog
+
+    return get_active_watchdog()
+
+
+class _TouchSpan:
+    """Watchdog-only span: no trace file, but span entry/exit still defers
+    the hang deadline and names the phase — so ``tracing=False,
+    watchdog=True`` doesn't false-fire on a long first compile."""
+
+    __slots__ = ("_wd", "_name")
+
+    def __init__(self, wd, name: str):
+        self._wd = wd
+        self._name = name
+
+    def __enter__(self):
+        self._wd.touch(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        self._wd.touch(None)
+        return False
+
+    def set_attr(self, **attrs):
+        pass
+
+
+def trace_span(name: str, **attrs):
+    """Module-level span entry point for the instrumented hot paths:
+    ``with trace_span("collective/gather"): ...``. Routes through the
+    process-wide active tracer; with only the watchdog active the span
+    still feeds it progress/phase signals; fully disabled this is two
+    global reads returning a shared no-op context manager."""
+    tracer = _ACTIVE_TRACER
+    if tracer:
+        return tracer.span(name, **attrs)
+    wd = _active_watchdog()
+    if wd is not None:
+        return _TouchSpan(wd, name)
+    return _NULL_SPAN
+
+
+def trace_instant(name: str, **attrs):
+    _ACTIVE_TRACER.instant(name, **attrs)
+
+
+def traced(name: str | None = None):
+    """Decorator form of :func:`trace_span` — wrap every call to the
+    function in a span named ``name`` (default: the function's name). The
+    shared implementation behind the collective and checkpoint wrappers."""
+    import functools
+
+    def deco(fn):
+        span_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with trace_span(span_name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# per-host trace parsing + merge (the `accelerate-tpu trace merge` engine)
+# ---------------------------------------------------------------------------
+
+
+def parse_trace_file(path: str) -> list[dict]:
+    """Lenient line-oriented parse of the append-format trace file: skips
+    the ``[``/``]`` bracket lines and any torn tail line a crash left."""
+    events: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip().rstrip(",")
+                if not line or line in ("[", "]"):
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail from a crash mid-write
+                if isinstance(event, dict):
+                    events.append(event)
+    except OSError:
+        pass
+    return events
+
+
+def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
+    """Fuse ``host_*.trace.json`` files into ONE Perfetto-loadable timeline.
+
+    Every host's events carry monotonic timestamps with an arbitrary origin;
+    each file's ``clock_sync`` metadata records that host's wall-minus-
+    monotonic offset. The merge shifts every host onto the wall clock
+    (``ts + offset``), then rebases the union so the earliest event sits at
+    t=0 — cross-host skew is then exactly the wall-clock skew between
+    hosts, which is what a straggler investigation wants to see.
+
+    Returns the merged trace dict (``{"traceEvents": [...]}``); when
+    ``output_path`` is given it is also written there as well-formed JSON.
+    """
+    import glob as _glob
+
+    paths = sorted(_glob.glob(os.path.join(trace_dir, "host_*.trace.json")))
+    if not paths:
+        raise FileNotFoundError(f"no host_*.trace.json under {trace_dir}")
+
+    merged: list[dict] = []
+    offsets: dict[int, float] = {}
+    for path in paths:
+        events = parse_trace_file(path)
+        # A file can hold SEVERAL monotonic epochs: the tracer appends
+        # across restarts (auto-resume in the same logging_dir), and each
+        # restart writes a fresh clock_sync with its own perf_counter
+        # origin. Offsets therefore apply SEQUENTIALLY — every event uses
+        # the most recent clock_sync above it, so a resumed run's spans
+        # land at their true wall-clock position, not the dead process's.
+        offset_us = 0.0  # until the first clock_sync (legacy/foreign files)
+        for e in events:
+            if e.get("ph") == "M":
+                if e.get("name") == "clock_sync":
+                    offset_us = float(e["args"]["wall_minus_mono_s"]) * 1e6
+                    host = e.get("pid")
+                    if host is not None:
+                        offsets[int(host)] = offset_us / 1e6  # last epoch wins
+                    continue  # consumed; per-host process_name survives
+                merged.append(e)
+                continue
+            e = dict(e)
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + offset_us
+            merged.append(e)
+
+    timed = [e for e in merged if "ts" in e]
+    t0 = min((float(e["ts"]) for e in timed), default=0.0)
+    for e in timed:
+        e["ts"] = float(e["ts"]) - t0
+    merged.sort(key=lambda e: float(e.get("ts", 0.0)))
+
+    trace = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merged_hosts": sorted(offsets),
+            "clock_offsets_s": {str(h): o for h, o in sorted(offsets.items())},
+            "t0_wall_s": t0 / 1e6,
+        },
+    }
+    if output_path is not None:
+        tmp = output_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, output_path)
+    return trace
+
+
+def validate_chrome_trace(trace: dict | list) -> None:
+    """Raise ValueError unless ``trace`` is loadable by Perfetto /
+    ``chrome://tracing`` (schema check used by tests and trace-smoke)."""
+    events = trace.get("traceEvents") if isinstance(trace, dict) else trace
+    if not isinstance(events, list) or not events:
+        raise ValueError("trace has no traceEvents")
+    for e in events:
+        if not isinstance(e, dict):
+            raise ValueError(f"non-object event: {e!r}")
+        if "ph" not in e or "name" not in e:
+            raise ValueError(f"event missing ph/name: {e!r}")
+        if e["ph"] in ("X", "B", "E", "i", "C") and "ts" not in e:
+            raise ValueError(f"timed event missing ts: {e!r}")
+        if e["ph"] == "X" and "dur" not in e:
+            raise ValueError(f"complete event missing dur: {e!r}")

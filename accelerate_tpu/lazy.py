@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .diagnostics.tracing import get_tracer as _get_tracer, trace_span as _trace_span
+
 
 # ---------------------------------------------------------------------------
 # graph nodes
@@ -474,11 +476,18 @@ def get_compile_callback():
 def _compile_facts(jitted, args, label: str) -> tuple:
     """AOT-compile one signature, timing trace+lower and compile separately
     and extracting the program's static cost facts: XLA-cost-model FLOPs /
-    bytes accessed, and collective bytes parsed from the compiled HLO."""
+    bytes accessed, and collective bytes parsed from the compiled HLO.
+
+    The phases are wrapped in diagnostics spans (``compile/trace_lower``,
+    ``compile/compile``) and the facts carry the phases' raw *monotonic*
+    timestamps (``mono``) so telemetry's compile records line up with the
+    trace timeline, not just the wall clock."""
     t0 = time.perf_counter()
-    lowered = jitted.lower(*args)
+    with _trace_span("compile/trace_lower", label=label):
+        lowered = jitted.lower(*args)
     t1 = time.perf_counter()
-    compiled = lowered.compile()
+    with _trace_span("compile/compile", label=label):
+        compiled = lowered.compile()
     t2 = time.perf_counter()
     try:
         stats = compiled.cost_analysis() or {}
@@ -490,6 +499,7 @@ def _compile_facts(jitted, args, label: str) -> tuple:
         "label": label,
         "lower_s": t1 - t0,
         "compile_s": t2 - t1,
+        "mono": {"lower_start": t0, "compile_start": t1, "compile_end": t2},
         "flops": stats.get("flops"),
         "bytes_accessed": stats.get("bytes accessed"),
         "collective_bytes": None,
@@ -514,7 +524,9 @@ def _cost_aware_jit(fn, donate_argnums=(), label=""):
 
     def call(*args):
         callback = _COMPILE_CALLBACK
-        if not (_COLLECT_COSTS or callback is not None):
+        # an active tracer also wants the explicit AOT path: it is what
+        # separates trace/lower/compile into spans a flame graph shows
+        if not (_COLLECT_COSTS or callback is not None) and not _get_tracer():
             return jitted(*args)
         # every leaf participates: truncating the signature would hand
         # a cached executable mismatched avals if two calls differ only

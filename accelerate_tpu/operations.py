@@ -32,9 +32,19 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .diagnostics.tracing import traced
 from .utils.compat import axis_size
 
 P = PartitionSpec
+
+
+def _traced_collective(function: Callable):
+    """Span-wrap an eager collective: these are the host-blocking
+    rendezvous points where a multi-host hang actually *sits*, so the open
+    span names the culprit op in watchdog hang reports and the merged
+    timeline shows which host entered the collective late (the
+    straggler)."""
+    return traced(f"collective/{function.__name__}")(function)
 
 
 class DistributedOperationException(Exception):
@@ -196,6 +206,7 @@ def _materialize(t: jax.Array | np.ndarray) -> np.ndarray | jax.Array:
 
 
 @verify_operation
+@_traced_collective
 def gather(tensor: Any):
     """Global view of per-shard data, concatenated on dim 0 (reference
     ``gather`` :423). A globally-sharded ``jax.Array`` *is already* the
@@ -215,6 +226,7 @@ def gather(tensor: Any):
     return recursively_apply(_gather, tensor)
 
 
+@_traced_collective
 def gather_object(object: list[Any]) -> list[Any]:
     """Gather arbitrary picklable objects from all processes into one list
     (reference ``gather_object`` :449)."""
@@ -236,6 +248,7 @@ def gather_object(object: list[Any]) -> list[Any]:
 
 
 @verify_operation
+@_traced_collective
 def broadcast(tensor: Any, from_process: int = 0):
     """Broadcast array leaves from one process to all (reference :543)."""
     state = _state()
@@ -252,6 +265,7 @@ def broadcast(tensor: Any, from_process: int = 0):
     return recursively_apply(_bcast, tensor)
 
 
+@_traced_collective
 def broadcast_object_list(object_list: list[Any], from_process: int = 0) -> list[Any]:
     """In-place broadcast of picklable objects (reference :564)."""
     state = _state()
@@ -293,6 +307,7 @@ def _dim0_shard_count(t: jax.Array) -> int:
 
 
 @verify_operation
+@_traced_collective
 def reduce(tensor: Any, reduction: str = "mean", scale: float = 1.0):
     """Elementwise reduce of per-participant values (reference ``reduce``
     :728; XLA path :750-757 applied sum+scale). The participants are the
@@ -325,6 +340,7 @@ def reduce(tensor: Any, reduction: str = "mean", scale: float = 1.0):
 
 
 @verify_operation
+@_traced_collective
 def pad_across_processes(tensor: Any, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
     """Pad each process's arrays to the max size along ``dim`` so a gather
     can concatenate them (reference :632)."""

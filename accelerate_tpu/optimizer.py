@@ -139,6 +139,8 @@ class AcceleratedOptimizer:
         self._step_ok_device = None  # fp16: lazily-fetched finite flag
         self.comm_hook = None  # (hook_str, mesh): compressed dp grad reduction
         self.telemetry = None  # TelemetryRecorder, wired by prepare_optimizer
+        self.tracer = None     # diagnostics Tracer, wired by prepare_optimizer
+        self.watchdog = None   # diagnostics Watchdog, wired by prepare_optimizer
 
     # -- initialisation (called by Accelerator.prepare) ----------------------
 
@@ -262,35 +264,54 @@ class AcceleratedOptimizer:
 
     def step(self, closure=None):
         tel = self.telemetry
-        if tel is None or not tel.enabled:
+        tel_on = tel is not None and tel.enabled
+        wd = self.watchdog
+        tracer = self.tracer
+        if not tel_on and wd is None and tracer is None:
             return self._step_inner(closure)
         import time
 
         t0 = time.perf_counter()
-        self._step_inner(closure)
+        if tracer is not None:
+            with tracer.span("step/dispatch", sync=self.gradient_state.sync_gradients):
+                self._step_inner(closure)
+        else:
+            self._step_inner(closure)
         t1 = time.perf_counter()
         device_s = None
-        if tel.sync_device and self.model is not None and self.gradient_state.sync_gradients:
+        if (
+            tel_on
+            and tel.sync_device
+            and self.model is not None
+            and self.gradient_state.sync_gradients
+        ):
             # realise the dispatched update: splits the step's wall time
             # into host dispatch vs device-blocked (costs the host-runahead
             # pipelining; the recorder's sync_device=False keeps full async)
             try:
-                jax.block_until_ready(self.model.params)
+                if tracer is not None:
+                    with tracer.span("step/device_wait"):
+                        jax.block_until_ready(self.model.params)
+                else:
+                    jax.block_until_ready(self.model.params)
                 device_s = time.perf_counter() - t1
             except Exception:
                 device_s = None
-        # fused fp16 keeps the finite flag on device; only fetch it when the
-        # sync above already realised the step (no extra host round trip) —
-        # otherwise report unknown rather than fabricate False
-        skipped = self._step_was_skipped
-        if self._step_ok_device is not None:
-            skipped = self.step_was_skipped if tel.sync_device else None
-        tel.record_step(
-            dispatch_s=t1 - t0,
-            device_s=device_s,
-            sync_gradients=self.gradient_state.sync_gradients,
-            skipped=skipped,
-        )
+        if tel_on:
+            # fused fp16 keeps the finite flag on device; only fetch it when
+            # the sync above already realised the step (no extra host round
+            # trip) — otherwise report unknown rather than fabricate False
+            skipped = self._step_was_skipped
+            if self._step_ok_device is not None:
+                skipped = self.step_was_skipped if tel.sync_device else None
+            tel.record_step(
+                dispatch_s=t1 - t0,
+                device_s=device_s,
+                sync_gradients=self.gradient_state.sync_gradients,
+                skipped=skipped,
+            )
+        if wd is not None and self.gradient_state.sync_gradients:
+            wd.step_completed()
 
     def _step_inner(self, closure=None):
         if not self.gradient_state.sync_gradients:

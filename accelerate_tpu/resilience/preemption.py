@@ -87,6 +87,7 @@ class PreemptionHandler:
         """Raise the flag programmatically (tests; in-band watchdogs)."""
         self._reason = reason
         self._flag.set()
+        self._trace_flag()
 
     def reset(self):
         self._flag.clear()
@@ -153,10 +154,22 @@ class PreemptionHandler:
             raise KeyboardInterrupt
         self._reason = signal.Signals(signum).name
         self._flag.set()
+        self._trace_flag()
         logger.warning(
             "%s received — emergency checkpoint at the next step boundary",
             self._reason,
         )
+
+    def _trace_flag(self):
+        """Mark the flag-raise on the diagnostics timeline: the gap between
+        this instant and the `checkpoint/save` span is the preemption
+        reaction latency, the number a save-cadence tuning session needs."""
+        try:
+            from ..diagnostics.tracing import trace_instant
+
+            trace_instant("preemption/flag_raised", reason=self._reason)
+        except Exception:
+            pass
 
     def _poll_maintenance(self):
         import urllib.request

@@ -33,7 +33,13 @@ Record schema (every record carries ``type`` and ``ts``):
                table) ``mfu``.
 ``compile``  — ``label``, ``static_key``, ``lower_s``, ``compile_s``,
                ``total_s``, ``flops``, ``bytes_accessed``,
-               ``collective_bytes``, ``recompiles`` (cumulative).
+               ``collective_bytes``, ``recompiles`` (cumulative), and
+               ``mono`` — the phases' raw *monotonic* timestamps
+               (``lower_start``/``compile_start``/``compile_end``, same
+               ``perf_counter`` clock the diagnostics trace spans use).
+               ``ts`` stays wall-clock like every record; ``mono`` is what
+               lines a compile record up with the per-host trace timeline
+               (trace export / ``accelerate-tpu trace merge``).
 ``memory``   — ``device_bytes_in_use``, ``device_peak_bytes``,
                ``host_rss_bytes`` (sampled every ``memory_interval`` steps).
 ``generate`` — ``mode``, ``new_tokens``, ``seconds``, ``tokens_per_sec``
@@ -85,6 +91,11 @@ _STEP_LABELS = ("fused_step", "grad", "forward", "opt_apply")
 
 def _percentiles(values) -> dict[str, float]:
     arr = np.asarray(list(values), dtype=np.float64)
+    if arr.size == 0:
+        # an empty ring must yield an empty dict, not a numpy warning +
+        # NaNs — summary() can race a concurrent close()/clear in crash
+        # paths (the atexit flush) where the deques were never fed
+        return {}
     return {
         "p50": float(np.percentile(arr, 50)),
         "p95": float(np.percentile(arr, 95)),
@@ -207,6 +218,7 @@ class TelemetryRecorder:
         sync_device: bool | None = None,
     ):
         self.enabled = True
+        self._closed = False
         self._tracker_sink = tracker_sink
         self._ring_size = int(ring_size)
         self.memory_interval = int(memory_interval)
@@ -233,6 +245,11 @@ class TelemetryRecorder:
         self.step_count = 0
         self.optimizer_step_count = 0
         self.recompile_count = 0
+        self.skipped_step_count = 0
+        #: steps whose skip verdict was UNKNOWN at record time (fp16 fused
+        #: path: the finite-grads flag was still on device) — distinct from
+        #: "not skipped" so summaries stay honest about what they counted
+        self.unknown_skip_count = 0
         self.compile_seconds_total = 0.0
         self._static_keys: set = set()
         self._step_flops: float | None = None  # last step-program cost fact
@@ -256,6 +273,14 @@ class TelemetryRecorder:
         from .lazy import set_compile_callback
 
         set_compile_callback(self._on_compile)
+
+        # crash paths that never reach Accelerator.end_training() (uncaught
+        # exceptions, sys.exit from user code) must still leave a complete
+        # JSONL tail — close() is idempotent, so the normal path unregisters
+        # and this is a no-op there
+        import atexit
+
+        atexit.register(self.close)
 
     # -- sinks ---------------------------------------------------------------
 
@@ -298,6 +323,7 @@ class TelemetryRecorder:
                 "lower_s": facts.get("lower_s"),
                 "compile_s": facts.get("compile_s"),
                 "total_s": total_s,
+                "mono": facts.get("mono"),
                 "flops": facts.get("flops"),
                 "bytes_accessed": facts.get("bytes_accessed"),
                 "collective_bytes": facts.get("collective_bytes"),
@@ -329,6 +355,13 @@ class TelemetryRecorder:
     ):
         now = time.perf_counter()
         self.step_count += 1
+        if skipped is None:
+            self.unknown_skip_count += 1
+        elif skipped:
+            self.skipped_step_count += 1
+        # an unknown verdict counts toward optimizer_steps (the usual case:
+        # the device flag resolves to "fine"); unknown_skip records how many
+        # carried that assumption
         if sync_gradients and not skipped:
             self.optimizer_step_count += 1
         dispatch_s = float(dispatch_s) + self._pending_backward_s
@@ -497,6 +530,8 @@ class TelemetryRecorder:
         out: dict = {
             "steps": self.step_count,
             "optimizer_steps": self.optimizer_step_count,
+            "skipped_steps": self.skipped_step_count,
+            "unknown_skip": self.unknown_skip_count,
             "recompiles": self.recompile_count,
             "distinct_static_keys": len(self._static_keys),
             "compile_seconds_total": self.compile_seconds_total,
@@ -528,6 +563,8 @@ class TelemetryRecorder:
         return self._jsonl_path
 
     def close(self):
+        """Idempotent: safe to call from end_training(), the atexit hook,
+        and a Borg takeover in any order."""
         from .lazy import get_compile_callback, set_compile_callback
 
         if get_compile_callback() is self._on_compile:
@@ -540,6 +577,14 @@ class TelemetryRecorder:
             except Exception:
                 pass
             self._jsonl = None
+        if not self._closed:
+            self._closed = True
+            import atexit
+
+            try:
+                atexit.unregister(self.close)
+            except Exception:
+                pass
 
 
 def _json_default(obj):

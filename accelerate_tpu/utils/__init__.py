@@ -3,6 +3,7 @@ from .dataclasses import (
     CustomDtype,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
+    DiagnosticsPlugin,
     DistributedDataParallelKwargs,
     DistributedType,
     FullyShardedDataParallelPlugin,

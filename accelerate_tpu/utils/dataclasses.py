@@ -549,6 +549,69 @@ class FaultTolerancePlugin(KwargsHandler):
 
 
 @dataclass
+class DiagnosticsPlugin(KwargsHandler):
+    """Distributed tracing + hang watchdog (the ``diagnostics`` subsystem).
+
+    Handing this to ``Accelerator(diagnostics=...)``:
+
+    * **tracing** — per-host Chrome/Perfetto span timelines under
+      ``{logging_dir}/traces/host_<n>.trace.json`` covering prepare, the
+      AOT trace/lower/compile phases, backward dispatch vs device-blocked
+      time, dataloader fetch, eager collectives, and checkpoint
+      save/restore; fuse with ``accelerate-tpu trace merge``.
+    * **watchdog** — a background deadline of
+      ``max(watchdog_multiplier · EMA(step_time), watchdog_floor_seconds)``
+      armed around each step; on expiry, ``HANG_REPORT_<host>.json`` with
+      all-thread stacks + the open span stack, and (``preempt_on_hang``)
+      the resilience subsystem's consensus emergency-save instead of a
+      silent burn. Per-host heartbeat files feed
+      ``accelerate-tpu monitor``'s straggler naming.
+
+    Env overrides (all optional): ``ACCELERATE_DIAGNOSTICS=1`` enables the
+    subsystem with defaults; ``ACCELERATE_WATCHDOG_MULTIPLIER``,
+    ``ACCELERATE_WATCHDOG_FLOOR_SECONDS``,
+    ``ACCELERATE_WATCHDOG_CHECK_SECONDS``, ``ACCELERATE_WATCHDOG_PREEMPT``
+    tune the watchdog; ``ACCELERATE_WATCHDOG=0`` / ``ACCELERATE_TRACING=0``
+    switch either half off independently.
+    """
+
+    tracing: bool = True
+    watchdog: bool = True
+    watchdog_multiplier: float = 5.0
+    watchdog_floor_seconds: float = 120.0
+    watchdog_check_seconds: float = 5.0
+    watchdog_ema_alpha: float = 0.2
+    #: deadline while the open phase is compile/*, checkpoint/* or prepare
+    #: (host-local, legitimately unbounded by step time)
+    watchdog_grace_seconds: float = 1800.0
+    watchdog_telemetry_tail: int = 50
+    preempt_on_hang: bool = False
+    heartbeat_interval_seconds: float = 5.0
+    trace_buffer_events: int = 16
+
+    def __post_init__(self):
+        env = os.environ
+        if "ACCELERATE_TRACING" in env:
+            self.tracing = parse_flag_from_env("ACCELERATE_TRACING", self.tracing)
+        if "ACCELERATE_WATCHDOG" in env:
+            self.watchdog = parse_flag_from_env("ACCELERATE_WATCHDOG", self.watchdog)
+        if "ACCELERATE_WATCHDOG_MULTIPLIER" in env:
+            self.watchdog_multiplier = float(env["ACCELERATE_WATCHDOG_MULTIPLIER"])
+        if "ACCELERATE_WATCHDOG_FLOOR_SECONDS" in env:
+            self.watchdog_floor_seconds = float(env["ACCELERATE_WATCHDOG_FLOOR_SECONDS"])
+        if "ACCELERATE_WATCHDOG_CHECK_SECONDS" in env:
+            self.watchdog_check_seconds = float(env["ACCELERATE_WATCHDOG_CHECK_SECONDS"])
+        if "ACCELERATE_WATCHDOG_GRACE_SECONDS" in env:
+            self.watchdog_grace_seconds = float(env["ACCELERATE_WATCHDOG_GRACE_SECONDS"])
+        if "ACCELERATE_WATCHDOG_PREEMPT" in env:
+            self.preempt_on_hang = parse_flag_from_env(
+                "ACCELERATE_WATCHDOG_PREEMPT", self.preempt_on_hang
+            )
+        self.watchdog_multiplier = max(1.0, float(self.watchdog_multiplier))
+        self.watchdog_floor_seconds = max(0.0, float(self.watchdog_floor_seconds))
+
+
+@dataclass
 class MegatronLMPlugin(KwargsHandler):
     """Compatibility façade (reference ``dataclasses.py:1814+``): tp/pp/sp
     degrees lower to mesh axes; there is no separate Megatron engine.
