@@ -1,0 +1,254 @@
+"""``accelerate-tpu serve`` — drive the continuous-batching engine from
+JSONL on stdin or a local HTTP endpoint.
+
+Request protocol (one JSON object per line / per POST body):
+``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>}``;
+each completion is written back as
+``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``.
+Prompts are raw token ids — tokenization is deliberately out of scope (the
+engine is model-zoo-generic and this box ships no tokenizer assets).
+
+The engine loop owns the main thread; stdin/HTTP submissions land in a
+thread-safe inbox the loop drains between iterations, so network/pipe
+latency never stalls decode. ``--logging-dir`` turns on telemetry so
+``accelerate-tpu monitor <dir>`` shows live serving health (tokens/s,
+queue depth, slot occupancy, TTFT).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import threading
+import time
+
+
+def _build_model(args):
+    import jax.numpy as jnp
+
+    from ..models import LlamaConfig, LlamaForCausalLM
+
+    presets = {
+        "tiny": lambda: LlamaConfig.tiny(
+            vocab_size=256, hidden_size=64, layers=2, heads=4, seq=max(args.max_seq_len, 128)
+        ),
+        # the bench flagship slice (~700M): the largest single-chip shape
+        "flagship": lambda: LlamaConfig.flagship_700m(
+            max_position_embeddings=max(args.max_seq_len, 1024)
+        ),
+    }
+    config = presets[args.preset]()
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    return LlamaForCausalLM.from_config(config, seed=args.seed, dtype=dtype)
+
+
+def _make_engine(args):
+    from ..serving import EngineConfig, InferenceEngine
+
+    model = _build_model(args)
+    return InferenceEngine(
+        model,
+        EngineConfig(
+            num_slots=args.num_slots,
+            block_size=args.block_size,
+            max_seq_len=args.max_seq_len,
+            prefill_chunk=args.prefill_chunk,
+            decode_burst=args.decode_burst,
+            eos_token_id=args.eos_token_id,
+            do_sample=args.temperature is not None,
+            temperature=args.temperature if args.temperature is not None else 1.0,
+            seed=args.seed,
+            max_new_tokens=args.max_new_tokens,
+        ),
+    )
+
+
+def _result_dict(req, req_id) -> dict:
+    return {
+        "id": req_id,
+        "tokens": req.output_tokens,
+        "ttft_s": req.ttft_s,
+        "tpot_s": req.tpot_s,
+        "finish_reason": req.finish_reason,
+    }
+
+
+def _engine_loop(engine, inbox, emit, stop):
+    """Drain inbox → step → deliver completion dicts; idle-sleep when empty
+    so a quiet server doesn't spin a core. A malformed or over-budget
+    request is answered with an ``{"error": ...}`` result — it must never
+    kill the loop out from under the other in-flight requests."""
+    pending = {}  # engine request_id -> (user id, per-request callback)
+
+    def deliver(result, cb):
+        emit(result)
+        if cb is not None:
+            cb(result)
+
+    while not stop.is_set() or engine.scheduler.has_work() or not inbox.empty():
+        try:
+            while True:
+                payload, cb = inbox.get_nowait()
+                try:
+                    req = engine.add_request(
+                        payload["prompt"], payload.get("max_new_tokens")
+                    )
+                except Exception as e:  # noqa: BLE001 — reported, not fatal
+                    req_id = payload.get("id") if isinstance(payload, dict) else None
+                    deliver({"id": req_id, "error": str(e)}, cb)
+                    continue
+                pending[req.request_id] = (payload.get("id"), cb)
+        except queue.Empty:
+            pass
+        if engine.scheduler.has_work():
+            for req in engine.step():
+                req_id, cb = pending.pop(req.request_id, (None, None))
+                deliver(_result_dict(req, req_id), cb)
+        else:
+            time.sleep(0.005)
+
+
+def serve_command(args) -> int:
+    if args.logging_dir:
+        from ..telemetry import TelemetryRecorder, set_active_recorder
+
+        set_active_recorder(TelemetryRecorder(logging_dir=args.logging_dir))
+
+    engine = _make_engine(args)
+    inbox: queue.Queue = queue.Queue()
+    stop = threading.Event()
+    out_lock = threading.Lock()
+
+    def emit(result):
+        with out_lock:
+            print(json.dumps(result), flush=True)
+
+    if args.http:
+        return _serve_http(engine, inbox, stop, args.http)
+
+    # stdin/JSONL mode: a reader thread feeds the inbox; EOF arms stop and
+    # the loop drains what's in flight before exiting
+    def read_stdin():
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError as e:
+                with out_lock:
+                    print(json.dumps({"error": f"bad JSON: {e}"}), flush=True)
+                continue
+            inbox.put((payload, None))
+        stop.set()
+
+    threading.Thread(target=read_stdin, daemon=True).start()
+    try:
+        _engine_loop(engine, inbox, emit, stop)
+    except KeyboardInterrupt:
+        pass
+    stats = engine.stats()
+    print(
+        f"served {stats['completed']} requests, "
+        f"{stats['tokens_emitted']} tokens "
+        f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s), "
+        f"decode compiles {stats['decode_compiles']}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _serve_http(engine, inbox, stop, port) -> int:
+    """Minimal local HTTP front end: POST /generate blocks until the
+    request completes (400 on a rejected one); GET /stats returns engine
+    health JSON."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/stats", "/health"):
+                self._send(200, engine.stats())
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path.rstrip("/") != "/generate":
+                self._send(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n))
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+                if not payload.get("prompt"):
+                    raise ValueError("missing prompt")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            done = threading.Event()
+            box: dict = {}
+
+            def cb(result):
+                box["result"] = result
+                done.set()
+
+            inbox.put((payload, cb))
+            done.wait()
+            result = box["result"]
+            self._send(400 if "error" in result else 200, result)
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    print(f"serving on http://127.0.0.1:{port} (POST /generate, GET /stats)",
+          file=sys.stderr)
+    try:
+        _engine_loop(engine, inbox, lambda *a: None, stop)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "serve",
+        help="Continuous-batching inference engine over stdin/JSONL or local HTTP",
+    )
+    p.add_argument("--preset", choices=("tiny", "flagship"), default="tiny",
+                   help="model shape (random weights; prompts are token ids)")
+    p.add_argument("--dtype", choices=("f32", "bf16"), default="f32")
+    p.add_argument("--num-slots", type=int, default=8,
+                   help="decode batch slots (the compiled step's static dim)")
+    p.add_argument("--block-size", type=int, default=16, help="KV block tokens")
+    p.add_argument("--max-seq-len", type=int, default=512,
+                   help="per-request prompt+output cap")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   help="prompt tokens prefilled per engine iteration")
+    p.add_argument("--decode-burst", type=int, default=8,
+                   help="decode steps per dispatch (scheduling granularity)")
+    p.add_argument("--max-new-tokens", type=int, default=64,
+                   help="default output budget when a request omits it")
+    p.add_argument("--eos-token-id", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=None,
+                   help="enable sampling at this temperature (default: greedy)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve a local HTTP endpoint instead of stdin JSONL")
+    p.add_argument("--logging-dir", default=None,
+                   help="enable telemetry here (accelerate-tpu monitor shows "
+                   "serving health)")
+    p.set_defaults(func=serve_command)
+    return p

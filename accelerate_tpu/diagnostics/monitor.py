@@ -74,6 +74,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "mfu": None,
         "recompiles": None,
         "last_record_age_s": None,
+        "serving": None,
         "hosts": [],
         "stragglers": [],
         "wedged": [],
@@ -102,6 +103,45 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
                 status[key] = vals[-1]
         if last.get("ts"):
             status["last_record_age_s"] = max(0.0, now - float(last["ts"]))
+
+    # -- serving engine rows -------------------------------------------------
+    serving = [r for r in records if r.get("type") == "serving"]
+    srv_steps = [r for r in serving if r.get("kind") == "step"]
+    srv_reqs = [r for r in serving if r.get("kind") == "request"]
+    if srv_steps or srv_reqs:
+        last_step = srv_steps[-1] if srv_steps else {}
+        ttfts = sorted(r["ttft_s"] for r in srv_reqs if r.get("ttft_s") is not None)
+        status["serving"] = {
+            "tokens_per_sec": last_step.get("tokens_per_sec"),
+            "queue_depth": last_step.get("queue_depth"),
+            "slot_occupancy": last_step.get("slot_occupancy"),
+            "free_blocks": last_step.get("free_blocks"),
+            "decode_compiles": last_step.get("decode_compiles"),
+            # run-total: the step row's cumulative counter (the JSONL tail
+            # is bounded, so counting request rows windows long runs) plus
+            # request rows newer than it (the counter lags by up to one
+            # stats interval). Counting rows older than the step row would
+            # resurrect totals from a previous run in the appended trail.
+            "completed": (
+                int(last_step["completed_total"])
+                + sum(
+                    1 for r in srv_reqs
+                    if (r.get("ts") or 0) > (last_step.get("ts") or 0)
+                )
+                if last_step.get("completed_total") is not None
+                else len(srv_reqs)
+            ),
+            # percentile over the tail's recent requests (windowed by design)
+            "ttft_p50_s": ttfts[len(ttfts) // 2] if ttfts else None,
+        }
+        last_ts = serving[-1].get("ts")
+        if last_ts:
+            age = max(0.0, now - float(last_ts))
+            status["last_record_age_s"] = (
+                age
+                if status["last_record_age_s"] is None
+                else min(status["last_record_age_s"], age)
+            )
 
     # -- heartbeats ----------------------------------------------------------
     hb_glob = os.path.join(logging_dir, HEARTBEAT_SUBDIR, "heartbeat_*.json")
@@ -162,6 +202,16 @@ def render_status(status: dict[str, Any]) -> str:
         f"MFU {_fmt(status['mfu'], '{:.1%}')}   "
         f"last record {_fmt(status['last_record_age_s'], '{:.0f}')}s ago",
     ]
+    srv = status.get("serving")
+    if srv:
+        lines.append(
+            f"  serving: {_fmt(srv['tokens_per_sec'], '{:.0f}')} tok/s   "
+            f"queue {_fmt(srv['queue_depth'], '{}')}   "
+            f"occupancy {_fmt(srv['slot_occupancy'], '{:.0%}')}   "
+            f"free blocks {_fmt(srv['free_blocks'], '{}')}   "
+            f"done {srv['completed']} (ttft p50 {_fmt(srv['ttft_p50_s'], '{:.2f}')}s)   "
+            f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
+        )
     if status["hosts"]:
         lines.append(f"  hosts ({len(status['hosts'])}):")
         for h in status["hosts"]:

@@ -239,6 +239,12 @@ def generate(
                 "speculative decoding is greedy-only: rejection sampling for "
                 "do_sample=True is not implemented (pass do_sample=False)"
             )
+        if int(num_draft_tokens) < 1:
+            raise ValueError(
+                f"num_draft_tokens must be >= 1, got {num_draft_tokens}: the "
+                "speculative loop drafts k tokens per verify round — k < 1 "
+                "would verify nothing and never advance"
+            )
         target = _cache_backend(model)
         draft = _cache_backend(draft_model)
         if target is None or draft is None:
@@ -248,9 +254,13 @@ def generate(
                 f"target={'ok' if target else 'unsupported'}, "
                 f"draft={'ok' if draft else 'unsupported'}"
             )
+        config = getattr(model, "config", None) or getattr(
+            getattr(model, "_model", None), "config", None
+        )
         return _generate_speculative(
             target, draft, input_ids, max_new_tokens, int(num_draft_tokens),
             eos_token_id, attention_mask,
+            max_positions=getattr(config, "max_position_embeddings", None),
         )
     if use_cache:
         backend = _cache_backend(model)
@@ -588,6 +598,7 @@ def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool)
 
 def _generate_speculative(
     target, draft, input_ids, max_new_tokens, k, eos_token_id, attention_mask,
+    max_positions: int | None = None,
 ):
     """Greedy speculative decoding (the reference has no analog): a cheap
     draft model proposes ``k`` tokens autoregressively, the target model
@@ -622,8 +633,21 @@ def _generate_speculative(
     lengths = mask.sum(axis=1).astype(np.int64)
     total = prompt_len + max_new_tokens
     # verify chunks may overshoot a row's budget by up to k; both caches
-    # carry the margin so the scatter never clips a live row
+    # carry the margin so the scatter never clips a live row. Near an
+    # exact-fit budget (total == max_position_embeddings) the margin is
+    # clamped — overshoot writes past the cache end are DROPPED by the
+    # write scatter (ops.layers.write_kv_cache mode="drop") and belong to
+    # tokens past the budget, which are never emitted, so the clamp only
+    # removes the pre-allocated slack, not correctness.
     cache_len = total + k + 1
+    if max_positions is not None:
+        if total > int(max_positions):
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds max_position_embeddings {max_positions}: "
+                "emitted tokens would fall past the position table"
+            )
+        cache_len = min(cache_len, int(max_positions))
     buf = np.zeros((b, total), np.int32)
     buf[:, :prompt_len] = ids
     if max_new_tokens <= 0:

@@ -62,6 +62,10 @@ class GPTNeoXConfig:
     shared_layernorm: bool = False
     #: GPT-J: no biases on the q/k/v and attn-output projections
     attention_bias: bool = True
+    #: MLP GELU flavor: None resolves by family — GPT-NeoX checkpoints use
+    #: exact (erf) GELU (HF ``hidden_act="gelu"``) while GPT-J uses the tanh
+    #: approximation (``gelu_new``); True/False force tanh/exact.
+    gelu_approximate: bool | None = None
     remat: bool | str = False  # False | True | jax.checkpoint_policies name
     #: GPipe microbatch count when the mesh has a pp axis > 1 (0 = auto)
     pipeline_microbatches: int = 0
@@ -161,6 +165,17 @@ def init_gpt_neox_params(key: jax.Array, config: GPTNeoXConfig, dtype=jnp.float3
     return params
 
 
+def _gelu(c: GPTNeoXConfig, x):
+    """Family-resolved GELU: exact erf for NeoX, tanh for GPT-J (which is
+    identified by its shared LayerNorm) unless ``gelu_approximate`` forces
+    one. The tanh/erf gap is ~1e-3 at |x|≈2 — above checkpoint-parity
+    tolerance, so the flavor must match the published architecture."""
+    approx = c.gelu_approximate
+    if approx is None:
+        approx = c.shared_layernorm  # GPT-J
+    return jax.nn.gelu(x, approximate=approx)
+
+
 def _partial_rope(x, cos, sin, positions, rotary_dim):
     """Rotate the first ``rotary_dim`` dims of each head, pass the rest."""
     x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
@@ -201,7 +216,7 @@ def gpt_neox_layer_apply(
     else:
         y2 = layer_norm(x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
     mlp_out = dense(
-        jax.nn.gelu(dense(y2, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
+        _gelu(c, dense(y2, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
     ) + layer["b_out"]
     x = x + attn_out + mlp_out
     x = _constrain(x, residual_spec())
@@ -332,7 +347,7 @@ def _gpt_neox_decode_layer(c, layer, x, k_cache_l, v_cache_l, idx, rope, pp_manu
         x, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps
     )
     mlp_out = dense(
-        jax.nn.gelu(dense(y2, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
+        _gelu(c, dense(y2, layer["w_fc"]) + layer["b_fc"]), layer["w_out"]
     ) + layer["b_out"]
     return x + attn_out + mlp_out, k_cache_l, v_cache_l
 

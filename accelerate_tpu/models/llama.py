@@ -68,6 +68,24 @@ class LlamaConfig:
         return cls()
 
     @classmethod
+    def flagship_700m(cls, max_position_embeddings: int = 1024, remat: bool | str = False):
+        """The ~700M bench flagship slice (hidden 1536, 12 heads × 128,
+        ff 4h, 16 layers) — the largest credible-aspect-ratio shape whose
+        fp32 adam state fits one v5e chip (sweep: benchmarks/sweep_mfu.py).
+        Single source of truth for bench.py, benchmarks/serve_bench.py and
+        the serve CLI's ``--preset flagship`` so they measure one model."""
+        return cls(
+            vocab_size=32000,
+            hidden_size=1536,
+            intermediate_size=6144,
+            num_hidden_layers=16,
+            num_attention_heads=12,
+            num_key_value_heads=12,
+            max_position_embeddings=max_position_embeddings,
+            remat=remat,
+        )
+
+    @classmethod
     def tiny(cls, vocab_size=256, hidden_size=64, layers=2, heads=4, seq=128):
         return cls(
             vocab_size=vocab_size,
@@ -242,8 +260,12 @@ def llama_apply(
     kv_cache=None,  # {"k","v"}: [L, b, max_cache, n_kv, hd] (decode step)
     cache_index: jax.Array | None = None,  # [b] per-row write position
     max_cache_len: int | None = None,
+    paged_kv=None,  # {"k","v"}: [L, num_blocks, block_size, n_kv, hd]
+    block_tables: jax.Array | None = None,  # [b, max_blocks] pool block ids
+    cache_positions: jax.Array | None = None,  # [b] first new token position
+    paged_write_mask: jax.Array | None = None,  # [b, s] real-token mask
 ):
-    """Forward pass; three modes:
+    """Forward pass; four modes:
 
     * training/eval (default) — full causal attention;
     * **prefill** (``use_cache=True``) — same, plus the per-layer K/V
@@ -252,7 +274,14 @@ def llama_apply(
     * **decode** (``kv_cache=`` + ``cache_index=``) — ``input_ids`` is one
       token per row; K/V append at each row's own position (ragged-batch
       safe) and attention runs token-vs-cache in O(max_cache) — the KV-cache
-      inference path (the reference gets this from transformers' generate).
+      inference path (the reference gets this from transformers' generate);
+    * **paged decode/prefill-chunk** (``paged_kv=`` + ``block_tables=`` +
+      ``cache_positions=``) — the serving engine's block-paged cache path
+      (``supports_paged_kv``): K/V scatter through each slot's block table
+      into a shared pool, attention against the gathered logical prefix.
+      One compiled ``[num_slots, 1]`` program serves every decode iteration
+      for the lifetime of the engine; ``s > 1`` with a ``paged_write_mask``
+      is a chunked-prefill slice of one prompt.
     """
     c = config
     b, s = input_ids.shape
@@ -268,6 +297,11 @@ def llama_apply(
     # pipeline engine (parallel.pipeline.pipeline_cached_stack via the
     # prefill_stack/decode_stack drivers), so stage-split weights and
     # caches stay put instead of the plain scans all-gathering them
+    if paged_kv is not None:
+        return _llama_paged_step(
+            c, params, input_ids, paged_kv, block_tables, cache_positions,
+            paged_write_mask, cos, sin,
+        )
     if kv_cache is not None:
         return _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin)
 
@@ -377,6 +411,45 @@ def _llama_decode_step(c, params, input_ids, kv_cache, cache_index, cos, sin):
         head = params["embed_tokens"].T
     logits = dense(x, head)
     return ModelOutput(logits=logits, kv_cache=kv)
+
+
+def _llama_paged_step(
+    c, params, input_ids, paged_kv, block_tables, cache_positions,
+    paged_write_mask, cos, sin,
+):
+    """One step against the block-paged KV pool: ``s == 1`` token per slot
+    (the engine's single compiled decode program) or an ``s``-token prefill
+    chunk of one prompt. K/V land in pool blocks through each slot's block
+    table (:func:`ops.layers.write_paged_kv`); attention runs against the
+    gathered logical prefix. The layer loop is a plain scan — the serving
+    engine is a single-host path (no pp stage pipeline)."""
+    from ..ops.layers import rope_paged_attention_block
+
+    b, s = input_ids.shape
+    idx = jnp.asarray(cache_positions, jnp.int32).reshape(b)
+    x = params["embed_tokens"][input_ids]
+
+    def body(x, layer_pages):
+        layer, kp_l, vp_l = layer_pages
+        x, kp_l, vp_l = rope_paged_attention_block(
+            layer, x, kp_l, vp_l, cos, sin, block_tables, idx,
+            c.num_attention_heads, c.num_key_value_heads, c.head_dim,
+            c.rms_norm_eps, write_mask=paged_write_mask,
+        )
+        y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
+        x = x + dense(gated, layer["w_down"])
+        return x, (kp_l, vp_l)
+
+    x, (kp, vp) = jax.lax.scan(
+        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
+    )
+    x = rms_norm(x, params["norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    logits = dense(x, head)
+    return ModelOutput(logits=logits, paged_kv={"k": kp, "v": vp})
 
 
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")
@@ -519,6 +592,7 @@ class LlamaForCausalLM:
         model.segments = llama_segments(config)
         model.stacked_params_prefix = "layers"
         model.supports_kv_cache = True
+        model.supports_paged_kv = True  # serving engine's block-paged decode
         model.convert_state_dict = lambda flat: convert_hf_llama_state_dict(flat, config)
         # tied embeddings are a single leaf in this functional design (no
         # separate lm_head param exists), so no tie group is declared

@@ -194,8 +194,13 @@ def write_kv_cache(k_cache_l, v_cache_l, k, v, idx, pin_replicated: bool = False
     rows = jnp.arange(b)[:, None]
     idx = jnp.asarray(idx, jnp.int32).reshape(b)
     pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
-    k_cache_l = k_cache_l.at[rows, pos].set(k)
-    v_cache_l = v_cache_l.at[rows, pos].set(v)
+    # mode="drop": a chunk that overshoots the cache end (speculative verify
+    # near an exact-fit budget with a clamped cache) must NOT clamp-scatter —
+    # duplicate clamped indices would let an overshoot token overwrite the
+    # final legitimate cache slot. Dropped writes belong to tokens past the
+    # budget, which are never emitted.
+    k_cache_l = k_cache_l.at[rows, pos].set(k, mode="drop")
+    v_cache_l = v_cache_l.at[rows, pos].set(v, mode="drop")
     return k_cache_l, v_cache_l
 
 
@@ -266,3 +271,99 @@ def cached_attention(q, k_cache, v_cache, idx):
     return jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
     ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Block-paged KV cache (serving engine)
+#
+# The serving engine's cache is one pool of fixed-size blocks per layer
+# (``[num_blocks, block_size, n_kv, hd]``) plus a per-slot **block table**
+# mapping each slot's logical block index to a pool block — the
+# PagedAttention layout (vLLM, SOSP '23). Block 0 is the reserved *null
+# block*: free slots and unfilled table entries point at it, so the static
+# ``[num_slots, 1]`` decode step needs no dynamic shapes, and garbage
+# written/read there is always masked out by the per-slot valid prefix.
+# ---------------------------------------------------------------------------
+
+
+def write_paged_kv(
+    k_pages_l, v_pages_l, k, v, block_tables, positions, write_mask=None
+):
+    """Scatter a chunk's K/V (``[b, s, n_kv, hd]``) into block-paged caches
+    ``[num_blocks, block_size, n_kv, hd]`` at absolute token ``positions``
+    ``[b, s]`` through each row's ``block_tables`` row ``[b, max_blocks]``.
+
+    ``write_mask`` ``[b, s]`` (optional) marks real tokens; masked lanes
+    (the padded tail of a final prefill chunk) are routed out of range and
+    dropped — the pool never sees them. Positions past the table span
+    (post-budget burst lane-steps at a slot's maximum) gather an
+    out-of-range block id via ``mode="fill"`` and are likewise dropped —
+    never clamped into the slot's own final block. Distinct live slots own
+    disjoint blocks, so the flattened scatter has no cross-slot
+    collisions; only the null block (0) absorbs free-slot writes, and it
+    is never attended."""
+    nb, bs = k_pages_l.shape[0], k_pages_l.shape[1]
+    b, s = k.shape[0], k.shape[1]
+    positions = jnp.asarray(positions, jnp.int32)
+    blk = jnp.take_along_axis(
+        jnp.asarray(block_tables, jnp.int32), positions // bs, axis=1,
+        mode="fill", fill_value=nb,
+    )  # [b, s]; fill → flat lands past the pool and the scatter drops it
+    flat = blk * bs + positions % bs
+    if write_mask is not None:
+        flat = jnp.where(write_mask, flat, nb * bs)  # out of range → dropped
+    kf = k_pages_l.reshape(nb * bs, *k_pages_l.shape[2:])
+    vf = v_pages_l.reshape(nb * bs, *v_pages_l.shape[2:])
+    flat = flat.reshape(b * s)
+    kf = kf.at[flat].set(k.reshape(b * s, *k.shape[2:]), mode="drop")
+    vf = vf.at[flat].set(v.reshape(b * s, *v.shape[2:]), mode="drop")
+    return kf.reshape(k_pages_l.shape), vf.reshape(v_pages_l.shape)
+
+
+def gather_paged_kv(k_pages_l, v_pages_l, block_tables):
+    """Materialise each slot's logical cache from the block pool:
+    ``[num_blocks, bs, n_kv, hd]`` gathered through ``[b, max_blocks]`` →
+    ``[b, max_blocks*bs, n_kv, hd]``. Logical position ``p`` lands at
+    gathered index ``p`` (tables are ordered), so the result feeds
+    :func:`cached_attention` unchanged — paged decode shares the dense decode
+    path's masking/softmax/dtype policy by construction."""
+    bt = jnp.asarray(block_tables, jnp.int32)
+    k = k_pages_l[bt]  # [b, max_blocks, bs, n_kv, hd]
+    v = v_pages_l[bt]
+    b, mb, bs = k.shape[0], k.shape[1], k.shape[2]
+    return (
+        k.reshape(b, mb * bs, *k.shape[3:]),
+        v.reshape(b, mb * bs, *v.shape[3:]),
+    )
+
+
+def rope_paged_attention_block(
+    layer, x, k_pages_l, v_pages_l, cos, sin, block_tables, idx,
+    n_heads: int, n_kv_heads: int, head_dim: int, eps: float,
+    write_mask=None,
+):
+    """Paged twin of :func:`rope_cached_attention_block`: RMSNorm → q/k/v →
+    RoPE at each slot's absolute position → block-table scatter → page
+    gather → :func:`cached_attention` → output projection residual.
+    ``s == 1`` is the engine's decode step; ``s > 1`` a prefill chunk
+    (``write_mask`` drops its padded tail)."""
+    from .fp8 import dense
+
+    b, s, _ = x.shape
+    idx = jnp.asarray(idx, jnp.int32).reshape(b)
+    positions = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+    y = rms_norm(x, layer["attn_norm"], eps)
+    q = apply_rope(
+        dense(y, layer["wq"]).reshape(b, s, n_heads, head_dim), cos, sin, positions
+    )
+    k = apply_rope(
+        dense(y, layer["wk"]).reshape(b, s, n_kv_heads, head_dim), cos, sin, positions
+    )
+    v = dense(y, layer["wv"]).reshape(b, s, n_kv_heads, head_dim)
+    k_pages_l, v_pages_l = write_paged_kv(
+        k_pages_l, v_pages_l, k, v, block_tables, positions, write_mask=write_mask
+    )
+    k_g, v_g = gather_paged_kv(k_pages_l, v_pages_l, block_tables)
+    attn = cached_attention(q, k_g, v_g, idx)
+    x = x + dense(attn.reshape(b, s, n_heads * head_dim), layer["wo"])
+    return x, k_pages_l, v_pages_l

@@ -1,0 +1,17 @@
+"""Continuous-batching serving engine (Orca-style slot scheduling over a
+vLLM-style block-paged KV cache) — see :mod:`.engine` for the design."""
+
+from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
+from .engine import EngineConfig, InferenceEngine
+from .scheduler import Request, RequestState, SlotScheduler
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "blocks_needed",
+    "EngineConfig",
+    "InferenceEngine",
+    "Request",
+    "RequestState",
+    "SlotScheduler",
+]

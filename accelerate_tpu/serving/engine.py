@@ -1,0 +1,447 @@
+"""Continuous-batching inference engine: one compiled decode step, forever.
+
+The static-batch ``generate()`` path compiles a prefill + decode program per
+call and every request in the batch waits for the slowest one. This engine
+inverts the design for serving (Orca-style iteration scheduling over a
+vLLM-style block-paged cache):
+
+* the decode step is **one** pjit-compiled program of static shape
+  ``[num_slots, 1]`` against a block-paged KV pool — admitting, evicting,
+  or resizing requests never recompiles (asserted by ``stats()``'s
+  ``decode_compiles`` counter, which increments only when JAX re-traces);
+* prompts are **chunk-prefilled**: ``prefill_chunk`` tokens of one prompt
+  per engine iteration, interleaved with the decode step, so a long prompt
+  bounds every in-flight request's inter-token latency by one chunk's
+  forward instead of a whole prefill;
+* KV memory is allocated in ``block_size``-token blocks from a freelist
+  (:mod:`.blocks`) — padding waste is bounded by block granularity, and a
+  finished short completion's blocks are serving a new request on the next
+  iteration.
+
+Sampling/eos semantics reuse ``generation.py``'s traced pick helper
+(:func:`accelerate_tpu.generation._pick_traced`), so greedy engine output
+is token-for-token identical to ``generate(use_cache=True)``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..diagnostics.tracing import trace_span
+from ..generation import _pick_traced
+from ..telemetry import get_active_recorder
+from .blocks import BlockAllocator, blocks_needed
+from .scheduler import Request, RequestState, SlotScheduler
+
+
+@dataclass
+class EngineConfig:
+    """Engine geometry. ``num_blocks`` defaults to full residency
+    (``num_slots`` × the per-slot maximum + the null block) — set it lower
+    to exercise freelist contention."""
+
+    num_slots: int = 8
+    block_size: int = 16
+    #: per-request cap on prompt + generated tokens; also sizes the block
+    #: table width (``ceil(max_seq_len / block_size)`` entries per slot)
+    max_seq_len: int = 512
+    num_blocks: int | None = None
+    prefill_chunk: int = 32
+    eos_token_id: int | None = None
+    do_sample: bool = False
+    temperature: float = 1.0
+    seed: int = 0
+    #: default budget for add_request(max_new_tokens=None)
+    max_new_tokens: int = 64
+    #: decode steps per dispatch of the (single) compiled decode program —
+    #: a ``lax.scan`` of this many ``[num_slots, 1]`` steps. Amortises the
+    #: per-dispatch host round trip (the same move generation.py's
+    #: ``_EOS_CHUNK`` makes) at the cost of scheduling granularity:
+    #: admission/prefill interleave every ``decode_burst`` tokens, and a
+    #: request finishing mid-burst wastes at most ``decode_burst - 1``
+    #: lane-steps. 1 = schedule every token.
+    decode_burst: int = 8
+    #: emit a telemetry "serving" row every N iterations (0 disables)
+    stats_interval: int = 32
+
+    @property
+    def blocks_per_slot(self) -> int:
+        return blocks_needed(self.max_seq_len, self.block_size)
+
+
+class InferenceEngine:
+    """Slot-scheduled continuous-batching engine over a paged-KV model.
+
+    ``add_request()`` enqueues; ``step()`` runs one scheduler iteration
+    (evict → admit → one prefill chunk → one decode step) and returns the
+    requests that finished; ``run_until_idle()`` drains; ``stream()`` is a
+    per-request generator. The model must declare ``supports_paged_kv``
+    (the block-table decode path in its apply fn)."""
+
+    def __init__(self, model, config: EngineConfig | None = None):
+        self.config = cfg = config or EngineConfig()
+        inner = getattr(model, "_model", None) or model
+        if not getattr(inner, "supports_paged_kv", False):
+            raise ValueError(
+                f"model {getattr(inner, 'name', type(inner).__name__)!r} does not "
+                "declare supports_paged_kv: the engine needs the block-table "
+                "KV decode path (models/llama.py _llama_paged_step)"
+            )
+        self._apply_fn = inner.apply_fn
+        self._params = model.params
+        mcfg = inner.config
+        if cfg.max_seq_len > mcfg.max_position_embeddings:
+            raise ValueError(
+                f"max_seq_len {cfg.max_seq_len} exceeds the model's "
+                f"max_position_embeddings {mcfg.max_position_embeddings}"
+            )
+        if min(cfg.prefill_chunk, cfg.block_size, cfg.num_slots, cfg.decode_burst) < 1:
+            raise ValueError(
+                "prefill_chunk, block_size, num_slots, decode_burst must be >= 1"
+            )
+
+        self._mb = cfg.blocks_per_slot  # block-table width
+        num_blocks = cfg.num_blocks or cfg.num_slots * self._mb + 1
+        self.allocator = BlockAllocator(num_blocks)
+        self.scheduler = SlotScheduler(
+            cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len
+        )
+
+        # device state: per-layer page pools in the params' compute dtype
+        n_kv = getattr(mcfg, "num_key_value_heads", None) or mcfg.num_attention_heads
+        embed = jax.tree.leaves(self._params)[0]
+        dtype = embed.dtype if jnp.issubdtype(embed.dtype, jnp.floating) else jnp.float32
+        shape = (mcfg.num_hidden_layers, num_blocks, cfg.block_size, n_kv, mcfg.head_dim)
+        self._kp = jnp.zeros(shape, dtype)
+        self._vp = jnp.zeros(shape, dtype)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._temp = jnp.float32(cfg.temperature)
+
+        # host mirrors the compiled step reads every iteration
+        self._block_tables = np.zeros((cfg.num_slots, self._mb), np.int32)
+        self._pending_tok = np.zeros((cfg.num_slots,), np.int32)
+
+        # counters (the *_traces counters increment inside the traced
+        # bodies, i.e. only on a jit cache miss — the "exactly one decode
+        # executable" acceptance bar reads decode_compiles)
+        self._decode_traces = 0
+        self._prefill_traces = 0
+        self._iterations = 0
+        self._tokens_emitted = 0
+        self._occupancy_sum = 0.0
+        self._start_time: float | None = None
+        self._completed: list[Request] = []
+        self._last_stats_t: float | None = None
+        self._last_stats_tokens = 0
+
+        self._decode_fn = self._build_decode_fn()
+        self._prefill_fn = self._build_prefill_fn()
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _build_decode_fn(self):
+        apply_fn, cfg = self._apply_fn, self.config
+
+        def decode(params, kp, vp, block_tables, pos0, toks, active, key, temp):
+            self._decode_traces += 1  # traced-body side effect: cache misses only
+
+            def one_step(carry, _):
+                kp, vp, toks, pos, key = carry
+                out = apply_fn(
+                    params,
+                    input_ids=toks,
+                    paged_kv={"k": kp, "v": vp},
+                    block_tables=block_tables,
+                    cache_positions=pos,
+                    paged_write_mask=active,  # PREFILL/free lanes must not scribble
+                )
+                logits = out["logits"][:, -1, :]
+                tok, key, _ = _pick_traced(
+                    logits, key, jnp.zeros(logits.shape[:1], bool), jnp.int32(0),
+                    temp, cfg.do_sample, has_eos=False,  # eos is host-side state
+                )
+                pages = out["paged_kv"]
+                return (pages["k"], pages["v"], tok[:, None], pos + 1, key), tok
+
+            (kp, vp, _, _, key), toks_out = jax.lax.scan(
+                one_step, (kp, vp, toks, pos0, key), None, length=cfg.decode_burst
+            )
+            return kp, vp, toks_out, key  # toks_out: [decode_burst, num_slots]
+
+        return jax.jit(decode, donate_argnums=(1, 2))
+
+    def _build_prefill_fn(self):
+        apply_fn, cfg = self._apply_fn, self.config
+
+        def prefill(params, kp, vp, block_table, start, chunk, valid, last_idx, key, temp):
+            self._prefill_traces += 1
+            out = apply_fn(
+                params,
+                input_ids=chunk,  # [1, prefill_chunk]
+                paged_kv={"k": kp, "v": vp},
+                block_tables=block_table,  # [1, mb]
+                cache_positions=start,  # [1]
+                paged_write_mask=valid,  # drops the padded tail
+            )
+            # first-token pick from the prompt's last real position — only
+            # meaningful on the final chunk; the host ignores it otherwise
+            logits = jnp.take(out["logits"][0], last_idx, axis=0)[None]
+            tok, key, _ = _pick_traced(
+                logits, key, jnp.zeros((1,), bool), jnp.int32(0),
+                temp, cfg.do_sample, has_eos=False,
+            )
+            pages = out["paged_kv"]
+            return pages["k"], pages["v"], tok[0], logits[0], key
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    # -- public API ----------------------------------------------------------
+
+    def add_request(
+        self,
+        prompt,
+        max_new_tokens: int | None = None,
+        arrival_time: float | None = None,
+    ) -> Request:
+        req = Request(
+            prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
+            max_new_tokens=int(
+                self.config.max_new_tokens if max_new_tokens is None else max_new_tokens
+            ),
+        )
+        if arrival_time is not None:
+            req.arrival_time = arrival_time
+        return self.scheduler.submit(req)
+
+    def step(self) -> list[Request]:
+        """One engine iteration: evict finished → admit queued → one
+        prefill chunk → one decode step over every slot. Returns requests
+        that finished during this iteration."""
+        if self._start_time is None:
+            self._start_time = self._last_stats_t = time.perf_counter()
+        sched = self.scheduler
+        finished: list[Request] = []
+
+        with trace_span("serve/schedule"):
+            sched.evict_finished()
+            sched.admit()
+
+        with trace_span("serve/prefill"):
+            # one chunk per PREFILLING SLOT per iteration: slot turnover is
+            # never throttled to one admission per decode burst, while any
+            # single prompt still advances at most one chunk between decode
+            # steps — the TTFT/stall bound chunked prefill exists for
+            for req in sched.active(RequestState.PREFILL):
+                self._prefill_one_chunk(req, finished)
+
+        decoding = sched.active(RequestState.DECODE)
+        if decoding:
+            with trace_span("serve/decode", slots=len(decoding)):
+                self._decode_once(decoding, finished)
+
+        self._iterations += 1
+        self._occupancy_sum += sched.occupancy
+        self._completed.extend(finished)
+        self._emit_telemetry(finished)
+        return finished
+
+    def run_until_idle(self, max_iterations: int | None = None) -> list[Request]:
+        """Drain queue + slots; returns every request finished during the
+        drain (scheduling-bug guard: ``max_iterations`` bounds the loop)."""
+        done: list[Request] = []
+        it = 0
+        while self.scheduler.has_work():
+            done.extend(self.step())
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                raise RuntimeError(f"engine not idle after {it} iterations")
+        return done
+
+    def stream(self, prompt, max_new_tokens: int | None = None):
+        """Generator yielding this request's tokens as the engine emits
+        them (other in-flight requests keep decoding underneath)."""
+        req = self.add_request(prompt, max_new_tokens)
+        served = 0
+        while req.state is not RequestState.FINISHED:
+            self.step()
+            while served < len(req.output_tokens):
+                yield req.output_tokens[served]
+                served += 1
+        while served < len(req.output_tokens):
+            yield req.output_tokens[served]
+            served += 1
+
+    def reset_stats(self) -> None:
+        """Zero the measurement state (iterations, tokens, occupancy,
+        completed-request percentiles, wall clock) while keeping the
+        compiled programs, pages, and compile counters — so a bench can
+        warm up and then measure without the warmup's idle-engine TTFT and
+        low-occupancy drain iterations biasing the reported percentiles."""
+        self._iterations = 0
+        self._tokens_emitted = 0
+        self._occupancy_sum = 0.0
+        self._start_time = None
+        self._completed = []
+        self._last_stats_t = None
+        self._last_stats_tokens = 0
+
+    def stats(self) -> dict:
+        """Aggregate serving health: goodput, TTFT/TPOT percentiles over
+        completed requests, mean slot occupancy, and the compile counters
+        the one-executable contract is asserted against."""
+        out = {
+            "iterations": self._iterations,
+            "completed": len(self._completed),
+            "queue_depth": self.scheduler.queue_depth,
+            "tokens_emitted": self._tokens_emitted,
+            "decode_compiles": self._decode_traces,
+            "prefill_compiles": self._prefill_traces,
+            "free_blocks": self.allocator.free_count,
+            "allocated_blocks": self.allocator.allocated_count,
+            "slot_occupancy_mean": (
+                self._occupancy_sum / self._iterations if self._iterations else 0.0
+            ),
+        }
+        if self._start_time is not None:
+            elapsed = time.perf_counter() - self._start_time
+            out["elapsed_s"] = elapsed
+            out["tokens_per_sec"] = self._tokens_emitted / elapsed if elapsed > 0 else 0.0
+        ttfts = [r.ttft_s for r in self._completed if r.ttft_s is not None]
+        tpots = [r.tpot_s for r in self._completed if r.tpot_s is not None]
+        if ttfts:
+            out["ttft_s"] = {
+                "p50": float(np.percentile(ttfts, 50)),
+                "p99": float(np.percentile(ttfts, 99)),
+            }
+        if tpots:
+            out["tpot_s"] = {
+                "p50": float(np.percentile(tpots, 50)),
+                "p99": float(np.percentile(tpots, 99)),
+            }
+        return out
+
+    # -- iteration internals -------------------------------------------------
+
+    def _sync_block_table(self, req: Request) -> None:
+        row = self._block_tables[req.slot]
+        row[:] = 0
+        row[: len(req.blocks)] = req.blocks
+
+    def _prefill_one_chunk(self, req: Request, finished: list[Request]) -> None:
+        cfg = self.config
+        c = cfg.prefill_chunk
+        start = req.prefill_pos
+        end = min(start + c, req.prompt_len)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, : end - start] = req.prompt[start:end]
+        valid = np.zeros((1, c), bool)
+        valid[0, : end - start] = True
+        self._sync_block_table(req)
+        is_final = end == req.prompt_len
+        last_idx = np.int32((req.prompt_len - 1) - start if is_final else 0)
+
+        self._kp, self._vp, tok, _logits, self._key = self._prefill_fn(
+            self._params, self._kp, self._vp,
+            self._block_tables[req.slot : req.slot + 1],
+            np.asarray([start], np.int32), chunk, valid, last_idx,
+            self._key, self._temp,
+        )
+        req.prefill_pos = end
+        if is_final:
+            self._emit_token(req, int(tok), finished)
+            if req.state is not RequestState.FINISHED:
+                req.state = RequestState.DECODE
+
+    def _decode_once(self, decoding: list[Request], finished: list[Request]) -> None:
+        cfg = self.config
+        burst = cfg.decode_burst
+        pos0 = np.zeros((cfg.num_slots,), np.int32)
+        active = np.zeros((cfg.num_slots, 1), bool)
+        toks = np.zeros((cfg.num_slots, 1), np.int32)
+        live: list[Request] = []
+        for req in decoding:
+            # the burst writes up to `burst` positions ahead (capped at the
+            # request's own budget); lane-steps past the budget scatter into
+            # the null block and are dropped host-side
+            if not self.scheduler.grow_for_decode(req, tokens_ahead=burst):
+                req.finish_reason = "out_of_blocks"
+                req.finish_time = time.perf_counter()
+                req.state = RequestState.FINISHED
+                finished.append(req)
+                continue
+            self._sync_block_table(req)
+            pos0[req.slot] = req.context_len
+            toks[req.slot, 0] = self._pending_tok[req.slot]
+            active[req.slot, 0] = True
+            live.append(req)
+        if not live:
+            return
+
+        self._kp, self._vp, next_toks, self._key = self._decode_fn(
+            self._params, self._kp, self._vp, self._block_tables, pos0, toks,
+            active, self._key, self._temp,
+        )
+        next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
+        for req in live:
+            for t in range(burst):
+                if req.state is RequestState.FINISHED:
+                    break  # mid-burst eos/length: the tail lane-steps are waste
+                self._emit_token(req, int(next_toks[t, req.slot]), finished)
+
+    def _emit_token(self, req: Request, tok: int, finished: list[Request]) -> None:
+        now = time.perf_counter()
+        req.output_tokens.append(tok)
+        self._pending_tok[req.slot] = tok
+        self._tokens_emitted += 1
+        if req.first_token_time is None:
+            req.first_token_time = now
+        eos = self.config.eos_token_id
+        if eos is not None and tok == eos:
+            req.finish_reason = "eos"
+        elif len(req.output_tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+        if req.finish_reason is not None:
+            req.finish_time = now
+            req.state = RequestState.FINISHED
+            finished.append(req)
+
+    # -- observability -------------------------------------------------------
+
+    def _emit_telemetry(self, finished: list[Request]) -> None:
+        tel = get_active_recorder()
+        if not tel:
+            return
+        for req in finished:
+            tel.record_serving(
+                kind="request",
+                request_id=req.request_id,
+                prompt_tokens=req.prompt_len,
+                new_tokens=len(req.output_tokens),
+                ttft_s=req.ttft_s,
+                tpot_s=req.tpot_s,
+                finish_reason=req.finish_reason,
+            )
+        interval = self.config.stats_interval
+        if interval and self._iterations % interval == 0:
+            now = time.perf_counter()
+            window_s = now - (self._last_stats_t or now)
+            window_tokens = self._tokens_emitted - self._last_stats_tokens
+            self._last_stats_t, self._last_stats_tokens = now, self._tokens_emitted
+            tel.record_serving(
+                kind="step",
+                iteration=self._iterations,
+                tokens_per_sec=(window_tokens / window_s) if window_s > 0 else None,
+                queue_depth=self.scheduler.queue_depth,
+                slot_occupancy=self.scheduler.occupancy,
+                free_blocks=self.allocator.free_count,
+                decode_compiles=self._decode_traces,
+                # cumulative totals: the monitor reads a bounded JSONL tail,
+                # so run-total counts must ride every row, not be re-counted
+                completed_total=len(self._completed),
+                tokens_total=self._tokens_emitted,
+            )

@@ -45,6 +45,12 @@ Record schema (every record carries ``type`` and ``ts``):
 ``generate`` — ``mode``, ``new_tokens``, ``seconds``, ``tokens_per_sec``
                and, for speculative decoding, ``accept_rate`` /
                ``verify_rounds``.
+``serving``  — continuous-batching engine rows: ``kind="step"`` (periodic
+               — ``tokens_per_sec``, ``queue_depth``, ``slot_occupancy``,
+               ``free_blocks``, ``decode_compiles``) and
+               ``kind="request"`` (per completion — ``ttft_s``,
+               ``tpot_s``, ``prompt_tokens``, ``new_tokens``,
+               ``finish_reason``).
 ``profile``  — ``trace_dir``, ``steps``, ``active_steps`` (one record per
                finished ``accelerator.profile()`` session).
 ``checkpoint`` — ``kind`` (``save``/``restore``), ``seconds``, ``bytes``,
@@ -145,6 +151,9 @@ class _NullTelemetry:
         pass
 
     def record_generation(self, *a, **k):
+        pass
+
+    def record_serving(self, *a, **k):
         pass
 
     def record_profile(self, *a, **k):
@@ -481,6 +490,15 @@ class TelemetryRecorder:
         if verify_rounds is not None:
             record["verify_rounds"] = int(verify_rounds)
         self._emit(record, step=self.optimizer_step_count)
+
+    def record_serving(self, kind: str, **fields):
+        """One serving-engine row (fed by ``serving.engine``): ``kind`` is
+        ``"step"`` (periodic — tokens/s over the window, queue depth, slot
+        occupancy, free KV blocks, decode-compile count) or ``"request"``
+        (per completion — TTFT/TPOT seconds, prompt/new token counts,
+        finish reason). ``accelerate-tpu monitor`` renders the latest of
+        each."""
+        self._emit({"type": "serving", "kind": kind, **fields}, step=self.optimizer_step_count)
 
     def record_profile(self, trace_dir: str, steps: int, active_steps: int = 0):
         self._emit(

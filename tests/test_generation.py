@@ -494,3 +494,39 @@ def test_speculative_on_prepared_target():
             generate(target, ids, max_new_tokens=8, draft_model=draft, num_draft_tokens=4)
         )
     np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_rejects_zero_draft_tokens():
+    target, draft, ids, mask = _spec_case()
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match="num_draft_tokens"):
+            generate(target, ids, max_new_tokens=4, draft_model=draft,
+                     num_draft_tokens=bad, attention_mask=mask)
+
+
+def test_speculative_exact_fit_budget_matches_plain():
+    """prompt + max_new == max_position_embeddings: the speculative cache
+    is clamped to the position-table size (no k+1 margin) and overshoot
+    writes are dropped — emitted tokens must still equal plain greedy."""
+    cfg = LlamaConfig.tiny(layers=2, seq=24)
+    target = LlamaForCausalLM.from_config(cfg, seed=0)
+    draft = LlamaForCausalLM.from_config(
+        LlamaConfig.tiny(layers=1, seq=24), seed=9
+    )
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 8)).astype(np.int32)
+    plain = np.asarray(generate(target, ids, max_new_tokens=16, use_cache=True))
+    for k in (3, 5):
+        spec = np.asarray(
+            generate(target, ids, max_new_tokens=16, draft_model=draft,
+                     num_draft_tokens=k)
+        )
+        np.testing.assert_array_equal(spec, plain)
+
+
+def test_speculative_over_budget_raises():
+    cfg = LlamaConfig.tiny(layers=2, seq=24)
+    target = LlamaForCausalLM.from_config(cfg, seed=0)
+    draft = LlamaForCausalLM.from_config(LlamaConfig.tiny(layers=1, seq=24), seed=9)
+    ids = np.zeros((1, 8), np.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(target, ids, max_new_tokens=17, draft_model=draft, num_draft_tokens=4)

@@ -225,3 +225,26 @@ def test_zoo_shapes():
             meta = factory(cfg)
         n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(meta.params))
         assert lo < n < hi, (name, n)
+
+
+def test_gelu_flavor_exact_for_neox_tanh_for_gptj():
+    """GPT-NeoX checkpoints use exact (erf) GELU (HF ``hidden_act="gelu"``)
+    while GPT-J uses the tanh approximation (``gelu_new``) — the ~1e-3 gap
+    at |x|~2 is above checkpoint-parity tolerance, so the family resolution
+    (and its explicit override) is pinned here."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.gpt_neox import _gelu
+
+    x = jnp.linspace(-4.0, 4.0, 101, dtype=jnp.float32)
+    exact = jax.nn.gelu(x, approximate=False)
+    tanh = jax.nn.gelu(x, approximate=True)
+    assert float(jnp.abs(exact - tanh).max()) > 1e-4  # the flavors differ
+
+    neox = GPTNeoXConfig.tiny()
+    gptj = GPTNeoXConfig.tiny(shared_layernorm=True, attention_bias=False)
+    np.testing.assert_array_equal(np.asarray(_gelu(neox, x)), np.asarray(exact))
+    np.testing.assert_array_equal(np.asarray(_gelu(gptj, x)), np.asarray(tanh))
+    # explicit override beats the family default
+    forced = GPTNeoXConfig.tiny(gelu_approximate=True)
+    np.testing.assert_array_equal(np.asarray(_gelu(forced, x)), np.asarray(tanh))
