@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -28,3 +28,6 @@ ckpt-smoke:       ## save -> SIGTERM mid-training -> auto-resume round-trip on a
 
 trace-smoke:      ## 20-step loop with diagnostics on; asserts the merged trace validates + watchdog quiet
 	python benchmarks/trace_smoke.py
+
+metrics-smoke:    ## records a logging_dir fixture, scrapes the sidecar exporter (in-process + HTTP), checks SLO exit codes
+	python benchmarks/metrics_smoke.py

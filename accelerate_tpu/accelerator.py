@@ -512,6 +512,23 @@ class Accelerator:
             set_active_recorder(None)
             set_compile_callback(None)
 
+        # in-process metrics registry (metrics/): ACCELERATE_METRICS=1 arms
+        # the GET /metrics aggregation surface the telemetry/span hooks
+        # feed (main-process-gated; the sidecar `accelerate-tpu metrics
+        # export` covers jobs that leave this off)
+        from .metrics.registry import MetricsRegistry, set_active_registry
+
+        if parse_flag_from_env("ACCELERATE_METRICS"):
+            self.metrics_registry = MetricsRegistry()
+            set_active_registry(self.metrics_registry)
+        else:
+            from .metrics.registry import get_active_registry
+
+            # no takeover here (unlike telemetry): a registry set by an
+            # outer owner — the serve CLI's /metrics surface — must keep
+            # aggregating across Accelerator constructions
+            self.metrics_registry = get_active_registry()
+
         # diagnostics (tracing + hang watchdog, diagnostics/): opt-in via
         # the constructor or ACCELERATE_DIAGNOSTICS=1; same Borg takeover
         # semantics as telemetry — the newest Accelerator owns the

@@ -47,6 +47,13 @@ def env_command(args) -> int:
             else "inactive (set ACCELERATE_DIAGNOSTICS=1 or "
             "Accelerator(diagnostics=True) for tracing + hang watchdog)"
         ),
+        "Metrics": (
+            "active (ACCELERATE_METRICS=1)"
+            if parse_flag_from_env("ACCELERATE_METRICS")
+            else "inactive (set ACCELERATE_METRICS=1 for an in-process "
+            "OpenMetrics registry, or run `accelerate-tpu metrics export "
+            "<logging_dir>` as a sidecar)"
+        ),
     }
     try:
         import flax

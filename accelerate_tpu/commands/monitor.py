@@ -21,7 +21,16 @@ import time
 
 
 def monitor_command(args) -> int:
+    """Exit codes in ``--once`` mode (the scriptable health check):
+
+    * ``0`` — healthy (or nothing to report yet)
+    * ``1`` — usage error (``logging_dir`` is not a directory)
+    * ``2`` — a host is wedged or a ``HANG_REPORT`` exists
+    * ``3`` — an ``ACCELERATE_SLO_*`` alert rule is firing (``ALERTS.json``
+      written next to the run's artifacts; wedged/hang wins when both hold)
+    """
     from ..diagnostics.monitor import collect_status, render_status
+    from ..metrics.alerts import EXIT_SLO_VIOLATION, evaluate_alerts, write_alerts
 
     logging_dir = args.logging_dir
     if not os.path.isdir(logging_dir):
@@ -32,8 +41,26 @@ def monitor_command(args) -> int:
             status = collect_status(logging_dir)
             text = render_status(status)
             if args.once:
+                goodput = status.get("goodput") or {}
+                serving = status.get("serving") or {}
+                firing = evaluate_alerts(
+                    {
+                        "goodput_pct": goodput.get("goodput_pct"),
+                        "ttft_p99_s": serving.get("ttft_p99_s"),
+                        "recompiles_per_hour": status.get("recompiles_per_hour"),
+                    }
+                )
+                write_alerts(logging_dir, firing)
+                for alert in firing:
+                    text += (
+                        f"\n  !! SLO {alert['rule']}: observed "
+                        f"{alert['observed']:.4g} vs threshold "
+                        f"{alert['threshold']:.4g} ({alert['env']})"
+                    )
                 print(text)
-                return 2 if (status["wedged"] or status["hang_reports"]) else 0
+                if status["wedged"] or status["hang_reports"]:
+                    return 2
+                return EXIT_SLO_VIOLATION if firing else 0
             # repaint in place: clear screen + home, like `watch`
             sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
             sys.stdout.flush()
@@ -77,8 +104,9 @@ def add_parser(subparsers):
     monitor.add_argument(
         "--once",
         action="store_true",
-        help="print one snapshot and exit (exit code 2 when a host is "
-        "wedged or a hang report exists — scriptable health check)",
+        help="print one snapshot and exit (scriptable health check: exit 2 "
+        "when a host is wedged or a hang report exists, exit 3 when an "
+        "ACCELERATE_SLO_* alert rule fires — ALERTS.json is written — else 0)",
     )
     monitor.set_defaults(func=monitor_command)
 

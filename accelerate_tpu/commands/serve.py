@@ -110,6 +110,12 @@ def _engine_loop(engine, inbox, emit, stop):
 
 
 def serve_command(args) -> int:
+    # live metrics registry: the telemetry hook (when --logging-dir is set)
+    # and the /metrics scrape both publish through it — the vLLM-style
+    # in-process exposition, vs the sidecar for embedded-serverless training
+    from ..metrics.registry import MetricsRegistry, set_active_registry
+
+    set_active_registry(MetricsRegistry())
     if args.logging_dir:
         from ..telemetry import TelemetryRecorder, set_active_recorder
 
@@ -162,8 +168,13 @@ def serve_command(args) -> int:
 def _serve_http(engine, inbox, stop, port) -> int:
     """Minimal local HTTP front end: POST /generate blocks until the
     request completes (400 on a rejected one); GET /stats returns engine
-    health JSON."""
+    health JSON; GET /metrics answers OpenMetrics text from the active
+    registry (refreshed from ``engine.stats()`` on each scrape)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..metrics.ingest import observe_engine_stats
+    from ..metrics.openmetrics import CONTENT_TYPE, render_openmetrics
+    from ..metrics.registry import get_active_registry
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -177,8 +188,26 @@ def _serve_http(engine, inbox, stop, port) -> int:
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_metrics(self):
+            registry = get_active_registry()
+            if registry:
+                try:
+                    observe_engine_stats(registry, engine.stats())
+                except Exception:
+                    pass
+            body = render_openmetrics(registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
-            if self.path.rstrip("/") in ("", "/stats", "/health"):
+            # drop any query string (Prometheus scrape params, proxies)
+            path = self.path.split("?")[0].rstrip("/")
+            if path == "/metrics":
+                self._send_metrics()
+            elif path in ("", "/stats", "/health"):
                 self._send(200, engine.stats())
             else:
                 self._send(404, {"error": "unknown path"})
@@ -211,7 +240,8 @@ def _serve_http(engine, inbox, stop, port) -> int:
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    print(f"serving on http://127.0.0.1:{port} (POST /generate, GET /stats)",
+    print(f"serving on http://127.0.0.1:{port} "
+          f"(POST /generate, GET /stats, GET /metrics)",
           file=sys.stderr)
     try:
         _engine_loop(engine, inbox, lambda *a: None, stop)

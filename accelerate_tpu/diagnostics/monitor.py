@@ -50,6 +50,52 @@ def _tail_jsonl(path: str, max_records: int = 500) -> list[dict]:
     return records
 
 
+def _tail_trail(jsonl_path: str, max_records: int = 500) -> tuple[list[dict], int]:
+    """Tail of the whole (possibly rotated) telemetry trail: newest segment
+    first, walking back through ``telemetry.jsonl.N`` until ``max_records``
+    are gathered. Rows stamped with a newer ``schema`` than this reader
+    understands are skipped (returned as a count, surfaced in the render)
+    instead of KeyError-ing downstream."""
+    from ..telemetry import schema_compatible, telemetry_segments
+
+    records: list[dict] = []
+    for segment in reversed(telemetry_segments(jsonl_path)):
+        chunk = _tail_jsonl(segment, max_records - len(records))
+        records = chunk + records
+        if len(records) >= max_records:
+            break
+    compatible = [r for r in records if schema_compatible(r)]
+    return compatible, len(records) - len(compatible)
+
+
+def _trail_head(jsonl_path: str) -> dict | None:
+    """First parseable, schema-compatible record of the OLDEST surviving
+    segment — anchors run-wide rates (the tail window alone shrinks with
+    record rate and would wildly extrapolate a single event)."""
+    from ..telemetry import schema_compatible, telemetry_segments
+
+    for segment in telemetry_segments(jsonl_path):
+        try:
+            with open(segment, "rb") as f:
+                chunk = f.read(64 * 1024)
+        except OSError:
+            continue
+        for line in chunk.splitlines():
+            try:
+                record = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if isinstance(record, dict) and schema_compatible(record):
+                return record
+    return None
+
+
+#: rates (recompiles/hour) need at least this much observed wall before
+#: they can back an SLO threshold — one benign event in a seconds-wide
+#: window must not extrapolate into a page
+MIN_RATE_WINDOW_S = 600.0
+
+
 def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]:
     """One snapshot of run health:
 
@@ -73,8 +119,11 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "tokens_per_sec": None,
         "mfu": None,
         "recompiles": None,
+        "recompiles_per_hour": None,
         "last_record_age_s": None,
         "serving": None,
+        "goodput": None,
+        "skipped_unknown_schema": 0,
         "hosts": [],
         "stragglers": [],
         "wedged": [],
@@ -83,7 +132,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
 
     # -- telemetry tail ------------------------------------------------------
     jsonl = os.path.join(logging_dir, "telemetry", "telemetry.jsonl")
-    records = _tail_jsonl(jsonl)
+    records, status["skipped_unknown_schema"] = _tail_trail(jsonl)
     steps = [r for r in records if r.get("type") == "step"]
     if steps:
         last = steps[-1]
@@ -103,6 +152,30 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
                 status[key] = vals[-1]
         if last.get("ts"):
             status["last_record_age_s"] = max(0.0, now - float(last["ts"]))
+
+    # recompile rate over the WHOLE surviving trail (an SLO-rule input):
+    # the cumulative `recompiles` field on the newest step row minus the
+    # trail head's baseline, over the head→now wall window. Anchoring on
+    # the head (not the 500-record tail, whose width shrinks with record
+    # rate) plus a minimum-window floor keeps one benign recompile from
+    # extrapolating into a page.
+    if steps:
+        head = _trail_head(jsonl)
+        last = steps[-1]
+        t0 = (head or {}).get("ts")
+        t1 = last.get("ts")
+        if (
+            isinstance(t0, (int, float))
+            and isinstance(t1, (int, float))
+            and t1 - t0 >= MIN_RATE_WINDOW_S
+            and isinstance(last.get("recompiles"), (int, float))
+        ):
+            baseline = head.get("recompiles")
+            baseline = baseline if isinstance(baseline, (int, float)) else 0
+            window_hours = (t1 - t0) / 3600.0
+            status["recompiles_per_hour"] = (
+                max(0.0, last["recompiles"] - baseline) / window_hours
+            )
 
     # -- serving engine rows -------------------------------------------------
     serving = [r for r in records if r.get("type") == "serving"]
@@ -131,8 +204,11 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
                 if last_step.get("completed_total") is not None
                 else len(srv_reqs)
             ),
-            # percentile over the tail's recent requests (windowed by design)
+            # percentiles over the tail's recent requests (windowed by design)
             "ttft_p50_s": ttfts[len(ttfts) // 2] if ttfts else None,
+            "ttft_p99_s": (
+                ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))] if ttfts else None
+            ),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -181,6 +257,14 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             )
         except (OSError, json.JSONDecodeError):
             status["hang_reports"].append({"path": path})
+
+    # -- goodput ledger (trace trails; None when diagnostics is off or the
+    # trail exceeds the parse cap — throttled per logging_dir so the repaint
+    # loop never re-parses a fat trail 30x/minute; a `--once` probe runs in
+    # a fresh process and computes fresh by construction) --------------------
+    from ..metrics.goodput import ledger_from_dir_throttled
+
+    status["goodput"] = ledger_from_dir_throttled(logging_dir)
     return status
 
 
@@ -209,8 +293,28 @@ def render_status(status: dict[str, Any]) -> str:
             f"queue {_fmt(srv['queue_depth'], '{}')}   "
             f"occupancy {_fmt(srv['slot_occupancy'], '{:.0%}')}   "
             f"free blocks {_fmt(srv['free_blocks'], '{}')}   "
-            f"done {srv['completed']} (ttft p50 {_fmt(srv['ttft_p50_s'], '{:.2f}')}s)   "
+            f"done {srv['completed']} (ttft p50 {_fmt(srv['ttft_p50_s'], '{:.2f}')}s "
+            f"p99 {_fmt(srv.get('ttft_p99_s'), '{:.2f}')}s)   "
             f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
+        )
+    goodput = status.get("goodput")
+    if goodput:
+        lost = goodput["lost_s_by_cause"]
+        lost_text = "  ".join(
+            f"{cause} {seconds:.1f}s"
+            for cause, seconds in sorted(lost.items(), key=lambda kv: -kv[1])
+            if seconds > 0
+        )
+        lines.append(
+            f"  goodput: {goodput['goodput_pct']:.1f}% of "
+            f"{goodput['elapsed_s']:.0f}s wall "
+            f"({goodput.get('hosts', 1)} host(s))"
+            + (f"   lost: {lost_text}" if lost_text else "")
+        )
+    if status.get("skipped_unknown_schema"):
+        lines.append(
+            f"  ! skipped {status['skipped_unknown_schema']} telemetry rows "
+            f"with an unknown schema version (reader older than writer?)"
         )
     if status["hosts"]:
         lines.append(f"  hosts ({len(status['hosts'])}):")

@@ -37,12 +37,27 @@ import time
 from typing import Any
 
 from ..logging import get_logger
+from ..metrics.ingest import observe_span as _observe_metrics_span
+from ..metrics.registry import get_active_registry as _get_metrics_registry
 
 logger = get_logger(__name__)
 
 #: file name pattern for per-host traces (the merge tool globs on this)
 TRACE_FILE_PATTERN = "host_{host}.trace.json"
 TRACE_SUBDIR = "traces"
+
+#: version stamped as ``schema`` on every trace event (the trace-row
+#: counterpart of ``telemetry.SCHEMA_VERSION``): readers skip-with-warning
+#: events from a NEWER writer; events with no field are legacy = accepted
+TRACE_SCHEMA_VERSION = 1
+
+
+def _trace_schema_compatible(event: dict) -> bool:
+    version = event.get("schema", 0)
+    try:
+        return int(version) <= TRACE_SCHEMA_VERSION
+    except (TypeError, ValueError):
+        return False
 
 
 def _host_index() -> int:
@@ -298,10 +313,20 @@ class Tracer:
         if attrs:
             event["args"] = attrs
         self._write_event(event)
+        # span exit → per-phase latency histogram on the scrape surface
+        # (one global read when no registry is active — and this line only
+        # runs at all when tracing itself is enabled)
+        registry = _get_metrics_registry()
+        if registry:
+            try:
+                _observe_metrics_span(registry, name, dur)
+            except Exception:
+                pass
 
     def _write_event(self, event: dict, flush: bool = False):
         if self._file is None:
             return
+        event.setdefault("schema", TRACE_SCHEMA_VERSION)
         try:
             line = json.dumps(event, default=str) + ",\n"
         except (TypeError, ValueError):
@@ -427,8 +452,11 @@ def traced(name: str | None = None):
 
 def parse_trace_file(path: str) -> list[dict]:
     """Lenient line-oriented parse of the append-format trace file: skips
-    the ``[``/``]`` bracket lines and any torn tail line a crash left."""
+    the ``[``/``]`` bracket lines, any torn tail line a crash left, and —
+    with a warning — events stamped with a newer ``schema`` version than
+    this reader understands."""
     events: list[dict] = []
+    skipped_schema = 0
     try:
         with open(path) as f:
             for line in f:
@@ -439,10 +467,19 @@ def parse_trace_file(path: str) -> list[dict]:
                     event = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail from a crash mid-write
-                if isinstance(event, dict):
-                    events.append(event)
+                if not isinstance(event, dict):
+                    continue
+                if not _trace_schema_compatible(event):
+                    skipped_schema += 1
+                    continue
+                events.append(event)
     except OSError:
         pass
+    if skipped_schema:
+        logger.warning(
+            "%s: skipped %d events with an unknown schema version (> %d) — "
+            "upgrade this reader", path, skipped_schema, TRACE_SCHEMA_VERSION,
+        )
     return events
 
 
@@ -476,10 +513,23 @@ def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
         # the most recent clock_sync above it, so a resumed run's spans
         # land at their true wall-clock position, not the dead process's.
         offset_us = 0.0  # until the first clock_sync (legacy/foreign files)
+        saw_clock_sync = False
         for e in events:
             if e.get("ph") == "M":
                 if e.get("name") == "clock_sync":
-                    offset_us = float(e["args"]["wall_minus_mono_s"]) * 1e6
+                    # a partial/killed host can leave a clock_sync with a
+                    # torn/missing args payload: warn and keep the previous
+                    # offset (zero before the first good one) instead of
+                    # crashing the whole merge on one casualty's file
+                    wall_minus_mono = (e.get("args") or {}).get("wall_minus_mono_s")
+                    if wall_minus_mono is None:
+                        logger.warning(
+                            "%s: clock_sync without wall_minus_mono_s "
+                            "(partial/killed host?) — assuming zero offset", path,
+                        )
+                    else:
+                        offset_us = float(wall_minus_mono) * 1e6
+                        saw_clock_sync = True
                     host = e.get("pid")
                     if host is not None:
                         offsets[int(host)] = offset_us / 1e6  # last epoch wins
@@ -490,6 +540,20 @@ def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
             if "ts" in e:
                 e["ts"] = float(e["ts"]) + offset_us
             merged.append(e)
+        if not saw_clock_sync:
+            # the host still lands on the merged timeline (at its raw
+            # monotonic positions) and is still counted in merged_hosts —
+            # its cross-host skew is simply unknown
+            logger.warning(
+                "%s: no clock_sync metadata (partial/killed host?) — events "
+                "merged with zero clock offset", path,
+            )
+            base = os.path.basename(path)
+            try:
+                host_id = int(base.split("_")[1].split(".")[0])
+                offsets.setdefault(host_id, 0.0)
+            except (IndexError, ValueError):
+                pass
 
     timed = [e for e in merged if "ts" in e]
     t0 = min((float(e["ts"]) for e in timed), default=0.0)

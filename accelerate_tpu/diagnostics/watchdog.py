@@ -310,6 +310,19 @@ class Watchdog:
                 )
             except Exception:
                 pass
+        else:
+            # no telemetry = no record stream to carry the event to the
+            # scrape surface; publish the hang counter directly (with
+            # telemetry on, the record_event above already feeds it)
+            from ..metrics.ingest import observe_hang
+            from ..metrics.registry import get_active_registry
+
+            registry = get_active_registry()
+            if registry:
+                try:
+                    observe_hang(registry)
+                except Exception:
+                    pass
         if self.preempt_on_hang:
             from ..resilience.preemption import get_active_handler
 

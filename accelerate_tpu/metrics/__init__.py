@@ -1,0 +1,44 @@
+"""Metrics export + goodput ledger: the operable surface over PR 1-4's
+instrumentation — a labeled metric registry fed by the telemetry record
+stream and the diagnostics spans, OpenMetrics text exposition (the
+Prometheus-scrapeable ``GET /metrics`` contract, vLLM-style), wall-clock
+goodput attribution, and ``ACCELERATE_SLO_*`` threshold alerts.
+
+Two serving modes: in-process (``accelerate-tpu serve`` answers
+``GET /metrics`` from the active registry) and sidecar
+(``accelerate-tpu metrics export <logging_dir>`` tails the JSONL/trace
+artifacts a training job writes — no server in the train loop).
+
+The exporter lives in :mod:`.exporter` and is imported lazily by its
+consumers (it pulls in :mod:`accelerate_tpu.telemetry`, which itself feeds
+this package — importing it here would cycle).
+"""
+
+from .alerts import EXIT_SLO_VIOLATION, evaluate_alerts, write_alerts
+from .goodput import BUCKETS as GOODPUT_BUCKETS
+from .goodput import ledger_from_dir, ledger_from_events
+from .openmetrics import CONTENT_TYPE, parse_openmetrics, render_openmetrics
+from .registry import (
+    DEFAULT_BUCKETS,
+    NULL_REGISTRY,
+    MetricsRegistry,
+    get_active_registry,
+    set_active_registry,
+)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "DEFAULT_BUCKETS",
+    "EXIT_SLO_VIOLATION",
+    "GOODPUT_BUCKETS",
+    "MetricsRegistry",
+    "NULL_REGISTRY",
+    "evaluate_alerts",
+    "get_active_registry",
+    "ledger_from_dir",
+    "ledger_from_events",
+    "parse_openmetrics",
+    "render_openmetrics",
+    "set_active_registry",
+    "write_alerts",
+]

@@ -1,0 +1,186 @@
+"""Record → metric mapping: ONE translation from the telemetry record
+stream (and the tracer's span exits / the serving engine's stats dict) into
+registry updates, shared by two consumers:
+
+* **in-process**: ``TelemetryRecorder._emit`` calls :func:`observe_record`
+  on every record when a registry is active, so a serving job's
+  ``GET /metrics`` reflects the live process;
+* **sidecar**: :class:`~.exporter.LoggingDirExporter` tails the telemetry
+  JSONL segments and replays each new row through the same function, so a
+  training job gets scraped without embedding an HTTP server in the train
+  loop — both surfaces agree on names and semantics by construction.
+
+Counters increment per row (both consumers see each row exactly once);
+run-cumulative *fields* on rows (``recompiles``, ``optimizer_steps``)
+ratchet counters via ``set_total`` so a restarted sidecar re-reading a
+trail converges to the same totals.
+"""
+
+from __future__ import annotations
+
+from .registry import DEFAULT_BUCKETS
+
+__all__ = ["observe_record", "observe_span", "observe_engine_stats", "observe_hang"]
+
+#: tighter buckets for per-token latencies (TTFT/TPOT)
+_LATENCY_BUCKETS = tuple(b for b in DEFAULT_BUCKETS if b <= 60.0)
+
+
+def _num(value):
+    return value if isinstance(value, (int, float)) and not isinstance(value, bool) else None
+
+
+def observe_record(registry, record: dict) -> None:
+    """Feed one telemetry record into ``registry``. Must never raise on a
+    malformed row — the sidecar tails files other processes (or versions)
+    wrote; unknown types are counted, not errors."""
+    rtype = record.get("type")
+    if rtype == "step":
+        registry.counter("steps", "Training steps recorded").inc()
+        if record.get("skipped"):
+            registry.counter("skipped_steps", "Steps skipped (non-finite grads)").inc()
+        if _num(record.get("optimizer_steps")) is not None:
+            registry.counter(
+                "optimizer_steps", "Optimizer (sync) steps completed"
+            ).set_total(record["optimizer_steps"])
+        if _num(record.get("recompiles")) is not None:
+            registry.counter(
+                "recompiles", "Cumulative XLA compilations"
+            ).set_total(record["recompiles"])
+        if _num(record.get("step_time_s")) is not None:
+            registry.histogram(
+                "step_time_seconds", "Wall-clock per training step"
+            ).observe(record["step_time_s"])
+        for field, name, help in (
+            ("tokens_per_sec", "tokens_per_second", "Training token throughput"),
+            ("examples_per_sec", "examples_per_second", "Training example throughput"),
+            ("mfu", "mfu_ratio", "Model FLOPs utilization (0-1)"),
+        ):
+            if _num(record.get(field)) is not None:
+                registry.gauge(name, help).set(record[field])
+    elif rtype == "compile":
+        registry.counter("compiles", "XLA compile events").inc()
+        if _num(record.get("total_s")) is not None:
+            registry.counter(
+                "compile_seconds", "Wall-clock spent in trace+lower+compile"
+            ).inc(record["total_s"])
+    elif rtype == "memory":
+        for field, name, help in (
+            ("device_bytes_in_use", "device_bytes_in_use", "Device HBM bytes in use"),
+            ("device_peak_bytes", "device_peak_bytes", "Device HBM high-water mark"),
+            ("host_rss_bytes", "host_rss_bytes", "Host resident set size"),
+        ):
+            if _num(record.get(field)) is not None:
+                registry.gauge(name, help).set(record[field])
+    elif rtype == "generate":
+        registry.counter("generations", "generate() calls").inc(
+            mode=str(record.get("mode", "unknown"))
+        )
+        if _num(record.get("new_tokens")) is not None:
+            registry.counter("generated_tokens", "Tokens emitted by generate()").inc(
+                record["new_tokens"]
+            )
+    elif rtype == "serving":
+        _observe_serving(registry, record)
+    elif rtype == "checkpoint":
+        kind = str(record.get("kind", "unknown"))
+        registry.counter("checkpoints", "Checkpoint save/restore events").inc(kind=kind)
+        if _num(record.get("seconds")) is not None:
+            registry.histogram(
+                "checkpoint_seconds", "Wall-clock per checkpoint save/restore"
+            ).observe(record["seconds"], kind=kind)
+        if _num(record.get("bytes")) is not None:
+            registry.counter(
+                "checkpoint_bytes", "Bytes written/read by checkpointing"
+            ).inc(record["bytes"], kind=kind)
+    elif rtype == "event":
+        kind = str(record.get("kind", "unknown"))
+        registry.counter("events", "Free-form telemetry events").inc(kind=kind)
+        if kind == "watchdog_hang":
+            observe_hang(registry)
+    elif rtype is not None:
+        registry.counter("records_other", "Telemetry rows of unmapped types").inc(
+            type=str(rtype)
+        )
+
+
+def _observe_serving(registry, record: dict) -> None:
+    kind = record.get("kind")
+    if kind == "request":
+        registry.counter("serving_requests", "Completed serving requests").inc(
+            finish_reason=str(record.get("finish_reason", "unknown"))
+        )
+        if _num(record.get("new_tokens")) is not None:
+            registry.counter("serving_tokens", "Tokens emitted by the engine").inc(
+                record["new_tokens"]
+            )
+        if _num(record.get("ttft_s")) is not None:
+            registry.histogram(
+                "serving_ttft_seconds", "Time to first token",
+                buckets=_LATENCY_BUCKETS,
+            ).observe(record["ttft_s"])
+        if _num(record.get("tpot_s")) is not None:
+            registry.histogram(
+                "serving_tpot_seconds", "Time per output token",
+                buckets=_LATENCY_BUCKETS,
+            ).observe(record["tpot_s"])
+    elif kind == "step":
+        for field, name, help in (
+            ("tokens_per_sec", "serving_tokens_per_second", "Engine token throughput (window)"),
+            ("queue_depth", "serving_queue_depth", "Requests waiting for a slot"),
+            ("slot_occupancy", "serving_slot_occupancy", "Fraction of decode slots busy"),
+            ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
+        ):
+            if _num(record.get(field)) is not None:
+                registry.gauge(name, help).set(record[field])
+        if _num(record.get("decode_compiles")) is not None:
+            registry.counter(
+                "serving_decode_compiles", "Decode executable re-traces"
+            ).set_total(record["decode_compiles"])
+        if _num(record.get("completed_total")) is not None:
+            registry.counter(
+                "serving_completed", "Engine-reported completed requests (cumulative)"
+            ).set_total(record["completed_total"])
+
+
+def observe_span(registry, name: str, seconds: float) -> None:
+    """One closed trace span → the per-phase latency histogram. Span names
+    are a small fixed vocabulary (the built-in instrumentation points), so
+    the label cardinality stays bounded."""
+    registry.histogram(
+        "span_seconds", "Wall-clock per instrumented phase (trace spans)"
+    ).observe(seconds, name=name)
+
+
+def observe_hang(registry) -> None:
+    registry.counter("watchdog_hangs", "Watchdog hang-report firings").inc()
+
+
+def observe_engine_stats(registry, stats: dict) -> None:
+    """Refresh gauges from ``InferenceEngine.stats()`` — called by the serve
+    front end on each ``GET /metrics`` so a scrape is never staler than the
+    engine's own counters, even between periodic telemetry rows."""
+    for field, name, help in (
+        ("queue_depth", "serving_queue_depth", "Requests waiting for a slot"),
+        ("slot_occupancy_mean", "serving_slot_occupancy", "Fraction of decode slots busy"),
+        ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
+        ("tokens_per_sec", "serving_tokens_per_second", "Engine token throughput (window)"),
+    ):
+        if _num(stats.get(field)) is not None:
+            registry.gauge(name, help).set(stats[field])
+    if _num(stats.get("tokens_emitted")) is not None:
+        registry.counter("serving_tokens", "Tokens emitted by the engine").set_total(
+            stats["tokens_emitted"]
+        )
+    if _num(stats.get("completed")) is not None:
+        registry.counter(
+            "serving_completed", "Engine-reported completed requests (cumulative)"
+        ).set_total(stats["completed"])
+    if _num(stats.get("decode_compiles")) is not None:
+        registry.counter(
+            "serving_decode_compiles", "Decode executable re-traces"
+        ).set_total(stats["decode_compiles"])
+    if _num(stats.get("iterations")) is not None:
+        registry.counter("serving_iterations", "Engine scheduler iterations").set_total(
+            stats["iterations"]
+        )

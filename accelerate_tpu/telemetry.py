@@ -23,7 +23,16 @@ Enable with ``Accelerator(telemetry=True)`` or ``ACCELERATE_TELEMETRY=1``.
 Disabled, every instrumentation point holds a :data:`NULL_TELEMETRY`
 singleton whose methods are no-ops — the hot path pays one attribute read.
 
-Record schema (every record carries ``type`` and ``ts``):
+The JSONL trail is size-capped (``ACCELERATE_TELEMETRY_MAX_BYTES``, default
+64 MB, keeping ``ACCELERATE_TELEMETRY_KEEP_SEGMENTS`` rotated segments) —
+:func:`telemetry_segments` lists a trail's segments oldest-first for
+readers (``accelerate-tpu monitor``, the metrics exporter). An active
+:class:`~accelerate_tpu.metrics.MetricsRegistry` additionally receives
+every record through :func:`accelerate_tpu.metrics.ingest.observe_record`
+— the ``GET /metrics`` surface.
+
+Record schema (every record carries ``type``, ``ts``, and ``schema`` —
+see :data:`SCHEMA_VERSION`):
 
 ``step``     — ``step``, ``optimizer_steps``, ``step_time_s``,
                ``dispatch_s``, ``device_s``, ``examples``, ``tokens``,
@@ -73,8 +82,51 @@ from typing import Any, Callable
 import numpy as np
 
 from .logging import get_logger
+from .metrics.ingest import observe_record as _observe_metrics_record
+from .metrics.registry import get_active_registry as _get_metrics_registry
 
 logger = get_logger(__name__)
+
+#: version stamped as ``schema`` on every emitted record. Readers
+#: (``monitor``, the metrics exporter) must skip-with-warning rows whose
+#: version is NEWER than theirs instead of KeyError-ing on reshaped fields;
+#: rows with no ``schema`` field are the pre-versioning legacy format and
+#: are accepted. Bump on any backward-incompatible row reshape.
+SCHEMA_VERSION = 1
+
+
+def schema_compatible(row: dict) -> bool:
+    """True when this reader understands ``row``'s schema version (missing
+    field = legacy = compatible; garbage values are incompatible)."""
+    version = row.get("schema", 0)
+    try:
+        return int(version) <= SCHEMA_VERSION
+    except (TypeError, ValueError):
+        return False
+
+
+def telemetry_segments(jsonl_path: str) -> list[str]:
+    """Existing JSONL segments for a trail, oldest → newest: rotated
+    ``telemetry.jsonl.N`` … ``telemetry.jsonl.1`` then the live file.
+    Readers (``monitor``'s tail, the metrics exporter) iterate this instead
+    of assuming one unbounded file."""
+    segments: list[str] = []
+    suffixes = []
+    try:
+        directory = os.path.dirname(jsonl_path) or "."
+        base = os.path.basename(jsonl_path)
+        for name in os.listdir(directory):
+            if name.startswith(base + "."):
+                tail = name[len(base) + 1 :]
+                if tail.isdigit():
+                    suffixes.append(int(tail))
+    except OSError:
+        pass
+    for n in sorted(suffixes, reverse=True):
+        segments.append(f"{jsonl_path}.{n}")
+    if os.path.exists(jsonl_path):
+        segments.append(jsonl_path)
+    return segments
 
 #: Peak dense bf16 FLOPs/s per chip by device kind (public spec sheets;
 #: same table the bench harness uses). Override per-run with
@@ -270,13 +322,28 @@ class TelemetryRecorder:
         self._pending_backward_s: float = 0.0
         self._last_step_end: float | None = None
 
-        # JSONL sink (main process only; crash-safe append)
+        # JSONL sink (main process only; crash-safe append). The trail is
+        # size-capped: past ACCELERATE_TELEMETRY_MAX_BYTES the live file
+        # rolls to telemetry.jsonl.1 (older segments shift up, the oldest
+        # beyond ACCELERATE_TELEMETRY_KEEP_SEGMENTS drops) — a weeks-long
+        # serving job must not grow an unbounded trail. 0 disables rotation.
         self._jsonl = None
         self._jsonl_path = None
+        self._jsonl_bytes = 0
+        self._jsonl_max_bytes = int(
+            os.environ.get("ACCELERATE_TELEMETRY_MAX_BYTES", str(64 * 1024 * 1024))
+        )
+        self._jsonl_keep = max(
+            1, int(os.environ.get("ACCELERATE_TELEMETRY_KEEP_SEGMENTS", "4"))
+        )
         if logging_dir is not None and _is_main_process():
             tel_dir = os.path.join(logging_dir, "telemetry")
             os.makedirs(tel_dir, exist_ok=True)
             self._jsonl_path = os.path.join(tel_dir, "telemetry.jsonl")
+            try:
+                self._jsonl_bytes = os.path.getsize(self._jsonl_path)
+            except OSError:
+                self._jsonl_bytes = 0
             self._jsonl = open(self._jsonl_path, "a")
 
         from .lazy import set_compile_callback
@@ -295,11 +362,25 @@ class TelemetryRecorder:
 
     def _emit(self, record: dict, fan_out: bool = True, step: int | None = None):
         record.setdefault("ts", time.time())
+        record.setdefault("schema", SCHEMA_VERSION)
         self.records.append(record)
+        # metrics fan-out: the active MetricsRegistry (GET /metrics surface)
+        # sees every record through the same mapping the sidecar exporter
+        # replays from the JSONL — disabled is one global read
+        metrics_registry = _get_metrics_registry()
+        if metrics_registry:
+            try:
+                _observe_metrics_record(metrics_registry, record)
+            except Exception:  # the scrape surface must never kill training
+                logger.warning("metrics ingest failed", exc_info=True)
         if self._jsonl is not None:
             try:
-                self._jsonl.write(json.dumps(record, default=_json_default) + "\n")
+                line = json.dumps(record, default=_json_default) + "\n"
+                self._jsonl.write(line)
                 self._jsonl.flush()
+                self._jsonl_bytes += len(line)
+                if self._jsonl_max_bytes and self._jsonl_bytes >= self._jsonl_max_bytes:
+                    self._rotate_jsonl()
             except ValueError:  # closed file (end_training raced a record)
                 pass
         if fan_out and self._tracker_sink is not None and _is_main_process():
@@ -313,6 +394,40 @@ class TelemetryRecorder:
                     self._tracker_sink(values, step)
                 except Exception:  # tracker failures must not kill training
                     logger.warning("telemetry tracker fan-out failed", exc_info=True)
+
+    def _rotate_jsonl(self):
+        """Size-capped rollover: close the live file, shift rotated
+        segments up one slot (dropping the oldest beyond the keep count),
+        move the live trail to ``.1``, reopen fresh. Readers that follow
+        :func:`telemetry_segments` see one continuous trail across the
+        roll; a crash mid-rotation loses at most the rename in flight (the
+        segment files themselves are never rewritten)."""
+        if self._jsonl is None or self._jsonl_path is None:
+            return
+        try:
+            self._jsonl.close()
+        except Exception:
+            pass
+        self._jsonl = None
+        path = self._jsonl_path
+        try:
+            oldest = f"{path}.{self._jsonl_keep}"
+            if os.path.exists(oldest):
+                os.unlink(oldest)
+            for n in range(self._jsonl_keep - 1, 0, -1):
+                src = f"{path}.{n}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{n + 1}")
+            os.replace(path, f"{path}.1")
+        except OSError:
+            logger.warning("telemetry JSONL rotation failed", exc_info=True)
+        try:
+            self._jsonl = open(path, "a")
+            self._jsonl_bytes = 0
+        except OSError:
+            logger.warning("telemetry JSONL reopen failed; file sink disabled",
+                           exc_info=True)
+            self._jsonl = None
 
     # -- compile events (lazy.py miss callback) ------------------------------
 

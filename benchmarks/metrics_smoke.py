@@ -1,0 +1,132 @@
+"""``make metrics-smoke``: the scrape surface end to end against a recorded
+logging_dir fixture.
+
+1. Record the fixture: a 20-step toy loop with telemetry + diagnostics
+   writes a real telemetry JSONL trail and trace trail.
+2. Sidecar in-process: ``LoggingDirExporter`` refreshes from the fixture
+   and the exposition round-trips through the strict OpenMetrics parser
+   with the expected families (steps, compiles, goodput).
+3. Sidecar over HTTP: the real ``accelerate-tpu metrics export`` CLI is
+   spawned as a subprocess on an ephemeral port and scraped with urllib —
+   the same bytes a Prometheus scraper would see.
+4. SLO alerting: an impossible ``ACCELERATE_SLO_MIN_GOODPUT_PCT=101``
+   makes ``metrics export --once`` exit 3 and write ``ALERTS.json``.
+
+Exit code is the CI signal; prints a one-line OK.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _record_fixture(tmp: str) -> None:
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = Accelerator(project_dir=tmp, telemetry=True, diagnostics=True)
+    model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+    x = np.linspace(-1, 1, 16).astype(np.float32)
+    y = (2 * x + 3).astype(np.float32)
+    for _ in range(20):
+        out = model(x=x, y=y)
+        acc.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+    acc.end_training()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter
+    from accelerate_tpu.metrics.openmetrics import parse_openmetrics, sample_value
+
+    tmp = tempfile.mkdtemp(prefix="metrics_smoke_")
+    _record_fixture(tmp)
+
+    # -- in-process sidecar: refresh + strict round-trip ---------------------
+    exporter = LoggingDirExporter(tmp)
+    assert exporter.refresh() == [], "no SLO rules armed yet, nothing may fire"
+    families = parse_openmetrics(exporter.render())
+    steps = sample_value(families, "accelerate_steps")
+    assert steps == 20, f"expected 20 step rows, scraped {steps}"
+    assert sample_value(families, "accelerate_compiles") >= 1
+    goodput = sample_value(families, "accelerate_goodput_ratio")
+    assert goodput is not None and 0.0 <= goodput <= 1.0, goodput
+    assert "accelerate_step_time_seconds" in families  # histogram family
+
+    # -- real CLI sidecar over HTTP ------------------------------------------
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "metrics", "export", tmp, "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        body = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as resp:
+                    assert "openmetrics-text" in resp.headers.get("Content-Type", "")
+                    body = resp.read().decode()
+                break
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"exporter died: {proc.stderr.read()[-2000:]}"
+                    ) from None
+                time.sleep(0.25)
+        assert body is not None, "exporter never answered /metrics"
+        scraped = parse_openmetrics(body)
+        assert sample_value(scraped, "accelerate_steps") == 20
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+    # -- SLO alerting: --once exits 3 + writes ALERTS.json -------------------
+    env_slo = dict(env, ACCELERATE_SLO_MIN_GOODPUT_PCT="101")
+    once = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "metrics", "export", tmp, "--once"],
+        env=env_slo, capture_output=True, text=True, timeout=300,
+    )
+    assert once.returncode == 3, (once.returncode, once.stderr[-2000:])
+    parse_openmetrics(once.stdout)  # --once output is a full exposition too
+    alerts = json.load(open(os.path.join(tmp, "ALERTS.json")))
+    assert [a["rule"] for a in alerts["firing"]] == ["min_goodput_pct"]
+
+    print(
+        f"metrics-smoke OK: {len(families)} families in-process, "
+        f"{len(scraped)} over HTTP (port {port}), steps=20, "
+        f"goodput={goodput:.1%}, SLO breach -> exit 3 + ALERTS.json; "
+        f"fixture at {tmp}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

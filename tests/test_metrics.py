@@ -1,0 +1,733 @@
+"""Metrics subsystem tests: registry → OpenMetrics round-trip through the
+strict parser (type lines, label escaping, histogram bucket monotonicity),
+the goodput ledger's sum-to-wall invariant under synthetic span streams and
+a real toy run, the sidecar exporter (incremental + rotation-proof
+tailing), SLO alert rules with the monitor/exporter exit codes, and this
+PR's satellites (telemetry JSONL rotation, schema versioning, trace merge
+without clock_sync)."""
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.metrics import (
+    MetricsRegistry,
+    NULL_REGISTRY,
+    evaluate_alerts,
+    get_active_registry,
+    ledger_from_dir,
+    ledger_from_events,
+    parse_openmetrics,
+    render_openmetrics,
+    set_active_registry,
+)
+from accelerate_tpu.metrics.openmetrics import sample_value
+from accelerate_tpu.telemetry import (
+    SCHEMA_VERSION,
+    TelemetryRecorder,
+    schema_compatible,
+    set_active_recorder,
+    telemetry_segments,
+)
+
+E6 = 1e6  # trace timestamps are monotonic microseconds
+
+
+@pytest.fixture(autouse=True)
+def _clear_metrics_globals():
+    """The registry/recorder/tracer are process-wide Borg state; tests must
+    not leak them into each other."""
+    yield
+    from accelerate_tpu import lazy
+    from accelerate_tpu.diagnostics import set_active_tracer
+
+    set_active_registry(None)
+    set_active_recorder(None)
+    set_active_tracer(None)
+    lazy.set_compile_callback(None)
+
+
+# ---------------------------------------------------------------------------
+# registry + OpenMetrics round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_openmetrics_round_trip_counters_gauges_labels():
+    reg = MetricsRegistry(gate_main_process=False)
+    reg.counter("steps", "Training steps").inc()
+    reg.counter("steps").inc(4)
+    reg.gauge("mfu_ratio", "MFU").set(0.4175)
+    # label escaping: backslash, quote, newline all survive the round trip
+    nasty = 'quo"te\\back\nnewline'
+    reg.counter("serving_requests", "done").inc(2, finish_reason=nasty)
+    text = render_openmetrics(reg)
+    families = parse_openmetrics(text)
+    assert families["accelerate_steps"]["type"] == "counter"
+    assert sample_value(families, "accelerate_steps") == 5
+    assert sample_value(families, "accelerate_mfu_ratio") == pytest.approx(0.4175)
+    assert sample_value(
+        families, "accelerate_serving_requests", finish_reason=nasty
+    ) == 2
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_openmetrics_histogram_buckets_cumulative_and_inf():
+    reg = MetricsRegistry(gate_main_process=False)
+    h = reg.histogram("step_time_seconds", "per step", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 99.0):  # last lands past every bound
+        h.observe(v)
+    families = parse_openmetrics(render_openmetrics(reg))
+    fam = families["accelerate_step_time_seconds"]
+    buckets = {
+        labels["le"]: value
+        for name, labels, value in fam["samples"]
+        if name.endswith("_bucket")
+    }
+    assert buckets == {"0.01": 2, "0.1": 3, "1": 4, "+Inf": 5}
+    assert sample_value(families, "accelerate_step_time_seconds",
+                        "accelerate_step_time_seconds_count") == 5
+    assert sample_value(families, "accelerate_step_time_seconds",
+                        "accelerate_step_time_seconds_sum") == pytest.approx(99.56)
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        "accelerate_x_total 1\n# EOF\n",  # sample without a declared family
+        "# TYPE accelerate_x counter\naccelerate_x 1\n# EOF\n",  # counter w/o _total
+        "# TYPE accelerate_x counter\naccelerate_x_total 1\n",  # missing # EOF
+        '# TYPE a_h histogram\na_h_bucket{le="1"} 5\na_h_bucket{le="+Inf"} 3\n'
+        "a_h_count 3\na_h_sum 1\n# EOF\n",  # non-monotonic buckets
+        '# TYPE a_h histogram\na_h_bucket{le="1"} 2\na_h_count 2\na_h_sum 1\n'
+        "# EOF\n",  # missing +Inf bucket
+        '# TYPE a counter\na_total{l="bad\\q"} 1\n# EOF\n',  # bad escape
+    ],
+)
+def test_strict_parser_rejects_malformed(text):
+    with pytest.raises(ValueError):
+        parse_openmetrics(text)
+
+
+def test_counters_are_monotonic_and_kind_collisions_raise():
+    reg = MetricsRegistry(gate_main_process=False)
+    c = reg.counter("steps")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    c.inc(10)
+    c.set_total(5)  # ratchet: lower re-reads never move a counter back
+    assert c.value() == 10
+    c.set_total(15)
+    assert c.value() == 15
+    with pytest.raises(ValueError):
+        reg.gauge("steps")  # already a counter
+
+
+def test_null_registry_is_falsy_noop():
+    assert not NULL_REGISTRY
+    assert get_active_registry() is NULL_REGISTRY  # default state
+    NULL_REGISTRY.counter("x").inc()
+    NULL_REGISTRY.histogram("y").observe(1.0)
+    assert NULL_REGISTRY.collect() == []
+    assert parse_openmetrics(render_openmetrics(NULL_REGISTRY)) == {}
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_events():
+    """100s of wall: a 60s step span with a 20s compile INSIDE it, a 5s
+    checkpoint, 3s of dataloader, a watchdog hang covering [90, 95], and a
+    prepare span that must bill to idle."""
+    return [
+        {"ph": "X", "name": "step/dispatch", "ts": 0.0, "dur": 60 * E6},
+        {"ph": "X", "name": "compile/compile", "ts": 10 * E6, "dur": 20 * E6},
+        {"ph": "X", "name": "checkpoint/save", "ts": 70 * E6, "dur": 5 * E6},
+        {"ph": "X", "name": "dataloader/fetch", "ts": 76 * E6, "dur": 3 * E6},
+        {"ph": "i", "name": "watchdog/hang", "ts": 95 * E6, "args": {"elapsed_s": 5.0}},
+        {"ph": "X", "name": "prepare", "ts": 99 * E6, "dur": 1 * E6},
+    ]
+
+
+def test_goodput_buckets_are_exclusive_and_sum_to_wall():
+    ledger = ledger_from_events(_synthetic_events(), host=0)
+    b = ledger["buckets_s"]
+    assert ledger["elapsed_s"] == pytest.approx(100.0)
+    # the compile overlap is billed to compile, NOT double-counted in step
+    assert b["step"] == pytest.approx(40.0)
+    assert b["compile"] == pytest.approx(20.0)
+    assert b["checkpoint"] == pytest.approx(5.0)
+    assert b["dataloader"] == pytest.approx(3.0)
+    assert b["hang"] == pytest.approx(5.0)
+    assert b["idle"] == pytest.approx(27.0)  # incl. the prepare second
+    assert sum(b.values()) == pytest.approx(ledger["elapsed_s"], rel=1e-9)
+    assert ledger["goodput_pct"] == pytest.approx(40.0)
+    assert "step" not in ledger["lost_s_by_cause"]
+
+
+def test_goodput_overlapping_same_bucket_spans_not_double_counted():
+    events = [  # two step spans overlapping on [10, 20]: covered = 30s of 40
+        {"ph": "X", "name": "step/dispatch", "ts": 0.0, "dur": 20 * E6},
+        {"ph": "X", "name": "backward/dispatch", "ts": 10 * E6, "dur": 20 * E6},
+        {"ph": "X", "name": "prepare", "ts": 30 * E6, "dur": 10 * E6},
+    ]
+    ledger = ledger_from_events(events)
+    assert ledger["buckets_s"]["step"] == pytest.approx(30.0)
+    assert ledger["buckets_s"]["idle"] == pytest.approx(10.0)
+    assert sum(ledger["buckets_s"].values()) == pytest.approx(40.0, rel=1e-9)
+
+
+def test_goodput_from_real_toy_run(tmp_path):
+    """Acceptance bar: on a recorded trace fixture the buckets sum to the
+    elapsed wall within 1%."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel
+
+    acc = Accelerator(project_dir=str(tmp_path), telemetry=True, diagnostics=True)
+    model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+    x = np.linspace(-1, 1, 16).astype(np.float32)
+    y = (2 * x + 3).astype(np.float32)
+    for _ in range(20):
+        out = model(x=x, y=y)
+        acc.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+    acc.end_training()
+
+    ledger = ledger_from_dir(str(tmp_path))
+    assert ledger is not None and ledger["hosts"] == 1
+    total = sum(ledger["buckets_s"].values())
+    assert total == pytest.approx(ledger["elapsed_s"], rel=0.01)
+    assert ledger["buckets_s"]["step"] > 0  # the loop did productive work
+    assert ledger["buckets_s"]["compile"] > 0  # and compiled at least once
+    assert 0.0 < ledger["goodput_pct"] < 100.0
+
+
+def test_goodput_none_without_traces(tmp_path):
+    assert ledger_from_dir(str(tmp_path)) is None
+
+
+def test_goodput_partitions_monotonic_epochs_at_clock_sync():
+    """An auto-resumed run appends a SECOND monotonic epoch (fresh
+    perf_counter origin + fresh clock_sync) to the same trail; raw
+    timestamps across epochs are not comparable and must not be mixed into
+    one giant elapsed window."""
+    events = [
+        {"ph": "M", "name": "clock_sync", "args": {"wall_minus_mono_s": 1.0}},
+        # first life: mono 1000-2000s, 600s of step work
+        {"ph": "X", "name": "step/dispatch", "ts": 1000 * E6, "dur": 600 * E6},
+        {"ph": "X", "name": "prepare", "ts": 1600 * E6, "dur": 400 * E6},
+        # restart: mono origin resets far BELOW the first epoch
+        {"ph": "M", "name": "clock_sync", "args": {"wall_minus_mono_s": 2.0}},
+        {"ph": "X", "name": "step/dispatch", "ts": 50 * E6, "dur": 100 * E6},
+    ]
+    ledger = ledger_from_events(events, host=0)
+    assert ledger["epochs"] == 2
+    # NOT max(ts)-min(ts) ≈ 1950s: each epoch attributed independently
+    assert ledger["elapsed_s"] == pytest.approx(1000.0 + 100.0)
+    assert ledger["buckets_s"]["step"] == pytest.approx(700.0)
+    assert sum(ledger["buckets_s"].values()) == pytest.approx(
+        ledger["elapsed_s"], rel=1e-9
+    )
+    assert ledger["goodput_pct"] == pytest.approx(700.0 / 1100.0 * 100.0)
+
+
+def test_recompile_rate_needs_minimum_window(tmp_path):
+    """One benign recompile in a seconds-wide trail must NOT extrapolate to
+    an hours rate (MIN_RATE_WINDOW_S floor); a wide-enough trail computes
+    the run-anchored rate from the cumulative field."""
+    from accelerate_tpu.diagnostics.monitor import MIN_RATE_WINDOW_S, collect_status
+
+    now = time.time()
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 1, "optimizer_steps": 1, "step_time_s": 0.1,
+         "recompiles": 0, "ts": now - 50, "schema": SCHEMA_VERSION},
+        {"type": "compile", "total_s": 1.0, "ts": now, "schema": SCHEMA_VERSION},
+        {"type": "step", "step": 2, "optimizer_steps": 2, "step_time_s": 0.1,
+         "recompiles": 1, "ts": now, "schema": SCHEMA_VERSION},
+    ])
+    status = collect_status(str(tmp_path), now=now)
+    assert status["recompiles_per_hour"] is None  # 50s window < floor
+
+    (tmp_path / "telemetry" / "telemetry.jsonl").unlink()
+    window = MIN_RATE_WINDOW_S * 2
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 1, "optimizer_steps": 1, "step_time_s": 0.1,
+         "recompiles": 0, "ts": now - window, "schema": SCHEMA_VERSION},
+        {"type": "step", "step": 2, "optimizer_steps": 2, "step_time_s": 0.1,
+         "recompiles": 2, "ts": now, "schema": SCHEMA_VERSION},
+    ])
+    status = collect_status(str(tmp_path), now=now)
+    assert status["recompiles_per_hour"] == pytest.approx(2 / (window / 3600.0))
+
+
+# ---------------------------------------------------------------------------
+# in-process hooks (telemetry records + tracer spans → registry)
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_records_feed_active_registry():
+    reg = MetricsRegistry(gate_main_process=False)
+    set_active_registry(reg)
+    rec = TelemetryRecorder(logging_dir=None, memory_interval=0)
+    try:
+        rec.record_step(dispatch_s=0.01)
+        rec.record_step(dispatch_s=0.01, skipped=True)
+        rec.record_checkpoint("save", seconds=1.5, bytes_written=1024)
+        rec.record_serving(kind="request", ttft_s=0.2, new_tokens=8,
+                           finish_reason="eos")
+        rec.record_event("watchdog_hang", elapsed_s=9.0)
+    finally:
+        rec.close()
+    assert reg.counter("steps").value() == 2
+    assert reg.counter("skipped_steps").value() == 1
+    assert reg.counter("checkpoints").value(kind="save") == 1
+    assert reg.counter("checkpoint_bytes").value(kind="save") == 1024
+    assert reg.counter("serving_requests").value(finish_reason="eos") == 1
+    assert reg.counter("watchdog_hangs").value() == 1
+    count, total = reg.histogram("step_time_seconds").value()
+    assert count == 2 and total > 0
+    # and the exposition of all of it round-trips strictly
+    parse_openmetrics(render_openmetrics(reg))
+
+
+def test_tracer_span_exits_feed_span_histogram(tmp_path):
+    from accelerate_tpu.diagnostics import Tracer, set_active_tracer
+
+    reg = MetricsRegistry(gate_main_process=False)
+    set_active_registry(reg)
+    tracer = Tracer(logging_dir=str(tmp_path), host=0)
+    set_active_tracer(tracer)
+    try:
+        with tracer.span("collective/gather"):
+            pass
+        with tracer.span("collective/gather"):
+            pass
+    finally:
+        tracer.close()
+    count, _ = reg.histogram("span_seconds").value(name="collective/gather")
+    assert count == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: telemetry JSONL rotation
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_rotation_caps_live_file_and_keeps_segments(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_MAX_BYTES", "600")
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_KEEP_SEGMENTS", "2")
+    rec = TelemetryRecorder(logging_dir=str(tmp_path), memory_interval=0)
+    try:
+        for i in range(60):
+            rec.record_event("filler", i=i, pad="x" * 40)
+    finally:
+        rec.close()
+    jsonl = tmp_path / "telemetry" / "telemetry.jsonl"
+    segments = telemetry_segments(str(jsonl))
+    # keep=2 rotated segments + the live file, oldest first
+    assert [os.path.basename(p) for p in segments] == [
+        "telemetry.jsonl.2", "telemetry.jsonl.1", "telemetry.jsonl",
+    ]
+    assert not (tmp_path / "telemetry" / "telemetry.jsonl.3").exists()
+    assert os.path.getsize(jsonl) <= 600 + 200  # one record of slack
+    # every segment is intact JSONL and the newest record is in the live file
+    rows = [json.loads(line) for p in segments for line in open(p)]
+    assert rows[-1]["i"] == 59
+    # the trail is contiguous from the newest surviving record backwards
+    kept = [r["i"] for r in rows]
+    assert kept == list(range(kept[0], 60))
+
+
+def test_monitor_tail_reads_across_rotated_segments(tmp_path, monkeypatch):
+    from accelerate_tpu.diagnostics.monitor import collect_status
+
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_MAX_BYTES", "2000")
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_KEEP_SEGMENTS", "3")
+    rec = TelemetryRecorder(logging_dir=str(tmp_path), memory_interval=0)
+    try:
+        for _ in range(40):
+            rec.record_step(dispatch_s=0.01)
+    finally:
+        rec.close()
+    assert len(telemetry_segments(str(tmp_path / "telemetry" / "telemetry.jsonl"))) > 1
+    status = collect_status(str(tmp_path))
+    assert status["steps"] == 40  # the newest row, found despite rotation
+
+
+# ---------------------------------------------------------------------------
+# satellite: schema versioning
+# ---------------------------------------------------------------------------
+
+
+def test_schema_stamped_and_compat_logic():
+    rec = TelemetryRecorder(logging_dir=None, memory_interval=0)
+    try:
+        rec.record_event("x")
+        assert rec.records[-1]["schema"] == SCHEMA_VERSION
+    finally:
+        rec.close()
+    assert schema_compatible({})  # legacy rows: accepted
+    assert schema_compatible({"schema": SCHEMA_VERSION})
+    assert not schema_compatible({"schema": SCHEMA_VERSION + 1})
+    assert not schema_compatible({"schema": "garbage"})
+
+
+def test_monitor_skips_unknown_schema_rows_without_keyerror(tmp_path):
+    from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+
+    tel_dir = tmp_path / "telemetry"
+    tel_dir.mkdir()
+    now = time.time()
+    with open(tel_dir / "telemetry.jsonl", "w") as f:
+        f.write(json.dumps({"type": "step", "step": 7, "optimizer_steps": 7,
+                            "step_time_s": 0.1, "recompiles": 1, "ts": now,
+                            "schema": SCHEMA_VERSION}) + "\n")
+        # a future writer reshaped the row: no step_time_s, new schema —
+        # must be SKIPPED, not crash the reader
+        f.write(json.dumps({"type": "step", "schema": SCHEMA_VERSION + 5,
+                            "steps_v99": {"nested": True}, "ts": now}) + "\n")
+    status = collect_status(str(tmp_path), now=now)
+    assert status["steps"] == 7  # the compatible row still counts
+    assert status["skipped_unknown_schema"] == 1
+    assert "unknown schema" in render_status(status)
+
+
+def test_trace_events_stamped_and_unknown_schema_skipped(tmp_path):
+    from accelerate_tpu.diagnostics import Tracer
+    from accelerate_tpu.diagnostics.tracing import (
+        TRACE_SCHEMA_VERSION,
+        parse_trace_file,
+    )
+
+    tracer = Tracer(logging_dir=str(tmp_path), host=0)
+    with tracer.span("phase"):
+        pass
+    tracer.close()
+    path = tmp_path / "traces" / "host_0.trace.json"
+    events = parse_trace_file(str(path))
+    assert events and all(e["schema"] == TRACE_SCHEMA_VERSION for e in events)
+    with open(path, "a") as f:
+        f.write(json.dumps({"name": "future", "ph": "X", "ts": 1, "dur": 1,
+                            "schema": TRACE_SCHEMA_VERSION + 1}) + ",\n")
+    names = {e["name"] for e in parse_trace_file(str(path))}
+    assert "phase" in names and "future" not in names
+
+
+# ---------------------------------------------------------------------------
+# satellite: trace merge without clock_sync
+# ---------------------------------------------------------------------------
+
+
+def test_trace_merge_survives_missing_clock_sync(tmp_path):
+    """A partial/killed host's file with no clock_sync metadata must merge
+    with zero offset (warned, not crashed) and still be counted."""
+    from accelerate_tpu.diagnostics import merge_traces, validate_chrome_trace
+
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    (trace_dir / "host_0.trace.json").write_text(
+        "[\n"
+        + json.dumps({"name": "clock_sync", "ph": "M", "pid": 0, "tid": 0,
+                      "args": {"wall_minus_mono_s": 100.0}}) + ",\n"
+        + json.dumps({"name": "step", "ph": "X", "ts": 1.0 * E6, "dur": 10.0,
+                      "pid": 0, "tid": 1}) + ",\n"
+    )
+    # host 1 was SIGKILLed before its clock_sync flushed
+    (trace_dir / "host_1.trace.json").write_text(
+        "[\n"
+        + json.dumps({"name": "step", "ph": "X", "ts": 2.0 * E6, "dur": 10.0,
+                      "pid": 1, "tid": 1}) + ",\n"
+    )
+    # and host 2's clock_sync line lost its args payload
+    (trace_dir / "host_2.trace.json").write_text(
+        "[\n"
+        + json.dumps({"name": "clock_sync", "ph": "M", "pid": 2, "tid": 0}) + ",\n"
+        + json.dumps({"name": "step", "ph": "X", "ts": 3.0 * E6, "dur": 10.0,
+                      "pid": 2, "tid": 1}) + ",\n"
+    )
+    merged = merge_traces(str(trace_dir))
+    validate_chrome_trace(merged)
+    steps = [e for e in merged["traceEvents"] if e["name"] == "step"]
+    assert {e["pid"] for e in steps} == {0, 1, 2}
+    assert merged["metadata"]["merged_hosts"] == [0, 1, 2]
+    assert merged["metadata"]["clock_offsets_s"]["1"] == 0.0  # assumed zero
+
+
+# ---------------------------------------------------------------------------
+# sidecar exporter
+# ---------------------------------------------------------------------------
+
+
+def _write_fixture_rows(tmp_path, rows):
+    tel_dir = tmp_path / "telemetry"
+    tel_dir.mkdir(exist_ok=True)
+    with open(tel_dir / "telemetry.jsonl", "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_exporter_tails_incrementally_and_skips_unknown_schema(tmp_path):
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter
+
+    now = time.time()
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 1, "optimizer_steps": 1, "step_time_s": 0.1,
+         "recompiles": 2, "ts": now, "schema": SCHEMA_VERSION},
+        {"type": "compile", "total_s": 1.5, "ts": now, "schema": SCHEMA_VERSION},
+        {"type": "step", "schema": SCHEMA_VERSION + 9, "ts": now},  # future row
+    ])
+    exporter = LoggingDirExporter(str(tmp_path))
+    exporter.refresh(now=now)
+    reg = exporter.registry
+    assert reg.counter("steps").value() == 1
+    assert reg.counter("recompiles").value() == 2  # ratcheted from the field
+    assert reg.counter("compiles").value() == 1
+    assert reg.counter("rows_skipped_unknown_schema").value() == 1
+    # append two more rows: ONLY the delta is consumed on the next refresh
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 2, "optimizer_steps": 2, "step_time_s": 0.1,
+         "recompiles": 2, "ts": now + 1, "schema": SCHEMA_VERSION},
+        {"type": "step", "step": 3, "optimizer_steps": 3, "step_time_s": 0.1,
+         "recompiles": 2, "ts": now + 2, "schema": SCHEMA_VERSION},
+    ])
+    exporter.refresh(now=now + 2)
+    assert reg.counter("steps").value() == 3
+    parse_openmetrics(exporter.render())
+
+
+def test_exporter_survives_rotation_without_recount(tmp_path, monkeypatch):
+    """Segments are fingerprinted by content, not name: a rollover between
+    refreshes must neither re-count nor drop rows."""
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter
+
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_MAX_BYTES", "1500")
+    monkeypatch.setenv("ACCELERATE_TELEMETRY_KEEP_SEGMENTS", "4")
+    rec = TelemetryRecorder(logging_dir=str(tmp_path), memory_interval=0)
+    exporter = LoggingDirExporter(str(tmp_path))
+    try:
+        for _ in range(10):
+            rec.record_step(dispatch_s=0.01)
+        exporter.refresh()
+        assert exporter.registry.counter("steps").value() == 10
+        for _ in range(30):  # forces at least one rollover at 1500 bytes
+            rec.record_step(dispatch_s=0.01)
+    finally:
+        rec.close()
+    assert len(telemetry_segments(str(tmp_path / "telemetry" / "telemetry.jsonl"))) > 1
+    exporter.refresh()
+    assert exporter.registry.counter("steps").value() == 40
+
+
+def test_exporter_reads_heartbeats_and_goodput(tmp_path):
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter
+
+    hb_dir = tmp_path / "diagnostics"
+    hb_dir.mkdir()
+    now = time.time()
+    (hb_dir / "heartbeat_0.json").write_text(
+        json.dumps({"host": 0, "step": 12, "ts": now - 3, "fired": False})
+    )
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    (trace_dir / "host_0.trace.json").write_text(
+        "[\n"
+        + json.dumps({"name": "step/dispatch", "ph": "X", "ts": 0.0,
+                      "dur": 8 * E6, "pid": 0, "tid": 1}) + ",\n"
+        + json.dumps({"name": "compile/compile", "ph": "X", "ts": 8 * E6,
+                      "dur": 2 * E6, "pid": 0, "tid": 1}) + ",\n"
+    )
+    exporter = LoggingDirExporter(str(tmp_path))
+    exporter.refresh(now=now)
+    reg = exporter.registry
+    assert reg.gauge("host_step").value(host="0") == 12
+    assert reg.gauge("host_heartbeat_age_seconds").value(host="0") == pytest.approx(3, abs=1)
+    assert reg.gauge("goodput_ratio").value() == pytest.approx(0.8)
+    assert reg.gauge("goodput_bucket_seconds").value(bucket="compile") == pytest.approx(2.0)
+
+
+def test_exporter_http_scrape(tmp_path):
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter, serve_exporter
+
+    now = time.time()
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 1, "optimizer_steps": 1, "step_time_s": 0.1,
+         "recompiles": 0, "ts": now, "schema": SCHEMA_VERSION},
+    ])
+    exporter = LoggingDirExporter(str(tmp_path))
+    server = serve_exporter(exporter, port=0)  # ephemeral port
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert "openmetrics-text" in resp.headers["Content-Type"]
+            families = parse_openmetrics(resp.read().decode())
+        assert sample_value(families, "accelerate_steps") == 1
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["firing"] == []
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+
+
+def test_metrics_export_cli_once(tmp_path, capsys, monkeypatch):
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    now = time.time()
+    _write_fixture_rows(tmp_path, [
+        {"type": "step", "step": 5, "optimizer_steps": 5, "step_time_s": 0.1,
+         "recompiles": 1, "ts": now, "schema": SCHEMA_VERSION},
+    ])
+    monkeypatch.delenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", raising=False)
+    assert main(["metrics", "export", str(tmp_path), "--once"]) == 0
+    families = parse_openmetrics(capsys.readouterr().out)
+    assert sample_value(families, "accelerate_steps") == 1
+    assert not (tmp_path / "ALERTS.json").exists()  # nothing armed, no file
+
+
+# ---------------------------------------------------------------------------
+# SLO alert rules
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_alerts_min_max_and_abstention(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "90")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S", "0.5")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_RECOMPILES_PER_HOUR", "10")
+    firing = evaluate_alerts(
+        {"goodput_pct": 85.0, "ttft_p99_s": 0.4, "recompiles_per_hour": 50.0}
+    )
+    assert sorted(f["rule"] for f in firing) == [
+        "max_recompiles_per_hour", "min_goodput_pct",
+    ]
+    # missing observations abstain — a dead exporter must not page
+    assert evaluate_alerts({"goodput_pct": None}) == []
+    # healthy values: quiet
+    assert evaluate_alerts(
+        {"goodput_pct": 95.0, "ttft_p99_s": 0.1, "recompiles_per_hour": 1.0}
+    ) == []
+
+
+def test_monitor_once_exit_codes_and_alerts_json(tmp_path, capsys, monkeypatch):
+    """--once: 0 healthy, 3 on an SLO breach (ALERTS.json written), 2 when
+    wedged/hung (precedence over the SLO code)."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    (trace_dir / "host_0.trace.json").write_text(
+        "[\n"
+        + json.dumps({"name": "step/dispatch", "ph": "X", "ts": 0.0,
+                      "dur": 5 * E6, "pid": 0, "tid": 1}) + ",\n"
+        + json.dumps({"name": "prepare", "ph": "X", "ts": 5 * E6,
+                      "dur": 5 * E6, "pid": 0, "tid": 1}) + ",\n"
+    )  # goodput = 50%
+    monkeypatch.delenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", raising=False)
+    assert main(["monitor", str(tmp_path), "--once"]) == 0
+    assert "goodput: 50.0%" in capsys.readouterr().out
+
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "90")
+    assert main(["monitor", str(tmp_path), "--once"]) == 3
+    out = capsys.readouterr().out
+    assert "SLO min_goodput_pct" in out
+    alerts = json.load(open(tmp_path / "ALERTS.json"))
+    assert alerts["firing"][0]["rule"] == "min_goodput_pct"
+    assert alerts["firing"][0]["observed"] == pytest.approx(50.0)
+
+    # resolved breach rewrites the file empty instead of leaving a stale page
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "10")
+    assert main(["monitor", str(tmp_path), "--once"]) == 0
+    capsys.readouterr()
+    assert json.load(open(tmp_path / "ALERTS.json"))["firing"] == []
+
+    # wedged wins over SLO
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "90")
+    (tmp_path / "HANG_REPORT_0.json").write_text(
+        json.dumps({"host": 0, "stalled_phase": "x", "elapsed_s": 1.0})
+    )
+    assert main(["monitor", str(tmp_path), "--once"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# serve front end: GET /metrics
+# ---------------------------------------------------------------------------
+
+
+class _StubScheduler:
+    queue_depth = 0
+
+    def has_work(self):
+        return False
+
+
+class _StubEngine:
+    """Just enough engine for the serve HTTP front end's read-only paths."""
+
+    scheduler = _StubScheduler()
+
+    def stats(self):
+        return {
+            "iterations": 4, "completed": 2, "queue_depth": 0,
+            "tokens_emitted": 64, "decode_compiles": 1, "prefill_compiles": 1,
+            "free_blocks": 7, "slot_occupancy_mean": 0.5, "tokens_per_sec": 123.0,
+        }
+
+    def step(self):
+        return []
+
+
+def test_serve_http_metrics_route(tmp_path):
+    import queue as queue_mod
+
+    from accelerate_tpu.commands.serve import _serve_http
+
+    set_active_registry(MetricsRegistry(gate_main_process=False))
+    engine = _StubEngine()
+    inbox: queue_mod.Queue = queue_mod.Queue()
+    stop = threading.Event()
+
+    # find the bound port by racing the server up on an OS-assigned port is
+    # not possible through _serve_http's signature; pick a free one first
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    thread = threading.Thread(
+        target=_serve_http, args=(engine, inbox, stop, port), daemon=True
+    )
+    thread.start()
+    try:
+        body = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as resp:
+                    assert "openmetrics-text" in resp.headers["Content-Type"]
+                    body = resp.read().decode()
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert body is not None, "serve HTTP front end never answered /metrics"
+        families = parse_openmetrics(body)
+        assert sample_value(families, "accelerate_serving_tokens") == 64
+        assert sample_value(families, "accelerate_serving_free_blocks") == 7
+        assert sample_value(families, "accelerate_serving_slot_occupancy") == 0.5
+    finally:
+        stop.set()
+        thread.join(timeout=30)
+        assert not thread.is_alive()
