@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -31,3 +31,9 @@ trace-smoke:      ## 20-step loop with diagnostics on; asserts the merged trace 
 
 metrics-smoke:    ## records a logging_dir fixture, scrapes the sidecar exporter (in-process + HTTP), checks SLO exit codes
 	python benchmarks/metrics_smoke.py
+
+lint:             ## self-application gate: examples/ + benchmarks/ must lint clean (exit 2 on error-severity findings)
+	python -m accelerate_tpu.commands.accelerate_cli lint examples benchmarks
+
+lint-smoke:       ## seeded-bad script trips the CLI (exit 2), clean tree passes, ACCELERATE_SANITIZE=1 names a retraced argument
+	python benchmarks/lint_smoke.py

@@ -101,4 +101,8 @@ def __getattr__(name):
         from .checkpointing import wait_for_checkpoint
 
         return wait_for_checkpoint
+    if name in ("Sanitizer", "get_active_sanitizer", "lint_paths", "lint_source"):
+        from . import analysis
+
+        return getattr(analysis, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -24,6 +24,9 @@ import numpy as np
 import optax
 
 from . import operations as ops
+from .analysis.sanitizer import Sanitizer
+from .analysis.sanitizer import get_active_sanitizer as _get_sanitizer
+from .analysis.sanitizer import set_active_sanitizer as _set_sanitizer
 from .data_loader import DataLoaderShard, prepare_data_loader, skip_first_batches
 from .lazy import Deferred, clear_caches, grad_fn_for
 from .logging import get_logger
@@ -209,6 +212,7 @@ class Accelerator:
         telemetry: bool | None = None,
         fault_tolerance: FaultTolerancePlugin | bool | None = None,
         diagnostics: DiagnosticsPlugin | bool | None = None,
+        sanitize: bool | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -576,6 +580,21 @@ class Accelerator:
                 telemetry=self.telemetry if self.telemetry else None,
             )
             self.watchdog.start()
+
+        # runtime sanitizer (analysis/): opt-in via the constructor or
+        # ACCELERATE_SANITIZE=1 — recompile naming, donation report,
+        # per-host collective digests, NaN/inf loss probe. Same Borg
+        # takeover as telemetry: the newest Accelerator owns the
+        # process-wide sanitizer, and disabled mode is one global read
+        # at every instrumentation site
+        if sanitize is None:
+            sanitize = parse_flag_from_env("ACCELERATE_SANITIZE")
+        if sanitize:
+            self.sanitizer = Sanitizer(logging_dir=self.logging_dir)
+            _set_sanitizer(self.sanitizer)
+        else:
+            self.sanitizer = None
+            _set_sanitizer(None)
 
         # fault tolerance (resilience subsystem): opt-in via the
         # constructor, ACCELERATE_FAULT_TOLERANCE=1, or — so launcher
@@ -1248,6 +1267,11 @@ class Accelerator:
             train_params, frozen_params, inputs, *extra
         )
         loss._set_forced(unscaled_loss)
+        sanitizer = _get_sanitizer()
+        if sanitizer:
+            # split path computes the loss here, so this is its step
+            # boundary; the probe forces the value (sanitize-mode cost)
+            sanitizer.check_loss(unscaled_loss, step=self.step)
         for model, g in zip(trainables, grads):
             opt = self._optimizer_for(model)
             if opt is not None:
@@ -1606,6 +1630,11 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.finish()
         self.telemetry.close()
+        if self.sanitizer is not None:
+            # release only OUR sanitizer — a newer Accelerator's Borg
+            # takeover must not be clobbered by an old one's teardown
+            if _get_sanitizer() is self.sanitizer:
+                _set_sanitizer(None)
         if self.watchdog is not None:
             self.watchdog.stop()
         self.tracer.close()

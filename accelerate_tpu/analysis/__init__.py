@@ -1,0 +1,57 @@
+"""TPU-correctness static analysis + runtime sanitizer.
+
+* :mod:`.rules` / :mod:`.engine` — the AST lint pass behind
+  ``accelerate-tpu lint`` (stdlib-only; no jax import).
+* :mod:`.compiled` — jaxpr/HLO analyzers: donation checker, recompile
+  fingerprinter, collective-sequence digest.
+* :mod:`.sanitizer` — the runtime mode (``ACCELERATE_SANITIZE=1`` /
+  ``Accelerator(sanitize=True)``) that runs those analyzers on the live
+  compile path and probes the loss for NaN/inf at step boundaries.
+"""
+
+from .engine import lint_file, lint_paths, lint_source, normalize_rule_ids
+from .rules import RULES, Finding
+
+
+def __getattr__(name):
+    # jax-touching members resolve lazily so `lint` stays importable light
+    if name in (
+        "Sanitizer",
+        "NULL_SANITIZER",
+        "get_active_sanitizer",
+        "set_active_sanitizer",
+    ):
+        from . import sanitizer
+
+        return getattr(sanitizer, name)
+    if name in (
+        "signature_entries",
+        "fingerprint_of",
+        "diff_signatures",
+        "format_signature_diff",
+        "RecompileFingerprinter",
+        "donation_report",
+        "collective_digest",
+        "collective_sequence",
+        "read_host_digests",
+        "diff_host_digests",
+        "write_host_digest",
+    ):
+        from . import compiled
+
+        return getattr(compiled, name)
+    raise AttributeError(f"module 'accelerate_tpu.analysis' has no attribute {name!r}")
+
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "normalize_rule_ids",
+    "Sanitizer",
+    "NULL_SANITIZER",
+    "get_active_sanitizer",
+    "set_active_sanitizer",
+]

@@ -1,0 +1,352 @@
+"""Compiled-program analyzers: donation, recompile fingerprints, collective
+order.
+
+Three analyzers over the artifacts the AOT compile path already produces
+(:mod:`accelerate_tpu.lazy` hands them the jitted fn, its concrete args and
+the compiled executable):
+
+* **donation checker** — non-donated inputs whose abstract value matches an
+  output's could have been donated (``donate_argnums``); each one doubles
+  its buffer in HBM for the step's lifetime. Reports the wasted bytes and
+  names the argument.
+* **recompile fingerprinter** — hashes the abstract signature (leaf path →
+  shape/dtype) of every compile per label; when a label compiles again, the
+  diff NAMES the argument whose shape/dtype changed — the answer to "why
+  did step 512 retrace". Wired into the telemetry compile record and the
+  serving engine's one-executable assertion.
+* **collective-sequence digest** — an ordered walk of the compiled HLO's
+  collective ops (all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all, sync and ``-start`` async forms)
+  hashed into a digest. Two hosts executing the same program MUST have the
+  same digest; ``accelerate-tpu monitor`` diffs the per-host digest files
+  and names a divergent host before the mismatch becomes a cross-host
+  deadlock.
+
+jax is imported lazily inside the functions that need it: the digest-file
+readers at the bottom are consumed by the monitor CLI, which must work on
+a machine with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+
+#: ordered collective-op walk: op name + result shape, sync or async form.
+#: (utils/hlo.py answers "how many bytes"; this answers "in what order" —
+#: order is what cross-host agreement depends on.)
+_HLO_COLLECTIVE_SEQ = re.compile(
+    r"=\s*\(?((?:\w+\[[0-9,]*\][^)=]*?,?\s*)+)\)?\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start)?\("
+)
+
+
+# ---------------------------------------------------------------------------
+# abstract signatures
+# ---------------------------------------------------------------------------
+
+
+def signature_entries(args) -> tuple:
+    """Flatten a call's args into ``(leaf_path, shape, dtype)`` triples —
+    the abstract signature a jit cache keys on, with human-readable names
+    attached. ``leaf_path`` uses jax's keystr (``[0]['w']`` style) prefixed
+    with the positional argument index."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(args)
+    entries = []
+    for key_path, leaf in flat:
+        path = jax.tree_util.keystr(key_path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        entries.append((path, shape, dtype))
+    return tuple(entries)
+
+
+def fingerprint_of(entries) -> str:
+    """Stable short hash of an abstract signature."""
+    payload = ";".join(f"{p}:{s}:{d}" for p, s, d in entries)
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+def diff_signatures(old, new) -> dict | None:
+    """Name what changed between two abstract signatures, or None when they
+    match. ``changed`` pairs old/new by leaf path; paths present on only
+    one side land in ``added``/``removed`` (a pytree structure change)."""
+    if tuple(old) == tuple(new):
+        return None
+    old_map = {p: (s, d) for p, s, d in old}
+    new_map = {p: (s, d) for p, s, d in new}
+    changed = [
+        {"arg": p, "before": list(old_map[p][0]) + [old_map[p][1]],
+         "after": list(new_map[p][0]) + [new_map[p][1]]}
+        for p in old_map
+        if p in new_map and old_map[p] != new_map[p]
+    ]
+    added = sorted(p for p in new_map if p not in old_map)
+    removed = sorted(p for p in old_map if p not in new_map)
+    return {"changed": changed, "added": added, "removed": removed}
+
+
+def format_signature_diff(diff: dict, limit: int = 4) -> str:
+    """One-line human rendering: ``x[1]: (8, 128):float32 -> (8, 256):float32``."""
+    parts = []
+    for ch in diff.get("changed", [])[:limit]:
+        b, a = ch["before"], ch["after"]
+        parts.append(
+            f"{ch['arg']}: {tuple(b[:-1])}:{b[-1]} -> {tuple(a[:-1])}:{a[-1]}"
+        )
+    extra = len(diff.get("changed", [])) - limit
+    if extra > 0:
+        parts.append(f"(+{extra} more)")
+    if diff.get("added"):
+        parts.append(f"added {', '.join(diff['added'][:limit])}")
+    if diff.get("removed"):
+        parts.append(f"removed {', '.join(diff['removed'][:limit])}")
+    return "; ".join(parts) or "signature changed"
+
+
+class RecompileFingerprinter:
+    """Per-label signature history. ``note(label, entries)`` returns
+    ``(fingerprint, diff)`` where ``diff`` is None on the label's first
+    compile or an exact repeat, and the named argument diff when the label
+    re-traced with a different abstract signature."""
+
+    def __init__(self):
+        self._last: dict[str, tuple] = {}
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def note(self, label: str, entries) -> tuple[str, dict | None]:
+        fp = fingerprint_of(entries)
+        with self._lock:
+            prev = self._last.get(label)
+            self._last[label] = entries
+            self._counts[label] = self._counts.get(label, 0) + 1
+        diff = diff_signatures(prev, entries) if prev is not None else None
+        return fp, diff
+
+    def compiles_of(self, label: str) -> int:
+        return self._counts.get(label, 0)
+
+    def clear(self):
+        with self._lock:
+            self._last.clear()
+            self._counts.clear()
+
+
+#: process-global history the lazy AOT path feeds — compile records across
+#: every owner (telemetry, sanitizer, serving engine) diff against the same
+#: per-label baseline. Reset by ``lazy.clear_caches()``.
+GLOBAL_FINGERPRINTS = RecompileFingerprinter()
+
+
+def note_signature(label: str, entries) -> tuple[str, dict | None]:
+    return GLOBAL_FINGERPRINTS.note(label, entries)
+
+
+# ---------------------------------------------------------------------------
+# donation checker
+# ---------------------------------------------------------------------------
+
+
+def _leaf_bytes(shape, dtype) -> int:
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        return n * np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+
+
+def donation_report(fn, args, donate_argnums=(), label: str = "") -> dict:
+    """Flag non-donated inputs whose aval (shape+dtype) matches an output's
+    — candidates XLA could have aliased in place of allocating a fresh
+    result buffer, i.e. HBM the caller is paying twice for.
+
+    Abstract evaluation only (``jax.eval_shape``-class cost): nothing
+    executes or compiles. The match is multiset-based: outputs claimed by a
+    donated input's aval are consumed first, and each remaining output aval
+    can excuse at most one non-donated input.
+    """
+    import jax
+
+    donate_argnums = tuple(donate_argnums)
+    out_shape = jax.eval_shape(fn, *args)
+    out_avals = [
+        (tuple(leaf.shape), str(leaf.dtype)) for leaf in jax.tree_util.tree_leaves(out_shape)
+    ]
+    available: dict[tuple, int] = {}
+    for aval in out_avals:
+        available[aval] = available.get(aval, 0) + 1
+
+    donated_leaves: list[tuple] = []
+    candidate_leaves: list[tuple[str, tuple, str]] = []
+    for i, arg in enumerate(args):
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for key_path, leaf in flat:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(getattr(leaf, "dtype", ""))
+            if not dtype:
+                continue
+            if i in donate_argnums:
+                donated_leaves.append((shape, dtype))
+            else:
+                path = f"args[{i}]{jax.tree_util.keystr(key_path)}"
+                candidate_leaves.append((path, shape, dtype))
+
+    for aval in donated_leaves:  # donated inputs consume their matches first
+        if available.get(aval, 0) > 0:
+            available[aval] -= 1
+
+    candidates = []
+    wasted = 0
+    for path, shape, dtype in candidate_leaves:
+        aval = (shape, dtype)
+        if available.get(aval, 0) > 0:
+            available[aval] -= 1
+            nbytes = _leaf_bytes(shape, dtype)
+            wasted += nbytes
+            candidates.append(
+                {"arg": path, "shape": list(shape), "dtype": dtype, "bytes": nbytes}
+            )
+    return {
+        "label": label,
+        "wasted_bytes": wasted,
+        "donated_leaves": len(donated_leaves),
+        "candidates": candidates,
+    }
+
+
+# ---------------------------------------------------------------------------
+# collective-sequence digest
+# ---------------------------------------------------------------------------
+
+
+def collective_sequence(hlo_text: str) -> list[str]:
+    """Ordered ``"op shapes"`` entries for every collective in a compiled
+    HLO module — textual program order, which is the schedule-relevant
+    order XLA emits them in."""
+    seq = []
+    for m in _HLO_COLLECTIVE_SEQ.finditer(hlo_text):
+        shapes, op = m.group(1), m.group(2)
+        seq.append(f"{op} {' '.join(shapes.split())}")
+    return seq
+
+
+def collective_digest(hlo_text: str) -> tuple[str, list[str]]:
+    """(digest, sequence): the digest is what hosts compare; the sequence
+    is what a human reads when they diverge."""
+    seq = collective_sequence(hlo_text)
+    digest = hashlib.sha1("\n".join(seq).encode()).hexdigest()[:16]
+    return digest, seq
+
+
+# ---------------------------------------------------------------------------
+# per-host digest files (written by the sanitizer, read by `monitor`)
+# ---------------------------------------------------------------------------
+
+DIGEST_SUBDIR = "diagnostics"
+_DIGEST_PREFIX = "collectives_host_"
+
+
+def digest_path(logging_dir: str, host: int) -> str:
+    return os.path.join(logging_dir, DIGEST_SUBDIR, f"{_DIGEST_PREFIX}{host}.json")
+
+
+def write_host_digest(
+    logging_dir: str, host: int, label: str, digest: str, sequence: list[str]
+) -> str:
+    """Merge one label's digest into this host's digest file (atomic
+    tmp+rename, like the heartbeat files — a monitor mid-read never sees a
+    torn JSON)."""
+    path = digest_path(logging_dir, host)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    data = {"host": host, "digests": {}}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    data["host"] = host
+    data.setdefault("digests", {})[label] = {
+        "digest": digest,
+        "collectives": len(sequence),
+        "sequence_head": sequence[:8],
+    }
+    import time
+
+    data["ts"] = time.time()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_host_digests(logging_dir: str) -> dict[int, dict]:
+    """{host: {label: digest_record}} from every digest file under the
+    logging dir. Pure file reads (no jax)."""
+    out: dict[int, dict] = {}
+    directory = os.path.join(logging_dir, DIGEST_SUBDIR)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith(_DIGEST_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                data = json.load(f)
+            out[int(data.get("host", name[len(_DIGEST_PREFIX):-5]))] = data.get(
+                "digests", {}
+            )
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+    return out
+
+
+def diff_host_digests(digests: dict[int, dict]) -> list[dict]:
+    """Labels on which hosts disagree, with the minority host(s) named:
+    ``[{label, digests: {host: digest}, divergent_hosts: [...], tie: bool}]``.
+    The majority digest is presumed correct — in a pre-deadlock divergence
+    the straggler minority is the actionable name. When no digest holds a
+    strict majority (e.g. two hosts split 1-1) there is no side to presume
+    correct: every disagreeing host is named and ``tie`` is True."""
+    labels: set[str] = set()
+    for per_host in digests.values():
+        labels.update(per_host)
+    out = []
+    for label in sorted(labels):
+        by_host = {
+            host: per_host[label].get("digest")
+            for host, per_host in digests.items()
+            if label in per_host
+        }
+        distinct = set(by_host.values())
+        if len(by_host) >= 2 and len(distinct) > 1:
+            counts = {d: sum(1 for v in by_host.values() if v == d) for d in distinct}
+            top = max(counts.values())
+            tie = sum(1 for c in counts.values() if c == top) > 1
+            if tie:
+                divergent = sorted(by_host)
+            else:
+                majority = max(counts, key=lambda d: counts[d])
+                divergent = sorted(h for h, d in by_host.items() if d != majority)
+            out.append(
+                {
+                    "label": label,
+                    "digests": by_host,
+                    "divergent_hosts": divergent,
+                    "tie": tie,
+                }
+            )
+    return out

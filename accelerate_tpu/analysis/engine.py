@@ -1,0 +1,155 @@
+"""Lint engine: file discovery, suppression comments, rule selection.
+
+Pure stdlib (``ast`` + ``re``) — linting a training script must never
+require the accelerator stack to import, so this module has no jax
+dependency and runs anywhere the source tree is visible (a laptop, a CI
+box, a dead run's checkout).
+
+Suppression syntax (mirrors the rule IDs the findings print):
+
+* ``# tpu-lint: ignore[TPU004]`` on the offending line (or the line
+  directly above it) suppresses those rules for that line. Multiple IDs:
+  ``ignore[TPU001,TPU005]``. A reason after the bracket is encouraged:
+  ``# tpu-lint: ignore[TPU006] — host-side wall clock, fed in as input``.
+* ``# tpu-lint: skip-file`` anywhere in the first 10 lines skips the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .rules import RULES, Finding, run_rules
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*ignore\[([A-Za-z0-9,\s]+)\]")
+_SKIP_FILE_RE = re.compile(r"#\s*tpu-lint:\s*skip-file")
+
+
+def _suppressions(source: str) -> dict[int, set[str]]:
+    """line number -> suppressed rule IDs (a comment suppresses its own
+    line and the line below, so a comment-only line shields the statement
+    under it)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids = {part.strip().upper() for part in m.group(1).split(",") if part.strip()}
+        out.setdefault(i, set()).update(ids)
+        out.setdefault(i + 1, set()).update(ids)
+    return out
+
+
+def _selected(finding: Finding, select: set[str] | None, ignore: set[str] | None) -> bool:
+    if select and finding.rule not in select:
+        return False
+    if ignore and finding.rule in ignore:
+        return False
+    return True
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> list[Finding]:
+    """Lint one module's source text."""
+    head = "\n".join(source.splitlines()[:10])
+    if _SKIP_FILE_RE.search(head):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="TPU000",
+                severity="error",
+                message=f"could not parse: {e.msg}",
+                fixit="fix the syntax error; nothing else was checked",
+                path=path,
+                line=e.lineno or 0,
+                col=e.offset or 0,
+            )
+        ]
+    suppressed = _suppressions(source)
+    findings = []
+    for f in run_rules(tree, path):
+        if f.rule in suppressed.get(f.line, ()):
+            continue
+        if _selected(f, select, ignore):
+            findings.append(f)
+    return findings
+
+
+def lint_file(
+    path: str, select: set[str] | None = None, ignore: set[str] | None = None
+) -> list[Finding]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError as e:
+        return [
+            Finding(
+                rule="TPU000",
+                severity="error",
+                message=f"could not read: {e}",
+                fixit="check the path",
+                path=path,
+                line=0,
+            )
+        ]
+    return lint_source(source, path=path, select=select, ignore=ignore)
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    """Expand files/directories into a sorted list of ``.py`` files
+    (skipping hidden dirs and ``__pycache__``)."""
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def lint_paths(
+    paths: list[str],
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> tuple[list[Finding], int]:
+    """Lint every ``.py`` under ``paths``. Returns (findings, files_scanned)."""
+    files = iter_python_files(paths)
+    findings: list[Finding] = []
+    for path in files:
+        findings.extend(lint_file(path, select=select, ignore=ignore))
+    return findings, len(files)
+
+
+def normalize_rule_ids(raw: str | None) -> set[str] | None:
+    """``"TPU001,tpu4"`` → ``{"TPU001", "TPU004"}`` (zero-padded); None
+    passes through. Unknown IDs raise ValueError so a typo'd --select
+    fails loudly instead of silently selecting nothing."""
+    if not raw:
+        return None
+    out: set[str] = set()
+    for part in raw.split(","):
+        part = part.strip().upper()
+        if not part:
+            continue
+        if part.startswith("TPU"):
+            part = "TPU" + part[3:].zfill(3)
+        if part not in RULES and part != "TPU000":
+            raise ValueError(
+                f"unknown rule id {part!r} (known: {', '.join(sorted(RULES))})"
+            )
+        out.add(part)
+    return out or None

@@ -1,0 +1,656 @@
+"""AST lint rules for TPU anti-patterns — the rule catalogue behind
+``accelerate-tpu lint``.
+
+Every rule carries a stable ID (``TPU001``…), a severity (``error`` means
+"this defeats the compiled-step contract"; ``warning`` means "this is a
+retrace/measurement hazard"), and a fix-it message. The catalogue is the
+single source of truth: the CLI's ``--select``/``--ignore``, the docs
+table, and the test corpus all key on :data:`RULES`.
+
+What counts as a *traced function* (the context in which the host-sync
+rules apply):
+
+* a function decorated with ``jit`` / ``jax.jit`` / ``pjit`` /
+  ``functools.partial(jax.jit, …)``;
+* a function wrapped by name — ``g = jax.jit(f)`` marks ``f``;
+* a function passed to a tracing transform — ``lax.scan``/``cond``/
+  ``while_loop``, ``jax.grad``/``value_and_grad``/``vmap``, ``shard_map``,
+  ``defer_call``;
+* a function named like a step body (``train_step``/``eval_step``/
+  ``step_fn``/``loss_fn``) — these are the functions the paper's ~5-line
+  contract hands to the compiled path even when the jit wrap lives
+  elsewhere.
+
+Inside a traced function every parameter is assumed traced (that is what
+jit does) except parameters named by ``static_argnums``/``static_argnames``
+on the jit wrap; a light forward taint propagates through assignments so
+``y = x + 1`` is traced when ``x`` is.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str  # "error" | "warning"
+    summary: str
+    fixit: str
+
+
+#: the rule catalogue — IDs are append-only (stable across releases)
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "TPU001",
+            "error",
+            "implicit host sync: .item()/.tolist() on a traced value inside a traced function",
+            "return the array and sync outside the step, or use jax.debug.print for logging",
+        ),
+        Rule(
+            "TPU002",
+            "error",
+            "implicit host sync: float()/int()/bool() cast of a traced value inside a traced function",
+            "keep the value as an array (jnp.float32(x) stays traced); cast outside the step",
+        ),
+        Rule(
+            "TPU003",
+            "error",
+            "implicit host sync: np.array()/np.asarray() of a traced value inside a traced function",
+            "use jnp.asarray inside traced code; materialize with np.asarray only outside the step",
+        ),
+        Rule(
+            "TPU004",
+            "error",
+            "Python control flow on a traced value inside a traced function",
+            "use jax.lax.cond/jax.lax.while_loop or jnp.where — an `if` on a tracer either "
+            "fails or bakes one branch in at trace time",
+        ),
+        Rule(
+            "TPU005",
+            "warning",
+            "print() of a traced value inside a traced function prints the tracer, not the value",
+            "use jax.debug.print(\"{x}\", x=value) to print at run time",
+        ),
+        Rule(
+            "TPU006",
+            "error",
+            "wall-clock call inside a traced function is baked in as a constant at trace time",
+            "take timestamps outside the compiled step and pass them in as array arguments",
+        ),
+        Rule(
+            "TPU007",
+            "error",
+            "Python/numpy RNG inside a traced function is baked in as a constant at trace time",
+            "thread a jax.random.PRNGKey through the step and use jax.random.* ops",
+        ),
+        Rule(
+            "TPU008",
+            "warning",
+            "timing a dispatched computation without a blocking fence measures dispatch, not compute",
+            "call jax.block_until_ready(result) (or np.asarray(result)) before reading the stop "
+            "timestamp",
+        ),
+        Rule(
+            "TPU009",
+            "warning",
+            "mutable default argument on a jitted function is captured once at trace time",
+            "default to None and construct the value inside, or pass it explicitly per call",
+        ),
+        Rule(
+            "TPU010",
+            "warning",
+            "loop-varying Python scalar passed to a jitted function retraces every iteration",
+            "pass it as an array (jnp.asarray(i)) or mark the argument static if it truly varies "
+            "rarely",
+        ),
+        Rule(
+            "TPU011",
+            "error",
+            "collective op under data-dependent control flow — hosts can disagree on collective "
+            "order and deadlock",
+            "hoist the collective out of the branch, or use jax.lax.cond so every host traces "
+            "the same collective sequence",
+        ),
+    )
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    fixit: str
+    path: str
+    line: int
+    col: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "fixit": self.fixit,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.severity}] {self.message}\n    fix: {self.fixit}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# helpers over the AST
+# ---------------------------------------------------------------------------
+
+_TIME_CALLS = {"time", "perf_counter", "monotonic", "process_time", "time_ns", "now"}
+_RNG_MODULES = {"random"}
+_SYNC_ATTRS = {"item", "tolist"}
+#: call names that fence the device (host-blocking materialization)
+_FENCE_NAMES = {"block_until_ready", "device_get", "asarray", "array", "force", "item"}
+#: lax / jops traced collectives. ``lax.gather``/``lax.broadcast``/
+#: ``lax.reduce`` are LOCAL ops (indexing / shape broadcast / monoid
+#: reduce), deliberately absent — only unambiguous collective names here.
+_LAX_COLLECTIVE_NAMES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "psum_scatter", "axis_index", "all_reduce", "reduce_scatter",
+}
+#: eager cross-host collectives in accelerate_tpu.operations whose names
+#: are unambiguous at any callee root
+_EAGER_COLLECTIVE_NAMES = {
+    "gather_object", "broadcast_object_list", "wait_for_everyone",
+}
+#: short eager names that collide with local ops elsewhere — only a
+#: collective when called through an operations/Accelerator-ish receiver
+_EAGER_COLLECTIVE_SHORT = {"gather", "broadcast", "reduce"}
+_EAGER_COLLECTIVE_ROOTS = {"ops", "operations", "accelerator", "acc", "self"}
+_STEP_FN_NAMES = {"train_step", "eval_step", "step_fn", "loss_fn", "forward_fn"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' when not a plain path."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions denoting the jit transform itself."""
+    name = _dotted(node)
+    return name in ("jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit")
+
+
+def _jit_call_statics(call: ast.Call) -> tuple[set[int], set[str]]:
+    """static_argnums/static_argnames of a ``jax.jit(...)`` call node."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    nums.add(elt.value)
+        elif kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return nums, names
+
+
+def _decorator_jit_info(fn: ast.FunctionDef):
+    """(is_jitted, static_argnums, static_argnames) from the decorator list."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True, set(), set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                return (True,) + _jit_call_statics(dec)
+            # functools.partial(jax.jit, ...)
+            if _dotted(dec.func) in ("functools.partial", "partial") and dec.args:
+                if _is_jit_expr(dec.args[0]):
+                    return (True,) + _jit_call_statics(dec)
+    return False, set(), set()
+
+
+_TRANSFORM_FN_ARGS = {
+    # transform dotted-suffix -> indices of function-valued positional args
+    "scan": (0,),
+    "cond": (1, 2),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "defer_call": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+}
+
+
+def collect_jax_aliases(tree: ast.Module) -> set[str]:
+    """Local names bound by an import from the ``jax`` package —
+    ``from jax import random`` binds ``random`` to jax.random, whose calls
+    are trace-safe and must not trip the host-RNG rule."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    aliases.add(a.asname or a.name.split(".", 1)[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax" or node.module.startswith("jax.")):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def collect_traced_names(tree: ast.Module) -> tuple[set[str], dict[str, tuple[set[int], set[str]]], set[str]]:
+    """Pass 1 over a module: which locally-defined function names run under
+    trace, their static-arg info, and which *names* are jit-wrapped
+    callables (for the call-site rules).
+
+    Returns (traced_fn_names, statics_by_fn, jitted_callable_names).
+    """
+    traced: set[str] = set()
+    statics: dict[str, tuple[set[int], set[str]]] = {}
+    jitted_names: set[str] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            is_jit, nums, names = _decorator_jit_info(node)
+            if is_jit:
+                traced.add(node.name)
+                statics[node.name] = (nums, names)
+                jitted_names.add(node.name)
+            elif node.name in _STEP_FN_NAMES:
+                traced.add(node.name)
+                statics.setdefault(node.name, (set(), set()))
+        elif isinstance(node, ast.Call):
+            callee = _dotted(node.func)
+            tail = callee.rsplit(".", 1)[-1]
+            if _is_jit_expr(node.func) and node.args:
+                if isinstance(node.args[0], ast.Name):
+                    traced.add(node.args[0].id)
+                    statics[node.args[0].id] = _jit_call_statics(node)
+            elif tail in _TRANSFORM_FN_ARGS:
+                for idx in _TRANSFORM_FN_ARGS[tail]:
+                    if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+                        traced.add(node.args[idx].id)
+                        statics.setdefault(node.args[idx].id, (set(), set()))
+        elif isinstance(node, ast.Assign):
+            # g = jax.jit(f[, ...]) : g is a jitted callable name
+            if (
+                isinstance(node.value, ast.Call)
+                and _is_jit_expr(node.value.func)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                jitted_names.add(node.targets[0].id)
+    return traced, statics, jitted_names
+
+
+# ---------------------------------------------------------------------------
+# per-function taint + rule checks
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Ctx:
+    path: str
+    findings: list[Finding] = field(default_factory=list)
+
+    def add(self, rule_id: str, node: ast.AST, detail: str = ""):
+        rule = RULES[rule_id]
+        message = rule.summary + (f" ({detail})" if detail else "")
+        self.findings.append(
+            Finding(
+                rule=rule_id,
+                severity=rule.severity,
+                message=message,
+                fixit=rule.fixit,
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+            )
+        )
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return params
+
+
+class _TaintTracker:
+    """Forward may-taint over a traced function body: parameters (minus
+    statics) are traced; assignment from a tainted expression taints the
+    target. Deliberately simple — one pass in statement order, no branches
+    merging — which matches the golden-corpus bar (no false negatives on
+    the positives, no false positives on the negatives)."""
+
+    def __init__(self, fn: ast.FunctionDef, static_nums: set[int], static_names: set[str]):
+        self.tainted: set[str] = set()
+        params = _param_names(fn)
+        for i, name in enumerate(params):
+            if i in static_nums or name in static_names:
+                continue
+            self.tainted.add(name)
+
+    #: attribute reads of STATIC aval metadata — `x.shape[0]`, `x.ndim` —
+    #: are Python values at trace time; `if x.shape[0] == 1:` and
+    #: `int(x.ndim)` are correct jax idiom, not host syncs
+    _STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+    def expr_is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in self._STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        return any(
+            self.expr_is_tainted(child) for child in ast.iter_child_nodes(node)
+        )
+
+    def note_statement(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is None or not self.expr_is_tainted(value):
+                return
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for target in targets:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Name):
+                        self.tainted.add(sub.id)
+
+
+def check_traced_function(
+    fn: ast.FunctionDef,
+    ctx: _Ctx,
+    static_nums: set[int] | None = None,
+    static_names: set[str] | None = None,
+    jax_aliases: set[str] | None = None,
+) -> None:
+    """Run the traced-context rules (TPU001-TPU007, TPU011) over one
+    function body."""
+    taint = _TaintTracker(fn, static_nums or set(), static_names or set())
+    jax_aliases = jax_aliases or set()
+
+    def tainted_control_depth(stack: list[ast.AST]) -> ast.AST | None:
+        for ctrl in stack:
+            test = getattr(ctrl, "test", None)
+            if test is not None and taint.expr_is_tainted(test):
+                return ctrl
+        return None
+
+    control_stack: list[ast.AST] = []
+
+    def visit(node: ast.AST):
+        # nested defs get their own traced check only if themselves jitted;
+        # their bodies still trace when called from this one, so keep walking
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func)
+            tail = callee.rsplit(".", 1)[-1]
+            root = callee.split(".", 1)[0]
+            # TPU001: .item()/.tolist() on tainted value
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_ATTRS
+                and taint.expr_is_tainted(node.func.value)
+            ):
+                ctx.add("TPU001", node, f".{node.func.attr}() forces the device")
+            # TPU002: float()/int()/bool() of tainted value
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and taint.expr_is_tainted(node.args[0])
+            ):
+                ctx.add("TPU002", node, f"{node.func.id}() forces the device")
+            # TPU003: np.array/np.asarray of tainted value
+            elif (
+                root in ("np", "numpy")
+                and tail in ("array", "asarray")
+                and node.args
+                and taint.expr_is_tainted(node.args[0])
+            ):
+                ctx.add("TPU003", node, f"{callee}() materializes on host")
+            # TPU005: print of tainted value
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and any(taint.expr_is_tainted(a) for a in node.args)
+            ):
+                ctx.add("TPU005", node)
+            # TPU006: wall clock in trace
+            elif root in ("time", "datetime") and tail in _TIME_CALLS:
+                ctx.add("TPU006", node, f"{callee}()")
+            # TPU007: python/numpy RNG in trace (jax.random aliases exempt)
+            elif (
+                (root in _RNG_MODULES and root not in jax_aliases)
+                or (callee.startswith("np.random.") or callee.startswith("numpy.random."))
+            ):
+                ctx.add("TPU007", node, f"{callee}()")
+            # TPU011: collective under tainted control flow
+            if (
+                (tail in _LAX_COLLECTIVE_NAMES
+                 and (root in ("lax", "jops") or callee.startswith("jax.lax.")))
+                or tail in _EAGER_COLLECTIVE_NAMES
+                or (tail in _EAGER_COLLECTIVE_SHORT
+                    and root in _EAGER_COLLECTIVE_ROOTS)
+            ):
+                ctrl = tainted_control_depth(control_stack)
+                if ctrl is not None:
+                    ctx.add(
+                        "TPU011",
+                        node,
+                        f"{callee} under `{type(ctrl).__name__.lower()}` on a traced value",
+                    )
+        elif isinstance(node, (ast.If, ast.While)):
+            if taint.expr_is_tainted(node.test):
+                ctx.add(
+                    "TPU004",
+                    node,
+                    f"`{type(node).__name__.lower()}` on a traced value",
+                )
+        elif isinstance(node, ast.Assert):
+            if taint.expr_is_tainted(node.test):
+                ctx.add("TPU004", node, "`assert` on a traced value")
+        elif isinstance(node, ast.stmt):
+            taint.note_statement(node)
+
+        pushed = isinstance(node, (ast.If, ast.While, ast.For))
+        if pushed:
+            control_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if pushed:
+            control_stack.pop()
+
+    for stmt in fn.body:
+        visit(stmt)
+
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def check_jitted_signature(fn: ast.FunctionDef, ctx: _Ctx) -> None:
+    """TPU009: mutable default args on a jitted function."""
+    defaults = list(fn.args.defaults) + [d for d in fn.args.kw_defaults if d is not None]
+    for d in defaults:
+        if isinstance(d, _MUTABLE_LITERALS) or (
+            isinstance(d, ast.Call) and _dotted(d.func) in ("list", "dict", "set")
+        ):
+            ctx.add("TPU009", d)
+
+
+def _is_timing_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    callee = _dotted(node.func)
+    return (
+        callee.split(".", 1)[0] in ("time", "datetime")
+        and callee.rsplit(".", 1)[-1] in _TIME_CALLS
+    )
+
+
+def _contains_fence(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            callee = _dotted(sub.func)
+            if callee.rsplit(".", 1)[-1] in _FENCE_NAMES:
+                return True
+        if isinstance(sub, ast.Attribute) and sub.attr in ("block_until_ready",):
+            return True
+    return False
+
+
+def check_unfenced_timing(fn: ast.FunctionDef | ast.Module, ctx: _Ctx) -> None:
+    """TPU008: ``t0 = time.*()`` … dispatch … ``time.*() - t0`` with no
+    blocking fence in between. Linear statement scan of each suite,
+    recursing into loop/branch/try bodies with their own timer scope so the
+    canonical per-iteration form (``for ...: t0 = time(); step(); ... - t0``)
+    is caught, not just timers opened at the suite's top level. Accepts a
+    Module so script-level timing (no enclosing def) is scanned too."""
+    reported: set[tuple[int, int]] = set()
+
+    def scan(body: list[ast.stmt]):
+        open_timers: dict[str, int] = {}  # var -> fence count at start
+        fences = 0
+        dispatches_since: dict[str, int] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # own scope — run_rules visits every def itself
+            has_fence = _contains_fence(stmt)
+            stop_reads: list[tuple[str, ast.AST]] = []
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub):
+                    if _is_timing_call(sub.left) and isinstance(sub.right, ast.Name):
+                        stop_reads.append((sub.right.id, sub))
+            for var, node in stop_reads:
+                if var in open_timers and not has_fence:
+                    if fences == open_timers[var] and dispatches_since.get(var, 0) > 0:
+                        key = (node.lineno, node.col_offset)
+                        if key not in reported:
+                            reported.add(key)
+                            ctx.add(
+                                "TPU008",
+                                node,
+                                f"elapsed read of `{var}` with no block_until_ready since it was set",
+                            )
+                open_timers.pop(var, None)
+            if has_fence:
+                fences += 1
+            # a new timer start
+            if isinstance(stmt, ast.Assign) and _is_timing_call(stmt.value):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        open_timers[target.id] = fences
+                        dispatches_since[target.id] = 0
+            elif any(isinstance(sub, ast.Call) and not _is_timing_call(sub)
+                     and not has_fence for sub in ast.walk(stmt)):
+                for var in open_timers:
+                    dispatches_since[var] = dispatches_since.get(var, 0) + 1
+            # recurse: inner suites get their own timer scope (dedup via
+            # `reported` where the outer walk already saw the same read),
+            # while outer timers keep accumulating fences/dispatches
+            for attr in ("body", "orelse", "finalbody"):
+                nested = getattr(stmt, attr, None)
+                if nested:
+                    scan(nested)
+                    for sub in nested:
+                        if _contains_fence(sub):
+                            fences += 1
+                        elif any(isinstance(s, ast.Call) for s in ast.walk(sub)):
+                            for var in open_timers:
+                                dispatches_since[var] = dispatches_since.get(var, 0) + 1
+            for handler in getattr(stmt, "handlers", None) or []:
+                scan(handler.body)
+
+    scan(fn.body)
+
+
+def check_scalar_retrace(tree: ast.Module, jitted_names: set[str], ctx: _Ctx) -> None:
+    """TPU010: a jitted callable invoked with the bare induction variable of
+    an enclosing ``for … in range(...)`` loop."""
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_vars: list[str] = []
+
+        def visit_For(self, node: ast.For):
+            tail = (
+                _dotted(node.iter.func).rsplit(".", 1)[-1]
+                if isinstance(node.iter, ast.Call)
+                else ""
+            )
+            pushed: list[str] = []
+            if tail == "range":
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        pushed.append(sub.id)
+            elif tail == "enumerate":
+                # only the INDEX element is a loop-varying scalar; the
+                # payload (`for step, batch in enumerate(loader)`) is
+                # whatever the iterable yields — flagging it would false-
+                # positive on the canonical training loop
+                if (
+                    isinstance(node.target, ast.Tuple)
+                    and node.target.elts
+                    and isinstance(node.target.elts[0], ast.Name)
+                ):
+                    pushed.append(node.target.elts[0].id)
+            self.loop_vars.extend(pushed)
+            self.generic_visit(node)
+            for _ in pushed:
+                self.loop_vars.pop()
+
+        def visit_Call(self, node: ast.Call):
+            callee = _dotted(node.func)
+            if callee in jitted_names:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in self.loop_vars:
+                        ctx.add(
+                            "TPU010",
+                            node,
+                            f"`{arg.id}` varies per iteration of an enclosing range() loop",
+                        )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+
+
+def run_rules(tree: ast.Module, path: str) -> list[Finding]:
+    """All rules over one parsed module."""
+    ctx = _Ctx(path=path)
+    traced, statics, jitted_names = collect_traced_names(tree)
+    jax_aliases = collect_jax_aliases(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            if node.name in traced:
+                nums, names = statics.get(node.name, (set(), set()))
+                check_traced_function(node, ctx, nums, names, jax_aliases)
+            if node.name in jitted_names:
+                check_jitted_signature(node, ctx)
+            check_unfenced_timing(node, ctx)
+    check_unfenced_timing(tree, ctx)  # module-level script timing
+    check_scalar_retrace(tree, jitted_names, ctx)
+    ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return ctx.findings

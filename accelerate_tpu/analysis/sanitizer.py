@@ -1,0 +1,238 @@
+"""Runtime sanitizer mode — the dynamic half of ``accelerate-tpu lint``.
+
+Armed with ``Accelerator(sanitize=True)`` or ``ACCELERATE_SANITIZE=1``,
+the sanitizer turns the compiled-program analyzers loose on the live run:
+
+* every compile on the AOT path (:mod:`accelerate_tpu.lazy`) is
+  fingerprinted — a **re-trace names the argument** whose shape/dtype
+  changed, on stderr and as a telemetry ``event`` row;
+* the first compile of each label runs the **donation checker** and
+  reports non-donated inputs that alias an output (wasted HBM bytes);
+* the compiled HLO's **collective-sequence digest** is written to a
+  per-host file under ``logging_dir/diagnostics/`` so
+  ``accelerate-tpu monitor`` can diff hosts and name a divergent one;
+* at every optimizer-step boundary the loss is probed for **NaN/inf**
+  (this forces the value — a host sync the sanitizer accepts by design;
+  it is a debugging mode, not a production default).
+
+Disabled cost follows the telemetry/metrics convention exactly: every
+instrumentation site holds :func:`get_active_sanitizer` — one module
+global read and a truthiness test.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from .compiled import (
+    RecompileFingerprinter,
+    collective_digest,
+    donation_report,
+    format_signature_diff,
+    write_host_digest,
+)
+
+
+class _NullSanitizer:
+    """Disabled mode: falsy, every method a no-op."""
+
+    enabled = False
+
+    def __bool__(self):
+        return False
+
+    def observe_compile(self, *a, **k):
+        pass
+
+    def check_loss(self, *a, **k):
+        pass
+
+    def report(self):
+        return {}
+
+
+NULL_SANITIZER = _NullSanitizer()
+
+_ACTIVE: "_NullSanitizer | Sanitizer" = NULL_SANITIZER
+
+
+def get_active_sanitizer():
+    return _ACTIVE
+
+
+def set_active_sanitizer(sanitizer) -> None:
+    global _ACTIVE
+    _ACTIVE = sanitizer if sanitizer is not None else NULL_SANITIZER
+
+
+def _host_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class Sanitizer:
+    """Owns the runtime checks and their one report stream.
+
+    Args:
+        logging_dir: where per-host collective-digest files land (no digest
+            files when None; stderr reports still fire).
+        nan_check: probe the loss for NaN/inf at step boundaries (the one
+            check with a per-step host-sync cost; the others only run at
+            compile time, which is already a multi-second event).
+        max_reports: stop printing (but keep counting) after this many
+            reports per kind — a shape-unstable loop must not flood stderr
+            at decode rate.
+        stream: report sink (stderr by default; tests inject a StringIO).
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        logging_dir: str | None = None,
+        nan_check: bool = True,
+        max_reports: int = 20,
+        stream=None,
+    ):
+        self.logging_dir = logging_dir
+        self.nan_check = bool(nan_check)
+        self.max_reports = int(max_reports)
+        self._stream = stream
+        self.fingerprinter = RecompileFingerprinter()
+        self._donation_done: set[str] = set()
+        self.counts = {"retrace": 0, "donation": 0, "nonfinite_loss": 0}
+        self.reports: list[dict] = []
+        self._step = 0
+
+    def __bool__(self):
+        return True
+
+    # -- report plumbing -----------------------------------------------------
+
+    def _emit(self, kind: str, message: str, **fields):
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        record = {"kind": kind, "message": message, "ts": time.time(), **fields}
+        self.reports.append(record)
+        if len(self.reports) > 4 * self.max_reports:
+            del self.reports[: len(self.reports) - 4 * self.max_reports]
+        if self.counts[kind] <= self.max_reports:
+            stream = self._stream or sys.stderr
+            print(f"TPU-SANITIZER[{kind}]: {message}", file=stream, flush=True)
+            if self.counts[kind] == self.max_reports:
+                print(
+                    f"TPU-SANITIZER[{kind}]: report limit reached; further "
+                    f"{kind} reports are counted but not printed",
+                    file=stream,
+                    flush=True,
+                )
+        from ..telemetry import get_active_recorder
+
+        tel = get_active_recorder()
+        if tel:
+            tel.record_event(f"sanitizer_{kind}", message=message, **{
+                k: v for k, v in fields.items() if isinstance(v, (int, float, str, bool))
+            })
+
+    # -- compile-time checks (driven by lazy.py's AOT path) ------------------
+
+    def observe_compile(
+        self,
+        label: str,
+        entries,
+        diff: dict | None,
+        fn=None,
+        args=None,
+        donate_argnums=(),
+        compiled=None,
+    ) -> str | None:
+        """One cache-missed compile: retrace naming, donation check (first
+        compile of the label only), collective digest. Returns the digest
+        (when one was computed) so the caller can stamp it onto the compile
+        record without rendering the HLO text a second time."""
+        fp, own_diff = self.fingerprinter.note(label, entries)
+        diff = diff if diff is not None else own_diff
+        if diff is not None:
+            self._emit(
+                "retrace",
+                f"'{label}' re-traced at step {self._step} — "
+                + format_signature_diff(diff),
+                label=label,
+                fingerprint=fp,
+                changed=format_signature_diff(diff),
+            )
+        if label not in self._donation_done and fn is not None and args is not None:
+            self._donation_done.add(label)
+            try:
+                rep = donation_report(fn, args, donate_argnums, label=label)
+            except Exception:
+                rep = None
+            if rep and rep["wasted_bytes"] > 0:
+                names = ", ".join(c["arg"] for c in rep["candidates"][:4])
+                more = len(rep["candidates"]) - 4
+                self._emit(
+                    "donation",
+                    f"'{label}': {rep['wasted_bytes'] / 1e6:.2f} MB of inputs "
+                    f"aliasable with outputs are not donated ({names}"
+                    + (f", +{more} more" if more > 0 else "")
+                    + ") — pass donate_argnums to free them in place",
+                    label=label,
+                    wasted_bytes=rep["wasted_bytes"],
+                )
+                self.reports[-1]["candidates"] = rep["candidates"]
+        digest = None
+        if compiled is not None:
+            try:
+                digest, seq = collective_digest(compiled.as_text())
+            except Exception:
+                digest, seq = None, []
+            if digest is not None and self.logging_dir is not None:
+                try:
+                    write_host_digest(
+                        self.logging_dir, _host_index(), label, digest, seq
+                    )
+                except OSError:
+                    pass
+        return digest
+
+    # -- step-boundary checks ------------------------------------------------
+
+    def check_loss(self, value, step: int | None = None) -> None:
+        """NaN/inf probe on the step's loss. Accepts a concrete array or a
+        Deferred (forced — the probe IS a host sync, documented cost of
+        sanitize mode)."""
+        self._step = step if step is not None else self._step + 1
+        if not self.nan_check or value is None:
+            return
+        import numpy as np
+
+        try:
+            if hasattr(value, "force"):
+                value = value.force()
+            arr = np.asarray(value, dtype=np.float64)
+        except Exception:
+            return
+        if not np.all(np.isfinite(arr)):
+            kind = "nan" if np.any(np.isnan(arr)) else "inf"
+            self._emit(
+                "nonfinite_loss",
+                f"loss is {kind} at step {self._step} — check learning rate / "
+                f"loss scaling (fp16) / input data",
+                step=self._step,
+            )
+
+    # -- summary -------------------------------------------------------------
+
+    def report(self) -> dict:
+        return {
+            "counts": dict(self.counts),
+            "reports": list(self.reports),
+            "labels_fingerprinted": {
+                label: self.fingerprinter.compiles_of(label)
+                for label in list(self.fingerprinter._counts)
+            },
+        }

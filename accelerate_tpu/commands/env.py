@@ -47,6 +47,14 @@ def env_command(args) -> int:
             else "inactive (set ACCELERATE_DIAGNOSTICS=1 or "
             "Accelerator(diagnostics=True) for tracing + hang watchdog)"
         ),
+        "Sanitizer": (
+            "active (ACCELERATE_SANITIZE=1)"
+            if parse_flag_from_env("ACCELERATE_SANITIZE")
+            else "inactive (set ACCELERATE_SANITIZE=1 or "
+            "Accelerator(sanitize=True) for recompile naming, donation "
+            "report, collective digests, NaN loss probe; static pass: "
+            "`accelerate-tpu lint <paths>`)"
+        ),
         "Metrics": (
             "active (ACCELERATE_METRICS=1)"
             if parse_flag_from_env("ACCELERATE_METRICS")

@@ -1,0 +1,102 @@
+"""``accelerate-tpu lint`` — the TPU-correctness static-analysis pass.
+
+Lints training scripts for the anti-patterns that silently destroy the
+"~5 lines and your loop runs fast on TPU" contract: implicit host syncs
+inside step functions, retrace hazards, wall-clock/RNG baked into traces,
+unfenced timing, collectives under data-dependent control flow. Rule
+catalogue: ``accelerate_tpu/analysis/rules.py`` (docs:
+``usage_guides/linting.md``).
+
+Exit codes (consistent with ``monitor --once``):
+
+* ``0`` — clean, or warnings only
+* ``1`` — usage error (no such path, unknown rule id)
+* ``2`` — at least one **error**-severity finding
+
+The runtime half of the pass — recompile naming, donation report,
+collective-digest files, NaN/inf loss probe — is the sanitizer:
+``ACCELERATE_SANITIZE=1`` or ``Accelerator(sanitize=True)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def lint_command(args) -> int:
+    from ..analysis.engine import lint_paths, normalize_rule_ids
+    from ..analysis.rules import RULES
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  [{rule.severity:7s}] {rule.summary}")
+        return 0
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"lint: no such path: {path}", file=sys.stderr)
+            return 1
+    if not args.paths:
+        print("lint: no paths given (try `accelerate-tpu lint .`)", file=sys.stderr)
+        return 1
+
+    try:
+        select = normalize_rule_ids(args.select)
+        ignore = normalize_rule_ids(args.ignore)
+    except ValueError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 1
+
+    findings, files_scanned = lint_paths(args.paths, select=select, ignore=ignore)
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files_scanned": files_scanned,
+                    "errors": len(errors),
+                    "warnings": len(warnings),
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"lint: {files_scanned} file(s) scanned — "
+            f"{len(errors)} error(s), {len(warnings)} warning(s)"
+        )
+    return 2 if errors else 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "lint",
+        help="Static-analysis pass for TPU anti-patterns (host syncs, "
+        "retrace hazards, collective-order bugs)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule IDs to run exclusively (e.g. TPU001,TPU004)",
+    )
+    p.add_argument(
+        "--ignore",
+        default=None,
+        help="comma-separated rule IDs to skip",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    p.set_defaults(func=lint_command)
+    return p

@@ -25,7 +25,10 @@ def monitor_command(args) -> int:
 
     * ``0`` — healthy (or nothing to report yet)
     * ``1`` — usage error (``logging_dir`` is not a directory)
-    * ``2`` — a host is wedged or a ``HANG_REPORT`` exists
+    * ``2`` — a host is wedged, a ``HANG_REPORT`` exists, or the per-host
+      collective-sequence digests diverge (a pre-deadlock condition: the
+      sanitizer writes one digest file per host, and disagreement means a
+      cross-host collective will never match up)
     * ``3`` — an ``ACCELERATE_SLO_*`` alert rule is firing (``ALERTS.json``
       written next to the run's artifacts; wedged/hang wins when both hold)
     """
@@ -58,7 +61,11 @@ def monitor_command(args) -> int:
                         f"{alert['threshold']:.4g} ({alert['env']})"
                     )
                 print(text)
-                if status["wedged"] or status["hang_reports"]:
+                if (
+                    status["wedged"]
+                    or status["hang_reports"]
+                    or status.get("collective_divergence")
+                ):
                     return 2
                 return EXIT_SLO_VIOLATION if firing else 0
             # repaint in place: clear screen + home, like `watch`

@@ -723,25 +723,28 @@ class DataLoaderDispatcher(DataLoaderShard):
             return None
 
         def _send_tensor(a):
-            # >4-byte dtypes (int64/float64 — numpy's defaults) would be
-            # silently truncated by broadcast_one_to_all's jax round-trip
-            # under the default jax_enable_x64=False; ship them as raw
-            # bytes instead (still a tensor broadcast, no pickling)
-            if a.dtype.itemsize > 4:
-                a = np.frombuffer(np.ascontiguousarray(a).tobytes(), np.uint8)
+            # non-4-byte dtypes ride the wire as raw bytes packed into
+            # int32 WORDS (still a tensor broadcast, no pickling) — see
+            # ops.pack_words for the gloo/x64 wire-format rationale
+            if a.dtype.itemsize != 4:
+                a = ops.pack_words(np.ascontiguousarray(a).tobytes())
             multihost_utils.broadcast_one_to_all(a, is_source=True)
 
         def _recv_tensor(shape, dtype, scalar):
             dtype = np.dtype(dtype)
-            if dtype.itemsize > 4:
+            if dtype.itemsize != 4:
                 nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
                 data = multihost_utils.broadcast_one_to_all(
-                    np.zeros(nbytes, np.uint8), is_source=False
+                    np.zeros(ops.word_count(nbytes), np.int32), is_source=False
                 )
                 # .copy(): frombuffer over bytes yields a READ-ONLY view;
                 # rank 0 yields writable arrays, so without it any in-place
                 # batch mutation would crash only on non-main ranks
-                out = np.frombuffer(np.asarray(data).tobytes(), dtype).reshape(shape).copy()
+                out = (
+                    np.frombuffer(ops.unpack_words(data, nbytes), dtype)
+                    .reshape(shape)
+                    .copy()
+                )
             else:
                 # .copy() here too: np.asarray over a jax.Array is a
                 # READ-ONLY view, same rank-divergent mutability hazard

@@ -128,6 +128,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "stragglers": [],
         "wedged": [],
         "hang_reports": [],
+        "collective_divergence": [],
     }
 
     # -- telemetry tail ------------------------------------------------------
@@ -258,6 +259,16 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         except (OSError, json.JSONDecodeError):
             status["hang_reports"].append({"path": path})
 
+    # -- collective-sequence digests (written per host by the sanitizer,
+    # analysis/compiled.py): hosts whose compiled programs disagree on
+    # collective order WILL deadlock at the first mismatched rendezvous —
+    # naming the divergent host here is the pre-deadlock diagnosis --------
+    from ..analysis.compiled import diff_host_digests, read_host_digests
+
+    digests = read_host_digests(logging_dir)
+    if len(digests) >= 2:
+        status["collective_divergence"] = diff_host_digests(digests)
+
     # -- goodput ledger (trace trails; None when diagnostics is off or the
     # trail exceeds the parse cap — throttled per logging_dir so the repaint
     # loop never re-parses a fat trail 30x/minute; a `--once` probe runs in
@@ -340,4 +351,23 @@ def render_status(status: dict[str, Any]) -> str:
             f"{r.get('stalled_phase') or '?'} after {_fmt(r.get('elapsed_s'), '{:.0f}')}s "
             f"— {r['path']}"
         )
+    for d in status.get("collective_divergence") or []:
+        per_host = "  ".join(
+            f"host {h}: {digest}" for h, digest in sorted(d["digests"].items())
+        )
+        divergent = ", ".join(str(h) for h in d["divergent_hosts"])
+        if d.get("tie"):
+            lines.append(
+                f"  !! COLLECTIVE ORDER DIVERGES on '{d['label']}' — hosts "
+                f"{divergent} compiled different collective sequences with no "
+                f"majority (will deadlock at the first mismatched rendezvous): "
+                f"{per_host}"
+            )
+        else:
+            lines.append(
+                f"  !! COLLECTIVE ORDER DIVERGES on '{d['label']}' — host(s) "
+                f"{divergent} compiled a different collective sequence than the "
+                f"majority (will deadlock at the first mismatched rendezvous): "
+                f"{per_host}"
+            )
     return "\n".join(lines)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .analysis.sanitizer import get_active_sanitizer as _get_sanitizer
 from .diagnostics.tracing import get_tracer as _get_tracer, trace_span as _trace_span
 
 
@@ -513,7 +514,7 @@ def _compile_facts(jitted, args, label: str) -> tuple:
     return compiled, facts
 
 
-def _cost_aware_jit(fn, donate_argnums=(), label=""):
+def _cost_aware_jit(fn, donate_argnums=(), label="", arg_names=()):
     """``jax.jit`` that, while instrumentation is active (a profile session
     with ``with_flops``, or a telemetry recorder's compile callback),
     AOT-compiles each new signature explicitly — timing trace+lower+compile
@@ -524,9 +525,15 @@ def _cost_aware_jit(fn, donate_argnums=(), label=""):
 
     def call(*args):
         callback = _COMPILE_CALLBACK
+        sanitizer = _get_sanitizer()
         # an active tracer also wants the explicit AOT path: it is what
-        # separates trace/lower/compile into spans a flame graph shows
-        if not (_COLLECT_COSTS or callback is not None) and not _get_tracer():
+        # separates trace/lower/compile into spans a flame graph shows.
+        # An active sanitizer does too: the donation / fingerprint /
+        # collective-digest checks need the compiled artifact in hand.
+        if (
+            not (_COLLECT_COSTS or callback is not None or sanitizer)
+            and not _get_tracer()
+        ):
             return jitted(*args)
         # every leaf participates: truncating the signature would hand
         # a cached executable mismatched avals if two calls differ only
@@ -553,6 +560,50 @@ def _cost_aware_jit(fn, donate_argnums=(), label=""):
             except Exception:  # AOT path unavailable on this backend
                 entry = (None, None)
             _AOT_CACHE[sig] = entry
+            if entry[1] is not None:
+                # recompile fingerprint: hash the abstract signature with
+                # leaf PATHS attached, so a later compile of the same label
+                # can NAME the argument whose shape/dtype changed. Shared
+                # global history — the telemetry record, the sanitizer's
+                # stderr report, and the serving engine's assertion all
+                # diff against the same baseline.
+                from .analysis.compiled import (
+                    format_signature_diff,
+                    note_signature,
+                    signature_entries,
+                )
+
+                try:
+                    # leaf paths read as ['inputs'][0] instead of [3][0]
+                    # when the call site named its positional args
+                    if arg_names and len(args) <= len(arg_names):
+                        named = dict(zip(arg_names, args))
+                    else:
+                        named = args
+                    entries = signature_entries(named)
+                    fingerprint, diff = note_signature(label, entries)
+                    entry[1]["fingerprint"] = fingerprint
+                    if diff is not None:
+                        entry[1]["changed_args"] = format_signature_diff(diff)
+                except Exception:
+                    entries, diff = (), None
+                if sanitizer:
+                    # the digest also rides the compile record so the
+                    # telemetry trail carries cross-host-comparable state;
+                    # observe_compile already computed it for the host
+                    # digest file — reuse it rather than rendering the
+                    # (multi-MB) HLO text a second time
+                    digest = sanitizer.observe_compile(
+                        label,
+                        entries,
+                        diff,
+                        fn=fn,
+                        args=args,
+                        donate_argnums=donate_argnums,
+                        compiled=entry[0],
+                    )
+                    if digest is not None:
+                        entry[1]["collective_digest"] = digest
             if entry[1] is not None and callback is not None:
                 # the human-readable shape key: label + the leaf signature
                 # (the part of the cache key a batch-shape change perturbs).
@@ -590,6 +641,9 @@ def clear_caches():
     _FUSED_CACHE.clear()
     _AOT_CACHE.clear()
     _COST_SEEN.clear()
+    from .analysis.compiled import GLOBAL_FINGERPRINTS
+
+    GLOBAL_FINGERPRINTS.clear()
 
 
 def force_value(deferred: Deferred):
@@ -603,7 +657,10 @@ def force_value(deferred: Deferred):
             env = {id(m): p for m, p in zip(models, model_params)}
             return replay(root, input_values, env)
 
-        entry = (_cost_aware_jit(fn, label="forward"), models)
+        entry = (
+            _cost_aware_jit(fn, label="forward", arg_names=("model_params", "inputs")),
+            models,
+        )
         _FORCE_CACHE[key] = entry
     jitted, cached_models = entry
     params = [m.params for m in cached_models]
@@ -651,7 +708,15 @@ def grad_fn_for(
             vag = ddp_compressed_vag(loss_fn, comm_hook[1], inputs, comm_hook[0])
         else:
             vag = jax.value_and_grad(loss_fn, argnums=0, has_aux=True)
-        entry = (_cost_aware_jit(vag, label="grad"), trainables, frozen)
+        entry = (
+            _cost_aware_jit(
+                vag,
+                label="grad",
+                arg_names=("params", "frozen_params", "inputs", "loss_scale"),
+            ),
+            trainables,
+            frozen,
+        )
         _GRAD_CACHE[key] = entry
     jitted, trainables, frozen = entry
     return jitted, trainables, frozen, inputs
@@ -823,7 +888,18 @@ def fused_step_fn_for(
                 new_opt_state = keep(new_opt_state, opt_state)
             return new_params, new_opt_state, loss_value, norm, step_ok, new_scaler_state
 
-        entry = (_cost_aware_jit(step, donate_argnums=(0, 1), label="fused_step"), frozen)
+        entry = (
+            _cost_aware_jit(
+                step,
+                donate_argnums=(0, 1),
+                label="fused_step",
+                arg_names=(
+                    "params", "opt_state", "frozen_params", "inputs",
+                    "max_norm", "scaler_state",
+                ),
+            ),
+            frozen,
+        )
         _FUSED_CACHE[key] = entry
     jitted, frozen = entry
     return jitted, frozen, inputs

@@ -52,6 +52,41 @@ class DistributedOperationException(Exception):
     (reference ``operations.py:359``)."""
 
 
+def pack_words(raw: bytes | np.ndarray) -> np.ndarray:
+    """Bytes → the int32-WORD wire format every cross-host byte/raw-tensor
+    broadcast in this package uses. int32 is the one dtype every backend
+    moves verbatim: a real 2-process run showed this jaxlib's gloo CPU
+    broadcast strides sub-4-byte elements through 4-byte slots (each u8
+    lands at offset 4i), and >4-byte dtypes (int64/float64) are silently
+    truncated by the jax round-trip under the default
+    ``jax_enable_x64=False``. Pads to a 4-byte multiple; pair with
+    :func:`unpack_words` and the original byte length."""
+    if isinstance(raw, bytes):
+        raw = np.frombuffer(raw, np.uint8)
+    else:
+        # reinterpret the array's BYTES — assigning a typed array into a
+        # uint8 buffer would element-cast (truncating anything >255)
+        raw = np.ascontiguousarray(raw).reshape(-1).view(np.uint8)
+    padded = np.zeros((raw.size + 3) // 4 * 4, np.uint8)
+    padded[: raw.size] = raw
+    return padded.view(np.int32)
+
+
+def word_count(nbytes: int) -> int:
+    """How many int32 words :func:`pack_words` produces for ``nbytes``."""
+    return (int(nbytes) + 3) // 4
+
+
+def unpack_words(words, nbytes: int) -> bytes:
+    """Inverse of :func:`pack_words`: the first ``nbytes`` payload bytes of
+    an int32 word array (accepts jax or numpy arrays)."""
+    return (
+        np.ascontiguousarray(np.asarray(words, dtype=np.int32))
+        .view(np.uint8)[: int(nbytes)]
+        .tobytes()
+    )
+
+
 # ---------------------------------------------------------------------------
 # pytree plumbing
 # ---------------------------------------------------------------------------
@@ -258,9 +293,25 @@ def broadcast(tensor: Any, from_process: int = 0):
 
     def _bcast(t):
         is_source = state.process_index == from_process
-        return multihost_utils.broadcast_one_to_all(
-            np.asarray(_materialize(t)), is_source=is_source
-        )
+        a = np.asarray(_materialize(t))
+        if a.dtype.itemsize != 4:
+            # non-4-byte dtypes ride the wire as int32 WORDS — see
+            # pack_words for the gloo/x64 rationale; every rank knows the
+            # leaf's shape/dtype (broadcast semantics: all ranks pass a
+            # same-structured operand), so no metadata exchange is needed
+            nbytes = a.nbytes
+            words = (
+                pack_words(np.ascontiguousarray(a).tobytes())
+                if is_source
+                else np.zeros(word_count(nbytes), np.int32)
+            )
+            data = multihost_utils.broadcast_one_to_all(words, is_source=is_source)
+            return (
+                np.frombuffer(unpack_words(data, nbytes), a.dtype)
+                .reshape(a.shape)
+                .copy()
+            )
+        return multihost_utils.broadcast_one_to_all(a, is_source=is_source)
 
     return recursively_apply(_bcast, tensor)
 
@@ -273,16 +324,20 @@ def broadcast_object_list(object_list: list[Any], from_process: int = 0) -> list
         return object_list
     from jax.experimental import multihost_utils
 
-    payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    payload = pickle.dumps(list(object_list))
     is_source = state.process_index == from_process
     size = multihost_utils.broadcast_one_to_all(
-        np.array([payload.size], dtype=np.int64), is_source=is_source
+        np.array([len(payload)], dtype=np.int64), is_source=is_source
     )
-    buf = np.zeros(int(size[0]), dtype=np.uint8)
-    if is_source:
-        buf[:] = payload
-    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-    received = pickle.loads(data.tobytes())
+    nbytes = int(size[0])
+    # ship the bytes as int32 WORDS, not uint8 — see pack_words for why
+    words = (
+        pack_words(payload)
+        if is_source
+        else np.zeros(word_count(nbytes), dtype=np.int32)
+    )
+    data = multihost_utils.broadcast_one_to_all(words, is_source=is_source)
+    received = pickle.loads(unpack_words(data, nbytes))
     object_list[:] = received
     return object_list
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .analysis.sanitizer import get_active_sanitizer as _get_sanitizer
 from .state import AcceleratorState, GradientState
 
 
@@ -258,6 +259,11 @@ class AcceleratedOptimizer:
         if self.scaler is not None:
             self.scaler.set_state(new_scaler_state)
         loss._set_forced(loss_value)
+        sanitizer = _get_sanitizer()
+        if sanitizer:
+            # fused path: the loss materializes here — step-boundary
+            # NaN/inf probe (forces the value; sanitize-mode cost)
+            sanitizer.check_loss(loss_value)
         self._last_norm = norm
         self._step_ok_device = step_ok if self.scaler is not None else None
         self._step_was_skipped = False  # overridden lazily via step_was_skipped

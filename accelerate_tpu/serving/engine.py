@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.sanitizer import get_active_sanitizer as _get_sanitizer
 from ..diagnostics.tracing import trace_span
 from ..generation import _pick_traced
 from ..telemetry import get_active_recorder
@@ -131,6 +132,13 @@ class InferenceEngine:
         # executable" acceptance bar reads decode_compiles)
         self._decode_traces = 0
         self._prefill_traces = 0
+        # one-executable watchdog state: the abstract signature of every
+        # decode dispatch, so a second trace can NAME the argument whose
+        # shape/dtype drifted (analysis/compiled.py fingerprint diff) —
+        # with the sanitizer armed the re-trace raises immediately
+        self._decode_sig: tuple | None = None
+        self._decode_traces_seen = 0
+        self.retrace_report: str | None = None
         self._iterations = 0
         self._tokens_emitted = 0
         self._occupancy_sum = 0.0
@@ -307,6 +315,8 @@ class InferenceEngine:
                 self._occupancy_sum / self._iterations if self._iterations else 0.0
             ),
         }
+        if self.retrace_report is not None:
+            out["retrace_report"] = self.retrace_report
         if self._start_time is not None:
             elapsed = time.perf_counter() - self._start_time
             out["elapsed_s"] = elapsed
@@ -382,16 +392,74 @@ class InferenceEngine:
         if not live:
             return
 
+        # signature capture costs ~8 shape/dtype formats per dispatch, so it
+        # rides the same armed-instrumentation gate as every other hot-path
+        # site (one global read each when disabled); the retrace *counter*
+        # check below stays unconditional — it is just two int compares
+        decode_sig = None
+        if _get_sanitizer() or get_active_recorder():
+            decode_sig = tuple(
+                (name, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
+                for name, v in (
+                    ("kp", self._kp), ("vp", self._vp),
+                    ("block_tables", self._block_tables), ("pos0", pos0),
+                    ("toks", toks), ("active", active), ("key", self._key),
+                    ("temp", self._temp),
+                )
+            )
         self._kp, self._vp, next_toks, self._key = self._decode_fn(
             self._params, self._kp, self._vp, self._block_tables, pos0, toks,
             active, self._key, self._temp,
         )
+        self._check_one_executable(decode_sig)
         next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
         for req in live:
             for t in range(burst):
                 if req.state is RequestState.FINISHED:
                     break  # mid-burst eos/length: the tail lane-steps are waste
                 self._emit_token(req, int(next_toks[t, req.slot]), finished)
+
+    def _check_one_executable(self, decode_sig: tuple | None) -> None:
+        """ONE compiled decode executable is the engine's core contract.
+        When the trace counter moves past 1, diff the dispatch's abstract
+        signature against the first trace's and put the named argument in
+        the failure message — "decode re-traced" alone sends the operator
+        bisecting; "block_tables went (8, 32):int32 -> (8, 64):int32" names
+        the bug. ``decode_sig`` is None when no instrumentation is armed
+        (the counter still catches the retrace, just without arg naming).
+        Armed sanitizer ⇒ raise; otherwise record + surface via
+        ``stats()['retrace_report']`` and telemetry."""
+        traced_now = self._decode_traces != self._decode_traces_seen
+        self._decode_traces_seen = self._decode_traces
+        if not traced_now or self._decode_traces <= 1:
+            self._decode_sig = decode_sig
+            return
+        if self._decode_sig is not None and decode_sig is not None:
+            from ..analysis.compiled import diff_signatures, format_signature_diff
+
+            diff = diff_signatures(self._decode_sig, decode_sig)
+            detail = (
+                format_signature_diff(diff)
+                if diff is not None
+                else "abstract signature unchanged (params/pages identity drift?)"
+            )
+        else:
+            detail = (
+                "fingerprint not captured — enable sanitizer or telemetry "
+                "for argument naming"
+            )
+        self._decode_sig = decode_sig
+        message = (
+            f"serving engine decode re-traced (compile #{self._decode_traces}; "
+            f"the one-compiled-executable contract is broken) — fingerprint "
+            f"diff vs previous dispatch: {detail}"
+        )
+        self.retrace_report = message
+        tel = get_active_recorder()
+        if tel:
+            tel.record_event("serving_retrace", message=message)
+        if _get_sanitizer():
+            raise RuntimeError(message)
 
     def _emit_token(self, req: Request, tok: int, finished: list[Request]) -> None:
         now = time.perf_counter()

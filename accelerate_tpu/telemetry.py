@@ -48,7 +48,12 @@ see :data:`SCHEMA_VERSION`):
                ``perf_counter`` clock the diagnostics trace spans use).
                ``ts`` stays wall-clock like every record; ``mono`` is what
                lines a compile record up with the per-host trace timeline
-               (trace export / ``accelerate-tpu trace merge``).
+               (trace export / ``accelerate-tpu trace merge``). When the
+               AOT path fingerprinted the signature (always on the AOT
+               path): ``fingerprint``, and on a re-trace ``changed_args``
+               naming the argument whose shape/dtype changed; with the
+               sanitizer armed, ``collective_digest`` (the ordered
+               collective-sequence hash ``monitor`` diffs across hosts).
 ``memory``   — ``device_bytes_in_use``, ``device_peak_bytes``,
                ``host_rss_bytes`` (sampled every ``memory_interval`` steps).
 ``generate`` — ``mode``, ``new_tokens``, ``seconds``, ``tokens_per_sec``
@@ -439,22 +444,27 @@ class TelemetryRecorder:
         if facts.get("label") in _STEP_LABELS and facts.get("flops"):
             self._step_flops = float(facts["flops"])
             self._step_collective_bytes = facts.get("collective_bytes")
-        self._emit(
-            {
-                "type": "compile",
-                "label": facts.get("label"),
-                "static_key": facts.get("static_key"),
-                "lower_s": facts.get("lower_s"),
-                "compile_s": facts.get("compile_s"),
-                "total_s": total_s,
-                "mono": facts.get("mono"),
-                "flops": facts.get("flops"),
-                "bytes_accessed": facts.get("bytes_accessed"),
-                "collective_bytes": facts.get("collective_bytes"),
-                "recompiles": self.recompile_count,
-            },
-            step=self.optimizer_step_count,
-        )
+        record = {
+            "type": "compile",
+            "label": facts.get("label"),
+            "static_key": facts.get("static_key"),
+            "lower_s": facts.get("lower_s"),
+            "compile_s": facts.get("compile_s"),
+            "total_s": total_s,
+            "mono": facts.get("mono"),
+            "flops": facts.get("flops"),
+            "bytes_accessed": facts.get("bytes_accessed"),
+            "collective_bytes": facts.get("collective_bytes"),
+            "recompiles": self.recompile_count,
+        }
+        # analysis/compiled.py fingerprint: present whenever the AOT path
+        # computed one. ``changed_args`` NAMES the argument whose
+        # shape/dtype perturbed the signature — the "why did this
+        # re-trace" answer, directly in the trail
+        for key in ("fingerprint", "changed_args", "collective_digest"):
+            if facts.get(key) is not None:
+                record[key] = facts[key]
+        self._emit(record, step=self.optimizer_step_count)
 
     # -- per-step plumbing ---------------------------------------------------
 

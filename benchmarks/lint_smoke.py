@@ -1,0 +1,124 @@
+"""``make lint-smoke``: the static-analysis pass + sanitizer end to end.
+
+Four assertions, exit code is the CI signal:
+
+1. a seeded-bad script trips error-severity rules through the REAL CLI
+   (``accelerate-tpu lint --json`` exit 2, rule IDs present);
+2. the shipped ``examples/`` + ``benchmarks/`` tree is clean (the
+   self-application gate `make lint` enforces);
+3. a deliberately shape-unstable toy loop under ``ACCELERATE_SANITIZE=1``
+   reports the re-trace on stderr NAMING the offending argument;
+4. the sanitizer wrote this host's collective-digest file and the
+   monitor-side reader parses it back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BAD_SCRIPT = textwrap.dedent(
+    """
+    import time, random
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def train_step(params, x):
+        loss = (x * params).sum()
+        if loss > 1.0:          # TPU004
+            loss = loss * 0.5
+        v = loss.item()         # TPU001
+        t = time.time()         # TPU006
+        return loss
+    """
+)
+
+
+def main() -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    # 1. seeded positives exit 2 with the right rule IDs
+    with tempfile.TemporaryDirectory(prefix="lint_smoke_") as tmp:
+        bad = os.path.join(tmp, "bad_train.py")
+        with open(bad, "w") as f:
+            f.write(BAD_SCRIPT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "lint", "--json", bad],
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+        )
+        assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+        payload = json.loads(proc.stdout)
+        rules = {f["rule"] for f in payload["findings"]}
+        assert {"TPU001", "TPU004", "TPU006"} <= rules, rules
+
+    # 2. the shipped tree is clean
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "lint", "--json", "examples", "benchmarks"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["errors"] == 0 and payload["warnings"] == 0, payload["findings"]
+
+    # 3 + 4. runtime sanitizer on a shape-unstable loop (subprocess so
+    # ACCELERATE_SANITIZE=1 — the env-var arming path — is what is proven)
+    with tempfile.TemporaryDirectory(prefix="lint_smoke_run_") as tmp:
+        loop = os.path.join(tmp, "unstable.py")
+        with open(loop, "w") as f:
+            f.write(textwrap.dedent(
+                """
+                import os, sys
+                import numpy as np
+                import optax
+                from accelerate_tpu import Accelerator
+                from accelerate_tpu.test_utils import RegressionModel
+
+                acc = Accelerator(project_dir=os.environ["RUN_DIR"], telemetry=True)
+                assert acc.sanitizer is not None, "ACCELERATE_SANITIZE=1 not honored"
+                model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+                for n in (16, 24, 32):
+                    x = np.linspace(-1, 1, n).astype(np.float32)
+                    out = model(x=x, y=(2 * x + 3).astype(np.float32))
+                    acc.backward(out.loss)
+                    opt.step(); opt.zero_grad()
+                acc.end_training()
+                print("UNSTABLE_DONE")
+                """
+            ))
+        run_dir = os.path.join(tmp, "run")
+        os.makedirs(run_dir)
+        proc = subprocess.run(
+            [sys.executable, loop],
+            capture_output=True, text=True, cwd=REPO,
+            env={**env, "ACCELERATE_SANITIZE": "1", "RUN_DIR": run_dir,
+                 "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")},
+            timeout=420,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "UNSTABLE_DONE" in proc.stdout
+        assert "TPU-SANITIZER[retrace]" in proc.stderr, proc.stderr[-2000:]
+        assert "'inputs'" in proc.stderr, proc.stderr[-2000:]
+
+        from accelerate_tpu.analysis.compiled import read_host_digests
+
+        digests = read_host_digests(run_dir)
+        assert 0 in digests and digests[0], digests
+
+    print("LINT_SMOKE_OK: CLI exit codes, clean self-application, "
+          "sanitizer retrace naming + digest files all verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

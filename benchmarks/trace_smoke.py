@@ -36,6 +36,11 @@ def _loop(acc, model, opt, steps: int) -> float:
         acc.backward(out.loss)
         opt.step()
         opt.zero_grad()
+    # fence before the stop read (tpu-lint TPU008): without it the loop
+    # times dispatch only and the last steps are still in flight
+    import jax
+
+    jax.block_until_ready(model.params)
     return (time.perf_counter() - t0) / steps
 
 

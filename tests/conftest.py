@@ -84,3 +84,6 @@ def reset_state():
     handler = get_active_handler()
     if handler is not None:  # restore the process signal handlers
         handler.uninstall()
+    from accelerate_tpu.analysis.sanitizer import set_active_sanitizer
+
+    set_active_sanitizer(None)

@@ -1,0 +1,839 @@
+"""The static-analysis pass + runtime sanitizer (accelerate_tpu/analysis/).
+
+Golden fixture corpus: ONE positive and ONE negative snippet per lint rule
+— every positive must fire exactly its rule, every negative must be clean
+(zero false positives is the bar that makes `make lint` a gate instead of
+noise). Plus: the jaxpr/HLO analyzers against a toy jitted step, digest
+stability, suppression syntax, the CLI's exit codes, and the sanitizer's
+runtime reports.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.analysis.engine import (
+    lint_paths,
+    lint_source,
+    normalize_rule_ids,
+)
+from accelerate_tpu.analysis.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# golden corpus: {rule: (positive_snippet, negative_snippet)}
+# ---------------------------------------------------------------------------
+
+CORPUS = {
+    "TPU001": (
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    loss = (x * params).sum()
+    v = loss.item()
+    return v
+""",
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    return (x * params).sum()
+
+def outer(model, batch):
+    loss = train_step(model, batch)
+    return loss.item()  # outside the traced function: fine
+""",
+    ),
+    "TPU002": (
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    return float((x * params).sum())
+""",
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    scale = float(0.5)  # cast of a literal, not a traced value
+    return (x * params).sum() * scale
+""",
+    ),
+    "TPU003": (
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def train_step(params, x):
+    host = np.asarray(x)
+    return host.sum()
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def train_step(params, x):
+    return jnp.asarray(x).sum()  # jnp stays traced
+""",
+    ),
+    "TPU004": (
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    loss = (x * params).sum()
+    if loss > 1.0:
+        loss = loss * 0.5
+    return loss
+""",
+        """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("training",))
+def train_step(params, x, training):
+    if training:  # static arg: branch resolved at trace time by design
+        x = x * 2
+    return (x * params).sum()
+""",
+    ),
+    "TPU005": (
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    loss = (x * params).sum()
+    print(loss)
+    return loss
+""",
+        """
+import jax
+
+@jax.jit
+def train_step(params, x):
+    loss = (x * params).sum()
+    jax.debug.print("loss {l}", l=loss)
+    return loss
+""",
+    ),
+    "TPU006": (
+        """
+import time
+import jax
+
+@jax.jit
+def train_step(params, x):
+    t = time.time()
+    return (x * params).sum() + t
+""",
+        """
+import time
+import jax
+
+@jax.jit
+def train_step(params, x, now):
+    return (x * params).sum() + now  # timestamp passed in as an input
+
+def loop(params, x):
+    now = time.time()  # wall clock OUTSIDE the trace
+    return train_step(params, x, now)
+""",
+    ),
+    "TPU007": (
+        """
+import random
+import jax
+
+@jax.jit
+def train_step(params, x):
+    noise = random.random()
+    return (x * params).sum() + noise
+""",
+        """
+import jax
+
+@jax.jit
+def train_step(params, x, key):
+    noise = jax.random.normal(key, x.shape)
+    return ((x + noise) * params).sum()
+""",
+    ),
+    "TPU008": (
+        """
+import time
+import jax
+
+def bench(fn, x):
+    jitted = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = jitted(x)
+    return time.perf_counter() - t0
+""",
+        """
+import time
+import jax
+
+def bench(fn, x):
+    jitted = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = jitted(x)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+""",
+    ),
+    "TPU009": (
+        """
+import jax
+
+@jax.jit
+def train_step(x, history=[]):
+    return x * 2
+""",
+        """
+import jax
+
+@jax.jit
+def train_step(x, scale=2.0):
+    return x * scale
+""",
+    ),
+    "TPU010": (
+        """
+import jax
+
+step = jax.jit(lambda x, i: x * i)
+
+def loop(x):
+    for i in range(100):
+        x = step(x, i)
+    return x
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x, i: x * i)
+
+def loop(x):
+    for i in range(100):
+        x = step(x, jnp.asarray(i))  # array-wrapped: one trace
+    return x
+""",
+    ),
+    "TPU011": (
+        """
+import jax
+from jax import lax
+
+@jax.jit
+def train_step(params, grads):
+    if (grads * grads).sum() > 1.0:
+        grads = lax.psum(grads, "dp")
+    return params - grads
+""",
+        """
+import jax
+from jax import lax
+
+@jax.jit
+def train_step(params, grads):
+    grads = lax.psum(grads, "dp")  # unconditional: same order everywhere
+    big = (grads * grads).sum() > 1.0
+    return params - grads * big
+""",
+    ),
+}
+
+
+class TestGoldenCorpus:
+    @pytest.mark.parametrize("rule_id", sorted(CORPUS))
+    def test_positive_fires(self, rule_id):
+        positive, _ = CORPUS[rule_id]
+        findings = lint_source(positive, f"{rule_id}_pos.py")
+        assert rule_id in {f.rule for f in findings}, (
+            f"{rule_id} did not fire on its positive fixture: "
+            f"{[f.rule for f in findings]}"
+        )
+
+    @pytest.mark.parametrize("rule_id", sorted(CORPUS))
+    def test_negative_clean(self, rule_id):
+        _, negative = CORPUS[rule_id]
+        findings = lint_source(negative, f"{rule_id}_neg.py")
+        assert findings == [], (
+            f"false positive(s) on the {rule_id} negative fixture: "
+            f"{[(f.rule, f.line) for f in findings]}"
+        )
+
+    def test_every_rule_has_fixture_and_metadata(self):
+        assert set(CORPUS) == set(RULES)
+        for rule in RULES.values():
+            assert rule.severity in ("error", "warning")
+            assert rule.summary and rule.fixit
+
+    @pytest.mark.parametrize(
+        "import_line, call",
+        [
+            ("from jax import random", "random.normal(key, x.shape)"),
+            ("import jax.random as random", "random.normal(key, x.shape)"),
+            ("from jax import random as jrandom", "jrandom.normal(key, x.shape)"),
+        ],
+    )
+    def test_tpu007_exempts_jax_random_aliases(self, import_line, call):
+        """``from jax import random`` is the idiom TPU007's own fixit
+        recommends — it must not trip the host-RNG rule."""
+        src = f"""
+import jax
+{import_line}
+
+@jax.jit
+def train_step(params, x, key):
+    noise = {call}
+    return ((x + noise) * params).sum()
+"""
+        assert lint_source(src, "jax_alias.py") == []
+
+    def test_tpu010_enumerate_payload_not_flagged(self):
+        """`for step, batch in enumerate(loader)` is the canonical training
+        loop — the payload element is whatever the iterable yields, not a
+        loop-varying Python scalar; only the index is."""
+        src = """
+import jax
+
+train_step = jax.jit(lambda params, batch: params)
+
+def loop(params, loader):
+    for step, batch in enumerate(loader):
+        params = train_step(params, batch)
+    return params
+"""
+        assert lint_source(src, "enum.py") == []
+
+    def test_tpu010_enumerate_index_still_flagged(self):
+        src = """
+import jax
+
+train_step = jax.jit(lambda params, i: params * i)
+
+def loop(params, loader):
+    for step, batch in enumerate(loader):
+        params = train_step(params, step)
+    return params
+"""
+        assert {f.rule for f in lint_source(src, "enum_idx.py")} == {"TPU010"}
+
+    def test_tpu007_still_fires_on_stdlib_random(self):
+        src = """
+import jax
+import random
+
+@jax.jit
+def train_step(params, x):
+    return (x * params).sum() * random.random()
+"""
+        assert {f.rule for f in lint_source(src, "host_rng.py")} == {"TPU007"}
+
+    def test_tpu008_in_loop_timer_fires(self):
+        """Per-iteration timing is the canonical real-world form of the
+        unfenced-timing bug — the timer start lives inside the loop body,
+        not at the function's top level."""
+        src = """
+import time
+import jax
+
+def bench(fn, x, times):
+    jitted = jax.jit(fn)
+    for i in range(10):
+        t0 = time.perf_counter()
+        out = jitted(x)
+        times.append(time.perf_counter() - t0)
+    return times
+"""
+        findings = lint_source(src, "loop_timer.py")
+        assert "TPU008" in {f.rule for f in findings}
+
+    def test_tpu008_in_loop_timer_fenced_clean(self):
+        src = """
+import time
+import jax
+
+def bench(fn, x, times):
+    jitted = jax.jit(fn)
+    for i in range(10):
+        t0 = time.perf_counter()
+        out = jitted(x)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return times
+"""
+        assert lint_source(src, "loop_timer_ok.py") == []
+
+    def test_tpu008_module_level_script_fires(self):
+        """Script-level timing with no enclosing def — benchmarks are often
+        written this way, so the module body must be scanned too."""
+        src = """
+import time
+import jax
+import jax.numpy as jnp
+
+jitted = jax.jit(lambda x: x * 2)
+x = jnp.ones((8,))
+t0 = time.perf_counter()
+out = jitted(x)
+elapsed = time.perf_counter() - t0
+"""
+        findings = lint_source(src, "script_timer.py")
+        assert "TPU008" in {f.rule for f in findings}
+
+    def test_tpu011_local_lax_ops_not_flagged(self):
+        """lax.gather / lax.broadcast / lax.reduce are LOCAL ops (indexing,
+        shape broadcast, monoid reduce) — they must not trip the
+        collective-order rule even under traced control flow."""
+        src = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+@jax.jit
+def train_step(params, x):
+    if x.sum() > 0:
+        y = lax.broadcast(x, (2,))
+        z = lax.reduce(x, 0.0, lax.add, (0,))
+        return params + y.sum() + z
+    return params
+"""
+        findings = lint_source(src, "local_lax.py")
+        assert "TPU011" not in {f.rule for f in findings}
+
+    def test_tpu011_eager_short_names_need_ops_root(self):
+        """`accelerator.gather(...)` under traced control IS the eager
+        collective; a bare `gather(...)` on some unrelated object is not."""
+        src = """
+import jax
+
+@jax.jit
+def train_step(accelerator, x):
+    if x.sum() > 0:
+        x = accelerator.gather(x)
+    return x
+"""
+        findings = lint_source(src, "eager_gather.py")
+        assert "TPU011" in {f.rule for f in findings}
+
+
+class TestSuppression:
+    POSITIVE = CORPUS["TPU001"][0]
+
+    def test_inline_suppression(self):
+        src = self.POSITIVE.replace(
+            "v = loss.item()", "v = loss.item()  # tpu-lint: ignore[TPU001] — test"
+        )
+        assert lint_source(src, "s.py") == []
+
+    def test_line_above_suppression(self):
+        src = self.POSITIVE.replace(
+            "    v = loss.item()",
+            "    # tpu-lint: ignore[TPU001] — reason\n    v = loss.item()",
+        )
+        assert lint_source(src, "s.py") == []
+
+    def test_skip_file(self):
+        src = "# tpu-lint: skip-file\n" + self.POSITIVE
+        assert lint_source(src, "s.py") == []
+
+    def test_wrong_id_does_not_suppress(self):
+        src = self.POSITIVE.replace(
+            "v = loss.item()", "v = loss.item()  # tpu-lint: ignore[TPU005]"
+        )
+        assert {f.rule for f in lint_source(src, "s.py")} == {"TPU001"}
+
+    def test_select_ignore(self):
+        findings = lint_source(self.POSITIVE, "s.py", select={"TPU005"})
+        assert findings == []
+        findings = lint_source(self.POSITIVE, "s.py", ignore={"TPU001"})
+        assert findings == []
+
+    def test_normalize_rule_ids(self):
+        assert normalize_rule_ids("TPU001, tpu4") == {"TPU001", "TPU004"}
+        assert normalize_rule_ids(None) is None
+        with pytest.raises(ValueError):
+            normalize_rule_ids("TPU999")
+
+    def test_syntax_error_is_a_finding(self):
+        findings = lint_source("def broken(:\n", "bad.py")
+        assert [f.rule for f in findings] == ["TPU000"]
+        assert findings[0].severity == "error"
+
+
+class TestSelfApplication:
+    def test_examples_and_benchmarks_clean(self):
+        """The self-application gate `make lint` enforces: the shipped
+        examples/ + benchmarks/ tree has zero findings (true positives
+        fixed, intentional patterns suppressed with reasons)."""
+        findings, files = lint_paths(
+            [os.path.join(REPO, "examples"), os.path.join(REPO, "benchmarks")]
+        )
+        assert files > 20
+        assert findings == [], [(f.path, f.line, f.rule) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr/HLO analyzers
+# ---------------------------------------------------------------------------
+
+
+class TestDonationChecker:
+    def test_flags_non_donated_aliasable_input(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.analysis.compiled import donation_report
+
+        def step(params, grads):
+            return params - 0.1 * grads, (grads * grads).sum()
+
+        params = jnp.ones((64, 64), jnp.float32)
+        grads = jnp.ones((64, 64), jnp.float32)
+        report = donation_report(step, (params, grads), donate_argnums=(), label="t")
+        # new_params matches BOTH inputs' aval but only one output slot
+        # exists, so exactly one candidate is excused by it
+        assert report["wasted_bytes"] == 64 * 64 * 4
+        assert len(report["candidates"]) == 1
+        assert report["candidates"][0]["arg"].startswith("args[0]")
+
+    def test_donated_input_consumes_the_match(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.analysis.compiled import donation_report
+
+        def step(params, grads):
+            return params - 0.1 * grads, (grads * grads).sum()
+
+        params = jnp.ones((8, 8), jnp.float32)
+        grads = jnp.ones((8, 8), jnp.float32)
+        report = donation_report(step, (params, grads), donate_argnums=(0,), label="t")
+        assert report["wasted_bytes"] == 0
+        assert report["candidates"] == []
+
+    def test_no_match_no_report(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.analysis.compiled import donation_report
+
+        def fwd(x):
+            return x.sum()
+
+        report = donation_report(fwd, (jnp.ones((16,), jnp.float32),))
+        assert report["wasted_bytes"] == 0
+
+
+class TestRecompileFingerprinter:
+    def test_names_the_changed_argument(self):
+        from accelerate_tpu.analysis.compiled import (
+            RecompileFingerprinter,
+            format_signature_diff,
+            signature_entries,
+        )
+
+        fp = RecompileFingerprinter()
+        a16 = {"x": np.zeros((16,), np.float32), "y": np.zeros((4,), np.int32)}
+        a24 = {"x": np.zeros((24,), np.float32), "y": np.zeros((4,), np.int32)}
+        h1, diff1 = fp.note("step", signature_entries(a16))
+        assert diff1 is None
+        h2, diff2 = fp.note("step", signature_entries(a16))
+        assert h2 == h1 and diff2 is None  # exact repeat: no diff
+        h3, diff3 = fp.note("step", signature_entries(a24))
+        assert h3 != h1 and diff3 is not None
+        changed = {c["arg"] for c in diff3["changed"]}
+        assert any("'x'" in c for c in changed), changed
+        assert all("'y'" not in c for c in changed), changed
+        text = format_signature_diff(diff3)
+        assert "(16,):float32 -> (24,):float32" in text
+
+    def test_structure_change_reported(self):
+        from accelerate_tpu.analysis.compiled import (
+            RecompileFingerprinter,
+            signature_entries,
+        )
+
+        fp = RecompileFingerprinter()
+        fp.note("step", signature_entries({"x": np.zeros(3)}))
+        _, diff = fp.note(
+            "step", signature_entries({"x": np.zeros(3), "extra": np.zeros(1)})
+        )
+        assert diff is not None and any("extra" in p for p in diff["added"])
+
+
+class TestCollectiveDigest:
+    HLO_A = """
+  %ar = f32[128] all-reduce(f32[128] %p0), replica_groups={}
+  %ag = f32[256] all-gather(f32[128] %p1), dimensions={0}
+"""
+    HLO_B = """
+  %ag = f32[256] all-gather(f32[128] %p1), dimensions={0}
+  %ar = f32[128] all-reduce(f32[128] %p0), replica_groups={}
+"""
+
+    def test_same_text_same_digest(self):
+        from accelerate_tpu.analysis.compiled import collective_digest
+
+        d1, seq1 = collective_digest(self.HLO_A)
+        d2, seq2 = collective_digest(self.HLO_A)
+        assert d1 == d2 and seq1 == seq2
+        assert len(seq1) == 2 and seq1[0].startswith("all-reduce")
+
+    def test_reordered_collectives_change_digest(self):
+        from accelerate_tpu.analysis.compiled import collective_digest
+
+        da, _ = collective_digest(self.HLO_A)
+        db, _ = collective_digest(self.HLO_B)
+        assert da != db
+
+    def test_real_program_digest_is_stable(self):
+        """Same jitted program lowered twice -> identical digest; the
+        digest walks REAL compiled HLO, not just the fixture strings."""
+        import jax
+        import jax.numpy as jnp
+
+        from accelerate_tpu.analysis.compiled import collective_digest
+
+        def fn(x):
+            return (x * 2).sum()
+
+        x = jnp.ones((32,), jnp.float32)
+        t1 = jax.jit(fn).lower(x).compile().as_text()
+        t2 = jax.jit(fn).lower(x).compile().as_text()
+        assert collective_digest(t1)[0] == collective_digest(t2)[0]
+
+    def test_host_digest_files_round_trip_and_diff(self, tmp_path):
+        from accelerate_tpu.analysis.compiled import (
+            diff_host_digests,
+            read_host_digests,
+            write_host_digest,
+        )
+
+        d = str(tmp_path)
+        write_host_digest(d, 0, "fused_step", "aaaa", ["all-reduce f32[4]"])
+        write_host_digest(d, 1, "fused_step", "bbbb", ["all-gather f32[4]"])
+        write_host_digest(d, 2, "fused_step", "aaaa", ["all-reduce f32[4]"])
+        write_host_digest(d, 0, "forward", "cccc", [])
+        digests = read_host_digests(d)
+        assert set(digests) == {0, 1, 2}
+        diffs = diff_host_digests(digests)
+        assert len(diffs) == 1
+        assert diffs[0]["label"] == "fused_step"
+        assert diffs[0]["divergent_hosts"] == [1]  # minority named
+        assert diffs[0]["tie"] is False
+
+    def test_two_host_split_is_a_tie_not_a_minority(self):
+        """With exactly 2 hosts disagreeing 1-1 there is no majority to
+        presume correct — both hosts are named rather than arbitrarily
+        blaming whichever digest iterates second."""
+        from accelerate_tpu.analysis.compiled import diff_host_digests
+
+        digests = {
+            0: {"fused_step": {"digest": "aaaa"}},
+            1: {"fused_step": {"digest": "bbbb"}},
+        }
+        diffs = diff_host_digests(digests)
+        assert len(diffs) == 1
+        assert diffs[0]["tie"] is True
+        assert diffs[0]["divergent_hosts"] == [0, 1]
+
+    def test_monitor_surfaces_divergence(self, tmp_path):
+        from accelerate_tpu.analysis.compiled import write_host_digest
+        from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+
+        d = str(tmp_path)
+        write_host_digest(d, 0, "fused_step", "aaaa", [])
+        write_host_digest(d, 1, "fused_step", "bbbb", [])
+        status = collect_status(d)
+        assert status["collective_divergence"]
+        rendered = render_status(status)
+        assert "COLLECTIVE ORDER DIVERGES" in rendered
+        # 2 hosts split 1-1: no majority exists, so the report says so
+        # instead of arbitrarily blaming one host
+        assert "no majority" in rendered
+        assert "hosts 0, 1" in rendered
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizerRuntime:
+    def test_shape_unstable_loop_names_the_argument(self, tmp_path):
+        """The acceptance scenario: a deliberately shape-unstable toy loop
+        under Accelerator(sanitize=True) produces a stderr/telemetry
+        report NAMING the offending argument."""
+        import optax
+
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.test_utils import RegressionModel
+
+        acc = Accelerator(project_dir=str(tmp_path), telemetry=True, sanitize=True)
+        stream = io.StringIO()
+        acc.sanitizer._stream = stream
+        model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+        try:
+            for n in (16, 16, 24):
+                x = np.linspace(-1, 1, n).astype(np.float32)
+                out = model(x=x, y=(2 * x + 3).astype(np.float32))
+                acc.backward(out.loss)
+                opt.step()
+                opt.zero_grad()
+            assert acc.sanitizer.counts["retrace"] == 1
+            text = stream.getvalue()
+            assert "re-traced" in text
+            assert "'inputs'" in text and "(16,):float32 -> (24,):float32" in text
+            # the compile record in the telemetry trail carries the diff too
+            records = [
+                json.loads(line) for line in open(acc.telemetry.jsonl_path)
+            ]
+            compiles = [r for r in records if r["type"] == "compile"]
+            assert any(r.get("changed_args") for r in compiles)
+            events = [
+                r for r in records
+                if r["type"] == "event" and r["kind"] == "sanitizer_retrace"
+            ]
+            assert events and "'inputs'" in events[0]["message"]
+            # per-host collective digest file written
+            from accelerate_tpu.analysis.compiled import read_host_digests
+
+            assert 0 in read_host_digests(acc.logging_dir)
+        finally:
+            acc.end_training()
+
+    def test_nan_loss_probe(self, tmp_path):
+        import optax
+
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.test_utils import RegressionModel
+
+        acc = Accelerator(project_dir=str(tmp_path), sanitize=True)
+        stream = io.StringIO()
+        acc.sanitizer._stream = stream
+        model, opt = acc.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+        try:
+            x = np.array([np.nan] * 8, np.float32)
+            out = model(x=x, y=np.ones(8, np.float32))
+            acc.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            assert acc.sanitizer.counts["nonfinite_loss"] >= 1
+            assert "loss is nan" in stream.getvalue()
+        finally:
+            acc.end_training()
+
+    def test_disabled_path_is_one_global_read(self):
+        from accelerate_tpu.analysis.sanitizer import (
+            NULL_SANITIZER,
+            get_active_sanitizer,
+            set_active_sanitizer,
+        )
+
+        set_active_sanitizer(None)
+        assert get_active_sanitizer() is NULL_SANITIZER
+        assert not get_active_sanitizer()
+
+    def test_report_limit_caps_stderr(self):
+        from accelerate_tpu.analysis.sanitizer import Sanitizer
+
+        stream = io.StringIO()
+        san = Sanitizer(max_reports=2, stream=stream)
+        for i in range(5):
+            san._emit("retrace", f"r{i}")
+        printed = stream.getvalue().count("TPU-SANITIZER[retrace]")
+        assert printed == 3  # 2 reports + 1 "limit reached" line
+        assert san.counts["retrace"] == 5
+
+
+class TestEngineRetraceMessage:
+    def test_decode_retrace_names_argument_and_raises_under_sanitizer(self):
+        """Unit-level: the engine's one-executable watchdog composes the
+        fingerprint diff into the failure message (acceptance: 'the
+        serving engine's re-trace assertion failure message now includes
+        that fingerprint diff')."""
+        from accelerate_tpu.analysis.sanitizer import Sanitizer, set_active_sanitizer
+        from accelerate_tpu.serving.engine import InferenceEngine
+
+        engine = InferenceEngine.__new__(InferenceEngine)  # no model needed
+        engine._decode_traces = 1
+        engine._decode_traces_seen = 0
+        engine._decode_sig = None
+        engine.retrace_report = None
+        sig1 = (("block_tables", (8, 32), "int32"), ("toks", (8, 1), "int32"))
+        sig2 = (("block_tables", (8, 64), "int32"), ("toks", (8, 1), "int32"))
+        engine._check_one_executable(sig1)  # first trace: baseline
+        assert engine.retrace_report is None
+        engine._decode_traces = 2  # a second trace happened
+        try:
+            set_active_sanitizer(Sanitizer(stream=io.StringIO()))
+            with pytest.raises(RuntimeError) as err:
+                engine._check_one_executable(sig2)
+        finally:
+            set_active_sanitizer(None)
+        message = str(err.value)
+        assert "re-traced" in message
+        assert "block_tables" in message
+        assert "(8, 32):int32 -> (8, 64):int32" in message
+        assert engine.retrace_report == message
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestLintCLI:
+    def _run(self, args, cwd=None):
+        return subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "lint", *args],
+            capture_output=True, text=True, cwd=cwd or REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240,
+        )
+
+    def test_json_exit_2_on_errors(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(CORPUS["TPU001"][0])
+        proc = self._run(["--json", str(bad)])
+        assert proc.returncode == 2, proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["errors"] == 1
+        assert payload["findings"][0]["rule"] == "TPU001"
+        assert payload["findings"][0]["severity"] == "error"
+
+    def test_exit_0_on_clean_and_warning_only(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text(CORPUS["TPU001"][1])
+        assert self._run([str(clean)]).returncode == 0
+        warn = tmp_path / "warn.py"
+        warn.write_text(CORPUS["TPU008"][0])  # TPU008 is warning severity
+        proc = self._run(["--json", str(warn)])
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout)["warnings"] == 1
+
+    def test_exit_1_on_missing_path(self):
+        assert self._run(["/nonexistent/path.py"]).returncode == 1
+
+    def test_select_filters(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(CORPUS["TPU001"][0])
+        proc = self._run(["--json", "--select", "TPU005", str(bad)])
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout)["findings"] == []

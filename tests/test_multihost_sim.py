@@ -46,6 +46,12 @@ class _FakeMultihost:
         return np.asarray(self.other.pop(0))
 
 
+#: the int32-word wire format the object/byte broadcasts use — the tests
+#: build expected wire payloads with the SAME helper the product uses so
+#: the format stays single-source
+_as_words = ops.pack_words
+
+
 def test_gather_object_pads_and_unpacks_uneven_payloads(two_process_state):
     import pickle
 
@@ -70,11 +76,42 @@ def test_broadcast_object_list_receiver_side(two_process_state):
     two_process_state.process_index = 1  # not the source
     source_obj = [{"weights": [1, 2, 3]}, "tag"]
     payload = np.frombuffer(pickle.dumps(source_obj), dtype=np.uint8)
-    fake = _FakeMultihost([np.array([payload.size], np.int64), payload])
+    fake = _FakeMultihost([np.array([payload.size], np.int64), _as_words(payload)])
     with mock.patch("jax.experimental.multihost_utils", fake):
         received = [None]
         ops.broadcast_object_list(received)
     assert received == source_obj
+
+
+def test_broadcast_ships_non_4byte_dtypes_as_words(two_process_state):
+    """Raw-tensor broadcast of int64/uint8 leaves rides the int32-word
+    wire (gloo sub-4-byte corruption / x64 truncation — same fix as the
+    dispatcher's _send_tensor); f32 leaves take the direct path."""
+    two_process_state.process_index = 1  # receiver
+    src_i64 = np.array([2**40 + 7, -3], np.int64)
+    src_u8 = np.arange(5, dtype=np.uint8)
+    src_f32 = np.array([1.5, -2.5], np.float32)
+    fake = _FakeMultihost(
+        [_as_words(src_i64.tobytes()), _as_words(src_u8.tobytes()), src_f32]
+    )
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        out_i64 = ops.broadcast(np.zeros(2, np.int64))
+        out_u8 = ops.broadcast(np.zeros(5, np.uint8))
+        out_f32 = ops.broadcast(np.zeros(2, np.float32))
+    np.testing.assert_array_equal(out_i64, src_i64)
+    assert out_i64.dtype == np.int64
+    out_i64[0] = 1  # receivers get a WRITABLE copy, not a frombuffer view
+    np.testing.assert_array_equal(out_u8, src_u8)
+    np.testing.assert_array_equal(out_f32, src_f32)
+
+
+def test_broadcast_source_side_word_wire_round_trips(two_process_state):
+    src = np.array([[2**40, 1], [-1, 2**33]], np.int64)
+    fake = _FakeMultihost([])  # source side never pops
+    with mock.patch("jax.experimental.multihost_utils", fake):
+        out = ops.broadcast(src)
+    np.testing.assert_array_equal(out, src)
+    assert out.dtype == np.int64 and out.shape == (2, 2)
 
 
 def test_verify_operation_raises_on_shape_mismatch(two_process_state):
@@ -254,7 +291,7 @@ def test_dispatcher_receiver_reconstructs_batches(two_process_state):
 
     def obj_payload(obj):
         payload = np.frombuffer(pickle.dumps([obj]), dtype=np.uint8)
-        return [np.array([payload.size], np.int64), payload]
+        return [np.array([payload.size], np.int64), _as_words(payload)]
 
     fake = _FakeMultihost(
         [np.array([2], np.int64), *obj_payload(desc0), x0]  # batch 0: new struct
@@ -288,9 +325,10 @@ def test_dispatcher_wide_dtypes_survive_exactly(two_process_state):
     desc = (treedef, ((big.shape, big.dtype.str, False),))
 
     payload = np.frombuffer(pickle.dumps([desc]), dtype=np.uint8)
-    wire_bytes = np.frombuffer(big.tobytes(), np.uint8)
+    wire_words = _as_words(np.frombuffer(big.tobytes(), np.uint8))
     fake = _FakeMultihost(
-        [np.array([2], np.int64), np.array([payload.size], np.int64), payload, wire_bytes]
+        [np.array([2], np.int64), np.array([payload.size], np.int64),
+         _as_words(payload), wire_words]
         + [np.array([0], np.int64)]
     )
     dl = _dispatcher([])
