@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -37,3 +37,6 @@ lint:             ## self-application gate: examples/ + benchmarks/ must lint cl
 
 lint-smoke:       ## seeded-bad script trips the CLI (exit 2), clean tree passes, ACCELERATE_SANITIZE=1 names a retraced argument
 	python benchmarks/lint_smoke.py
+
+route-smoke:      ## 2-replica router fleet, mixed sticky/free traffic, kill -9 one replica mid-run -> zero lost requests + clean drain
+	python benchmarks/route_smoke.py

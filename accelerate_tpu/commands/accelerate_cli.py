@@ -5,7 +5,10 @@ from __future__ import annotations
 import argparse
 import sys
 
-from . import config, env, estimate, launch, lint, merge, metrics, monitor, serve, test, tpu
+from . import (
+    config, env, estimate, launch, lint, merge, metrics, monitor, route, serve,
+    test, tpu,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -15,7 +18,7 @@ def main(argv: list[str] | None = None) -> int:
         allow_abbrev=False,
     )
     subparsers = parser.add_subparsers(dest="command")
-    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, serve, tpu):
+    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, route, serve, tpu):
         module.add_parser(subparsers)
 
     args = parser.parse_args(argv)

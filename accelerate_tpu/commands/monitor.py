@@ -25,10 +25,11 @@ def monitor_command(args) -> int:
 
     * ``0`` — healthy (or nothing to report yet)
     * ``1`` — usage error (``logging_dir`` is not a directory)
-    * ``2`` — a host is wedged, a ``HANG_REPORT`` exists, or the per-host
-      collective-sequence digests diverge (a pre-deadlock condition: the
-      sanitizer writes one digest file per host, and disagreement means a
-      cross-host collective will never match up)
+    * ``2`` — a host is wedged, a ``HANG_REPORT`` exists, a serving-fleet
+      replica is dead or its router rows went stale mid-run, or the
+      per-host collective-sequence digests diverge (a pre-deadlock
+      condition: the sanitizer writes one digest file per host, and
+      disagreement means a cross-host collective will never match up)
     * ``3`` — an ``ACCELERATE_SLO_*`` alert rule is firing (``ALERTS.json``
       written next to the run's artifacts; wedged/hang wins when both hold)
     """
@@ -65,6 +66,7 @@ def monitor_command(args) -> int:
                     status["wedged"]
                     or status["hang_reports"]
                     or status.get("collective_divergence")
+                    or status.get("fleet_dead")
                 ):
                     return 2
                 return EXIT_SLO_VIOLATION if firing else 0

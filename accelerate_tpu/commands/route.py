@@ -1,0 +1,213 @@
+"""``accelerate-tpu route`` — N engine replicas behind a health-checked
+load balancer.
+
+Spawns ``--replicas N`` serve processes (or ``--attach``\\ es to running
+ones), waits for every ``/healthz`` to report ``ready``, then reads the
+same JSONL request protocol as ``accelerate-tpu serve`` from stdin —
+plus an optional ``"session_id"`` field for sticky placement — and writes
+one JSON result line per request. Requests on a replica that dies
+mid-stream are requeued to a surviving replica; the caller still gets
+exactly one answer per request.
+
+SIGTERM drains: admission stops (late submissions are *answered* with an
+error row, never dropped), in-flight requests finish, every spawned
+replica is SIGTERM'd in turn (the serve front end's own drain path), and
+the router exits 0. This is the resilience preemption contract
+(``resilience/preemption.py``) applied to serving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+
+#: serve flags forwarded verbatim to every replica (the fleet must be
+#: shape-identical for dispatch to treat replicas as interchangeable)
+_ENGINE_FLAGS = (
+    ("--preset", "preset"), ("--dtype", "dtype"), ("--num-slots", "num_slots"),
+    ("--block-size", "block_size"), ("--max-seq-len", "max_seq_len"),
+    ("--prefill-chunk", "prefill_chunk"), ("--decode-burst", "decode_burst"),
+    ("--max-new-tokens", "max_new_tokens"), ("--eos-token-id", "eos_token_id"),
+    ("--temperature", "temperature"), ("--seed", "seed"),
+)
+
+
+def _serve_args(args) -> list[str]:
+    tail: list[str] = []
+    for flag, attr in _ENGINE_FLAGS:
+        value = getattr(args, attr)
+        if value is not None:
+            tail += [flag, str(value)]
+    if getattr(args, "mesh", False):
+        tail.append("--mesh")
+    return tail
+
+
+def route_command(args) -> int:
+    from ..resilience.preemption import PreemptionHandler
+    from ..serving.replica import ReplicaHandle, spawn_replica, wait_until_ready
+    from ..serving.router import Router
+
+    if args.logging_dir:
+        os.makedirs(args.logging_dir, exist_ok=True)
+
+    replicas = []
+    if args.attach:
+        for i, url in enumerate(x for x in args.attach.split(",") if x):
+            replicas.append(ReplicaHandle(i, url))
+    else:
+        for i in range(args.replicas):
+            serve_tail = _serve_args(args)
+            if args.logging_dir:
+                # one telemetry trail per replica — two processes appending
+                # the same telemetry.jsonl would interleave torn rows
+                serve_tail += ["--logging-dir",
+                               os.path.join(args.logging_dir, f"replica_{i}")]
+            replicas.append(spawn_replica(i, serve_tail, stderr=sys.stderr))
+    print(
+        f"route: waiting for {len(replicas)} replica(s) to report ready...",
+        file=sys.stderr,
+    )
+    router = Router(
+        replicas,
+        logging_dir=args.logging_dir,
+        health_interval=args.health_interval,
+        request_timeout=args.request_timeout,
+    )
+    try:
+        wait_until_ready(replicas, timeout=args.ready_timeout)
+    except Exception as e:
+        print(f"route: bring-up failed: {e}", file=sys.stderr)
+        router.close()
+        return 1
+    print(
+        "route: fleet ready — "
+        + "  ".join(f"replica {r.replica_id} @ {r.base_url} (pid {r.pid})"
+                    for r in replicas),
+        file=sys.stderr,
+    )
+
+    # SIGTERM → drain (stop admission, answer in-flight, clean exit 0);
+    # the handler only raises a flag — the loop below observes it between
+    # submissions, exactly like the training loop observes it between steps
+    handler = PreemptionHandler(handle_sigint=True)
+    handler.install()
+
+    out_lock = threading.Lock()
+
+    def emit(result):
+        with out_lock:
+            print(json.dumps(result), flush=True)
+
+    inbox: queue.Queue = queue.Queue()
+    eof = threading.Event()
+
+    def read_stdin():
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError as e:
+                emit({"error": f"bad JSON: {e}"})
+                continue
+            inbox.put(payload)
+        eof.set()
+
+    threading.Thread(target=read_stdin, daemon=True).start()
+
+    drain_reason = "eof"
+    try:
+        while True:
+            if handler.preemption_requested:
+                drain_reason = handler.reason or "signal"
+                # grace sweep: lines that were in the pipe before the signal
+                # are in-flight work, not late arrivals — give the reader a
+                # beat to surface them, then stop admission (anything later
+                # still gets answered via submit()'s draining error row)
+                grace_end = time.monotonic() + 1.0
+                while time.monotonic() < grace_end:
+                    try:
+                        router.submit(inbox.get(timeout=0.1), callback=emit)
+                    except queue.Empty:
+                        continue
+                router.stop_admission()
+                while not inbox.empty():
+                    router.submit(inbox.get_nowait(), callback=emit)
+                break
+            try:
+                payload = inbox.get(timeout=0.1)
+            except queue.Empty:
+                if eof.is_set() and inbox.empty():
+                    break
+                continue
+            router.submit(payload, callback=emit)
+    finally:
+        handler.uninstall()
+
+    print(f"route: draining ({drain_reason})...", file=sys.stderr)
+    clean = router.drain(timeout=args.drain_timeout)
+    # lines that arrived while drain() ran still get an answer (an
+    # admission-stopped error row), never silence; a short quiet window
+    # catches a producer mid-write before the process exits
+    grace_end = time.monotonic() + 1.0
+    while time.monotonic() < grace_end and not eof.is_set():
+        try:
+            router.submit(inbox.get(timeout=0.1), callback=emit)
+        except queue.Empty:
+            continue
+    while not inbox.empty():
+        router.submit(inbox.get_nowait(), callback=emit)
+    stats = router.stats()
+    print(
+        f"route: delivered {stats['delivered']} "
+        f"({stats['tokens']} tokens, {stats['requeues']} requeues, "
+        f"{stats['rejected']} rejected, {stats['dead']} dead replica(s))",
+        file=sys.stderr,
+    )
+    return 0 if clean else 1
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "route",
+        help="Load-balance JSONL requests over N health-checked engine replicas",
+    )
+    p.add_argument("--replicas", type=int, default=2,
+                   help="engine replica processes to spawn")
+    p.add_argument("--attach", default=None, metavar="URL[,URL...]",
+                   help="route to already-running serve endpoints instead of spawning")
+    p.add_argument("--logging-dir", default=None,
+                   help="fleet health JSONL (router/replicas.jsonl) + per-replica "
+                   "telemetry land here; `accelerate-tpu monitor` shows the fleet")
+    p.add_argument("--health-interval", type=float, default=0.5,
+                   help="seconds between /healthz sweeps")
+    p.add_argument("--ready-timeout", type=float, default=300.0,
+                   help="seconds to wait for the fleet to report ready")
+    p.add_argument("--drain-timeout", type=float, default=300.0,
+                   help="seconds to wait for in-flight requests + replica exits")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="per-dispatch HTTP timeout (default: wait forever)")
+    # engine shape passthrough (matches `serve`)
+    p.add_argument("--preset", choices=("tiny", "flagship"), default="tiny")
+    p.add_argument("--dtype", choices=("f32", "bf16"), default="f32")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--prefill-chunk", type=int, default=32)
+    p.add_argument("--decode-burst", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--eos-token-id", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mesh", action="store_true",
+                   help="each replica shards its engine over the attached mesh "
+                   "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")
+    p.set_defaults(func=route_command)
+    return p

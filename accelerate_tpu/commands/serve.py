@@ -13,15 +13,83 @@ thread-safe inbox the loop drains between iterations, so network/pipe
 latency never stalls decode. ``--logging-dir`` turns on telemetry so
 ``accelerate-tpu monitor <dir>`` shows live serving health (tokens/s,
 queue depth, slot occupancy, TTFT).
+
+Lifecycle (the router's dispatch + drain signals):
+
+* ``GET /healthz`` reports a real state machine — ``starting`` (engine
+  building/compiling), ``ready`` (loop serving), ``draining`` (SIGTERM
+  observed: admission stopped, in-flight finishing) — plus live
+  ``queue_depth``/``active_slots`` gauges;
+* SIGTERM reuses the resilience :class:`PreemptionHandler` flag: the loop
+  observes it between iterations, stops admission (late requests get an
+  error *answer*, never silence), drains everything already admitted, and
+  exits 0. kill-proven in ``tests/test_router.py``.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
 import sys
 import threading
 import time
+
+#: seconds the drain loop waits for pipe-buffered stragglers after going
+#: idle — lines written before the signal but not yet through the reader
+#: thread still deserve answers
+_DRAIN_IDLE_GRACE_S = 0.75
+
+
+class ServeHealth:
+    """The front end's lifecycle state machine: ``starting`` → ``ready`` →
+    ``draining``. Transitions are one-way; readers (the /healthz handler,
+    the stdin reader, the engine loop) only ever look at ``state``."""
+
+    def __init__(self, replica_id: int | None = None):
+        self.replica_id = replica_id
+        self._state = "starting"
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def ready(self) -> bool:
+        return self._state == "ready"
+
+    @property
+    def draining(self) -> bool:
+        return self._state == "draining"
+
+    def mark_ready(self) -> None:
+        with self._lock:
+            if self._state == "starting":
+                self._state = "ready"
+
+    def mark_draining(self) -> None:
+        with self._lock:
+            self._state = "draining"
+
+    def payload(self, engine=None) -> dict:
+        """The /healthz answer: state + the router's dispatch gauges."""
+        out = {
+            "state": self._state,
+            "pid": os.getpid(),
+            "replica_id": self.replica_id,
+            "queue_depth": None,
+            "active_slots": None,
+            "num_slots": None,
+        }
+        if engine is not None:
+            try:
+                out["queue_depth"] = int(engine.scheduler.queue_depth)
+                out["active_slots"] = len(engine.scheduler.active())
+                out["num_slots"] = int(engine.config.num_slots)
+            except Exception:
+                pass
+        return out
 
 
 def _build_model(args):
@@ -46,6 +114,11 @@ def _build_model(args):
 def _make_engine(args):
     from ..serving import EngineConfig, InferenceEngine
 
+    mesh = None
+    if getattr(args, "mesh", False):
+        from ..mesh import build_mesh
+
+        mesh = build_mesh()  # MeshPlugin reads ACCELERATE_MESH_* env vars
     model = _build_model(args)
     return InferenceEngine(
         model,
@@ -61,6 +134,7 @@ def _make_engine(args):
             seed=args.seed,
             max_new_tokens=args.max_new_tokens,
         ),
+        mesh=mesh,
     )
 
 
@@ -74,11 +148,16 @@ def _result_dict(req, req_id) -> dict:
     }
 
 
-def _engine_loop(engine, inbox, emit, stop):
+def _engine_loop(engine, inbox, emit, stop, health=None, handler=None):
     """Drain inbox → step → deliver completion dicts; idle-sleep when empty
     so a quiet server doesn't spin a core. A malformed or over-budget
     request is answered with an ``{"error": ...}`` result — it must never
-    kill the loop out from under the other in-flight requests."""
+    kill the loop out from under the other in-flight requests.
+
+    Exit conditions: ``stop`` (stdin EOF / server teardown) with nothing
+    left in flight, or a drain (SIGTERM → ``health.draining``) once the
+    engine has been idle for a short grace window — stragglers already in
+    the pipe still get answered."""
     pending = {}  # engine request_id -> (user id, per-request callback)
 
     def deliver(result, cb):
@@ -86,7 +165,15 @@ def _engine_loop(engine, inbox, emit, stop):
         if cb is not None:
             cb(result)
 
-    while not stop.is_set() or engine.scheduler.has_work() or not inbox.empty():
+    idle_since = None
+    while True:
+        if (
+            handler is not None
+            and handler.preemption_requested
+            and health is not None
+            and not health.draining
+        ):
+            health.mark_draining()
         try:
             while True:
                 payload, cb = inbox.get_nowait()
@@ -102,9 +189,19 @@ def _engine_loop(engine, inbox, emit, stop):
         except queue.Empty:
             pass
         if engine.scheduler.has_work():
+            idle_since = None
             for req in engine.step():
                 req_id, cb = pending.pop(req.request_id, (None, None))
                 deliver(_result_dict(req, req_id), cb)
+            continue
+        if stop.is_set() and inbox.empty():
+            return  # EOF/teardown: the pipe is closed, nothing more can arrive
+        if health is not None and health.draining:
+            if idle_since is None:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > _DRAIN_IDLE_GRACE_S:
+                return
+            time.sleep(0.01)
         else:
             time.sleep(0.005)
 
@@ -114,6 +211,7 @@ def serve_command(args) -> int:
     # and the /metrics scrape both publish through it — the vLLM-style
     # in-process exposition, vs the sidecar for embedded-serverless training
     from ..metrics.registry import MetricsRegistry, set_active_registry
+    from ..resilience.preemption import PreemptionHandler
 
     set_active_registry(MetricsRegistry())
     if args.logging_dir:
@@ -121,7 +219,13 @@ def serve_command(args) -> int:
 
         set_active_recorder(TelemetryRecorder(logging_dir=args.logging_dir))
 
-    engine = _make_engine(args)
+    health = ServeHealth(replica_id=args.replica_id)
+    # SIGTERM = drain request (the preemption contract): flag only; the
+    # engine loop observes it between iterations. Ctrl-C keeps its
+    # KeyboardInterrupt fast path below.
+    handler = PreemptionHandler(handle_sigint=False)
+    handler.install()
+
     inbox: queue.Queue = queue.Queue()
     stop = threading.Event()
     out_lock = threading.Lock()
@@ -130,51 +234,77 @@ def serve_command(args) -> int:
         with out_lock:
             print(json.dumps(result), flush=True)
 
-    if args.http:
-        return _serve_http(engine, inbox, stop, args.http)
-
-    # stdin/JSONL mode: a reader thread feeds the inbox; EOF arms stop and
-    # the loop drains what's in flight before exiting
-    def read_stdin():
-        for line in sys.stdin:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                payload = json.loads(line)
-            except json.JSONDecodeError as e:
-                with out_lock:
-                    print(json.dumps({"error": f"bad JSON: {e}"}), flush=True)
-                continue
-            inbox.put((payload, None))
-        stop.set()
-
-    threading.Thread(target=read_stdin, daemon=True).start()
     try:
-        _engine_loop(engine, inbox, emit, stop)
-    except KeyboardInterrupt:
-        pass
-    stats = engine.stats()
-    print(
-        f"served {stats['completed']} requests, "
-        f"{stats['tokens_emitted']} tokens "
-        f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s), "
-        f"decode compiles {stats['decode_compiles']}",
-        file=sys.stderr,
-    )
-    return 0
+        if args.http:
+            # factory form: the server binds FIRST (so /healthz answers
+            # `starting` while the engine builds/compiles), then the engine
+            # comes up and the state flips to `ready`
+            return _serve_http(lambda: _make_engine(args), inbox, stop,
+                               args.http, health=health, handler=handler)
+
+        engine = _make_engine(args)
+        # stdin/JSONL mode: a reader thread feeds the inbox; EOF arms stop
+        # and the loop drains what's in flight before exiting. Once
+        # draining, admission stops — late lines are answered, not queued.
+        health.mark_ready()
+
+        def read_stdin():
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError as e:
+                    with out_lock:
+                        print(json.dumps({"error": f"bad JSON: {e}"}), flush=True)
+                    continue
+                if health.draining:
+                    req_id = payload.get("id") if isinstance(payload, dict) else None
+                    emit({"id": req_id, "error": "draining: admission stopped"})
+                    continue
+                inbox.put((payload, None))
+            stop.set()
+
+        threading.Thread(target=read_stdin, daemon=True).start()
+        try:
+            _engine_loop(engine, inbox, emit, stop, health=health, handler=handler)
+        except KeyboardInterrupt:
+            pass
+        stats = engine.stats()
+        drained = " (drained on SIGTERM)" if health.draining else ""
+        print(
+            f"served {stats['completed']} requests, "
+            f"{stats['tokens_emitted']} tokens "
+            f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s), "
+            f"decode compiles {stats['decode_compiles']}{drained}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        handler.uninstall()
 
 
-def _serve_http(engine, inbox, stop, port) -> int:
+def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
     """Minimal local HTTP front end: POST /generate blocks until the
-    request completes (400 on a rejected one); GET /stats returns engine
-    health JSON; GET /metrics answers OpenMetrics text from the active
-    registry (refreshed from ``engine.stats()`` on each scrape)."""
+    request completes (400 on a rejected one, 503 while starting or
+    draining); GET /healthz answers the lifecycle state machine +
+    queue/slot gauges; GET /stats returns engine health JSON; GET /metrics
+    answers OpenMetrics text from the active registry (refreshed from
+    ``engine.stats()`` on each scrape).
+
+    ``engine`` may be a ready instance or a zero-arg factory — with a
+    factory the server binds and answers ``/healthz`` as ``starting``
+    *while* the engine builds, which is what the router's bring-up
+    health-checks observe."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from ..metrics.ingest import observe_engine_stats
     from ..metrics.openmetrics import CONTENT_TYPE, render_openmetrics
     from ..metrics.registry import get_active_registry
+
+    health = health or ServeHealth()
+    box = {"engine": None if callable(engine) else engine}
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -190,9 +320,9 @@ def _serve_http(engine, inbox, stop, port) -> int:
 
         def _send_metrics(self):
             registry = get_active_registry()
-            if registry:
+            if registry and box["engine"] is not None:
                 try:
-                    observe_engine_stats(registry, engine.stats())
+                    observe_engine_stats(registry, box["engine"].stats())
                 except Exception:
                     pass
             body = render_openmetrics(registry).encode()
@@ -207,14 +337,24 @@ def _serve_http(engine, inbox, stop, port) -> int:
             path = self.path.split("?")[0].rstrip("/")
             if path == "/metrics":
                 self._send_metrics()
+            elif path == "/healthz":
+                self._send(200, health.payload(box["engine"]))
             elif path in ("", "/stats", "/health"):
-                self._send(200, engine.stats())
+                eng = box["engine"]
+                self._send(200, eng.stats() if eng is not None
+                           else {"state": health.state})
             else:
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
             if self.path.rstrip("/") != "/generate":
                 self._send(404, {"error": "unknown path"})
+                return
+            if not health.ready:
+                # starting or draining: an explicit answer, so the router
+                # (or any client) fails fast instead of queueing into a
+                # front end that will never serve it
+                self._send(503, {"error": f"not accepting requests: {health.state}"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -227,24 +367,33 @@ def _serve_http(engine, inbox, stop, port) -> int:
                 self._send(400, {"error": str(e)})
                 return
             done = threading.Event()
-            box: dict = {}
+            answer: dict = {}  # NOT `box` — that closure holds the engine
 
             def cb(result):
-                box["result"] = result
+                answer["result"] = result
                 done.set()
 
             inbox.put((payload, cb))
             done.wait()
-            result = box["result"]
+            result = answer["result"]
             self._send(400 if "error" in result else 200, result)
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    class Server(ThreadingHTTPServer):
+        # default request_queue_size=5: under router redispatch churn a LIVE
+        # replica would refuse connections, which reads as a transport death
+        request_queue_size = 128
+
+    server = Server(("127.0.0.1", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     print(f"serving on http://127.0.0.1:{port} "
-          f"(POST /generate, GET /stats, GET /metrics)",
+          f"(POST /generate, GET /healthz, GET /stats, GET /metrics)",
           file=sys.stderr)
+    if box["engine"] is None:
+        box["engine"] = engine()  # /healthz says `starting` during this build
+    health.mark_ready()
     try:
-        _engine_loop(engine, inbox, lambda *a: None, stop)
+        _engine_loop(box["engine"], inbox, lambda *a: None, stop,
+                     health=health, handler=handler)
     except KeyboardInterrupt:
         pass
     finally:
@@ -275,6 +424,12 @@ def add_parser(subparsers):
     p.add_argument("--temperature", type=float, default=None,
                    help="enable sampling at this temperature (default: greedy)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mesh", action="store_true",
+                   help="shard the engine over the attached mesh "
+                   "(ACCELERATE_MESH_* env vars declare the shape)")
+    p.add_argument("--replica-id", type=int, default=None,
+                   help="identity stamped on /healthz when running behind "
+                   "`accelerate-tpu route`")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
                    help="serve a local HTTP endpoint instead of stdin JSONL")
     p.add_argument("--logging-dir", default=None,

@@ -24,6 +24,13 @@ STALE_FLOOR_S = 30.0
 STALE_MULTIPLIER = 10.0
 #: a live host this many steps behind the front-runner is named a straggler
 STRAGGLER_LAG_STEPS = 10
+#: a serving replica whose newest router row is older than this (while the
+#: router ticks every ~0.5s) is wedged-or-dead; a `terminated` row is clean
+#: history and never ages into an alarm
+ROUTER_STALE_S = 15.0
+#: newest router-row schema this reader understands (rows stamped newer are
+#: skipped, like telemetry rows)
+ROUTER_SCHEMA_SUPPORTED = 1
 
 
 def _tail_jsonl(path: str, max_records: int = 500) -> list[dict]:
@@ -129,6 +136,8 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "wedged": [],
         "hang_reports": [],
         "collective_divergence": [],
+        "fleet": [],
+        "fleet_dead": [],
     }
 
     # -- telemetry tail ------------------------------------------------------
@@ -259,6 +268,36 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         except (OSError, json.JSONDecodeError):
             status["hang_reports"].append({"path": path})
 
+    # -- serving fleet (the router's per-replica JSONL trail) ----------------
+    fleet_trail = os.path.join(logging_dir, "router", "replicas.jsonl")
+    if os.path.exists(fleet_trail):
+        latest: dict[int, dict] = {}
+        for row in _tail_jsonl(fleet_trail, max_records=500):
+            schema = row.get("schema")
+            if isinstance(schema, int) and schema > ROUTER_SCHEMA_SUPPORTED:
+                status["skipped_unknown_schema"] += 1
+                continue
+            rid = row.get("replica_id")
+            if rid is not None:
+                latest[rid] = row  # rows are append-ordered: newest wins
+        for rid in sorted(latest):
+            row = dict(latest[rid])
+            row["row_age_s"] = (
+                max(0.0, now - float(row["ts"])) if row.get("ts") else None
+            )
+            state = row.get("state")
+            # dead = the router said so, or a live-state replica whose rows
+            # stopped (router crashed / box gone) — `terminated` is a clean
+            # shutdown and never alarms, however old the trail
+            row["dead"] = state == "dead" or (
+                state in ("starting", "ready", "draining")
+                and row["row_age_s"] is not None
+                and row["row_age_s"] > ROUTER_STALE_S
+            )
+            if row["dead"]:
+                status["fleet_dead"].append(rid)
+            status["fleet"].append(row)
+
     # -- collective-sequence digests (written per host by the sanitizer,
     # analysis/compiled.py): hosts whose compiled programs disagree on
     # collective order WILL deadlock at the first mismatched rendezvous —
@@ -308,6 +347,22 @@ def render_status(status: dict[str, Any]) -> str:
             f"p99 {_fmt(srv.get('ttft_p99_s'), '{:.2f}')}s)   "
             f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
         )
+    fleet = status.get("fleet")
+    if fleet:
+        lines.append(f"  fleet ({len(fleet)} replica(s)):")
+        for r in fleet:
+            slots = (
+                f"{r.get('active_slots')}/{r.get('num_slots')}"
+                if r.get("num_slots") else _fmt(r.get("active_slots"), "{}")
+            )
+            mark = "  [DEAD]" if r.get("dead") else ""
+            lines.append(
+                f"    replica {r.get('replica_id')}: {r.get('state')}  "
+                f"queue {_fmt(r.get('queue_depth'), '{}')}  "
+                f"slots {slots}  in-flight {_fmt(r.get('in_flight'), '{}')}  "
+                f"heartbeat {_fmt(r.get('heartbeat_age_s'), '{:.1f}')}s  "
+                f"last row {_fmt(r.get('row_age_s'), '{:.0f}')}s ago{mark}"
+            )
     goodput = status.get("goodput")
     if goodput:
         lost = goodput["lost_s_by_cause"]

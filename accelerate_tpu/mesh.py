@@ -93,6 +93,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mesh_axis_sizes(mesh: Mesh, trivial: bool = False) -> dict[str, int]:
+    """``{axis: size}`` for the mesh — by default only the non-trivial axes
+    (size > 1), the form telemetry/serving stats record so a reader sees
+    "fsdp=2, tp=2" instead of five 1s."""
+    return {
+        str(ax): int(n)
+        for ax, n in mesh.shape.items()
+        if trivial or int(n) > 1
+    }
+
+
 def batch_axis_size(mesh: Mesh, extra_axes: tuple[str, ...] = ("fsdp",)) -> int:
     """Number of ways the global batch is split (the 'dp world size')."""
     n = mesh.shape["dp"]

@@ -128,6 +128,7 @@ def _observe_serving(registry, record: dict) -> None:
         for field, name, help in (
             ("tokens_per_sec", "serving_tokens_per_second", "Engine token throughput (window)"),
             ("queue_depth", "serving_queue_depth", "Requests waiting for a slot"),
+            ("active_slots", "serving_active_slots", "Decode slots holding a live request"),
             ("slot_occupancy", "serving_slot_occupancy", "Fraction of decode slots busy"),
             ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
         ):
@@ -162,6 +163,7 @@ def observe_engine_stats(registry, stats: dict) -> None:
     engine's own counters, even between periodic telemetry rows."""
     for field, name, help in (
         ("queue_depth", "serving_queue_depth", "Requests waiting for a slot"),
+        ("active_slots", "serving_active_slots", "Decode slots holding a live request"),
         ("slot_occupancy_mean", "serving_slot_occupancy", "Fraction of decode slots busy"),
         ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
         ("tokens_per_sec", "serving_tokens_per_second", "Engine token throughput (window)"),

@@ -134,6 +134,19 @@ def shard_params(params: Any, shardings: Any):
     return jax.tree.map(lambda p, s: jax.device_put(p, s), params, shardings)
 
 
+def paged_kv_sharding(mesh: Mesh, num_kv_heads: int) -> NamedSharding:
+    """Sharding for the serving engine's block-paged KV pools
+    (``[layers, num_blocks, block_size, n_kv, head_dim]``): the kv-head dim
+    over ``tp`` — K/V are *produced* tp-sharded by the wk/wv projections
+    (see ``LLAMA_PARTITION_RULES``), so storing the pool the same way keeps
+    the block scatter/gather collective-free. Falls back to replicated when
+    ``tp`` doesn't divide the head count (GQA models with few kv heads)."""
+    tp = mesh.shape["tp"]
+    if tp > 1 and num_kv_heads % tp == 0:
+        return NamedSharding(mesh, P(None, None, None, "tp", None))
+    return NamedSharding(mesh, P())
+
+
 def opt_state_sharding_like(tx, params, param_shardings, mesh: Mesh):
     """Sharding tree for ``tx.init(params)``'s state: param-shaped leaves
     inherit the param's sharding (matched via optax's param-tree mirroring),

@@ -1,5 +1,13 @@
 """Continuous-batching serving engine (Orca-style slot scheduling over a
-vLLM-style block-paged KV cache) — see :mod:`.engine` for the design."""
+vLLM-style block-paged KV cache) — see :mod:`.engine` for the design —
+plus the pod-scale layer: mesh-sharded decode (``InferenceEngine(...,
+mesh=)``) and the multi-replica router (:mod:`.router` / :mod:`.replica`).
+
+The router side is jax-free on purpose: importing ``Router`` or
+``ReplicaHandle`` must work on a machine with no accelerator, so those
+names are NOT imported here eagerly — use
+``from accelerate_tpu.serving.router import Router``.
+"""
 
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .engine import EngineConfig, InferenceEngine

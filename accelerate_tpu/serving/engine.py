@@ -82,9 +82,17 @@ class InferenceEngine:
     (evict → admit → one prefill chunk → one decode step) and returns the
     requests that finished; ``run_until_idle()`` drains; ``stream()`` is a
     per-request generator. The model must declare ``supports_paged_kv``
-    (the block-table decode path in its apply fn)."""
+    (the block-table decode path in its apply fn).
 
-    def __init__(self, model, config: EngineConfig | None = None):
+    ``mesh=`` shards the ONE decode executable over the named mesh with
+    GSPMD ``NamedSharding`` rules (the same planner training uses): params
+    by the model's partition rules + FSDP policy, the paged block pool by
+    kv-head over ``tp``, scheduler state replicated. Host-side scheduling
+    is untouched — sharding is a placement decision, never a different
+    program, so greedy output stays token-identical to the single-device
+    engine and the one-executable contract keeps holding."""
+
+    def __init__(self, model, config: EngineConfig | None = None, mesh=None):
         self.config = cfg = config or EngineConfig()
         inner = getattr(model, "_model", None) or model
         if not getattr(inner, "supports_paged_kv", False):
@@ -122,6 +130,9 @@ class InferenceEngine:
         self._vp = jnp.zeros(shape, dtype)
         self._key = jax.random.PRNGKey(cfg.seed)
         self._temp = jnp.float32(cfg.temperature)
+        self.mesh = mesh
+        if mesh is not None:
+            self._place_on_mesh(inner)
 
         # host mirrors the compiled step reads every iteration
         self._block_tables = np.zeros((cfg.num_slots, self._mb), np.int32)
@@ -149,6 +160,39 @@ class InferenceEngine:
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fn = self._build_prefill_fn()
+
+    def _place_on_mesh(self, inner) -> None:
+        """GSPMD placement over ``self.mesh``: every device-side input to
+        the compiled step gets an explicit ``NamedSharding`` so the first
+        dispatch compiles the sharded program and every later dispatch
+        reuses it (donated pool buffers keep their sharding, so the
+        signature — avals + shardings — never drifts). Host mirrors
+        (block tables, positions, tokens) stay plain numpy: they are
+        uncommitted inputs GSPMD replicates for free."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharding import (
+            infer_param_sharding,
+            paged_kv_sharding,
+            shard_params,
+        )
+        from ..utils.dataclasses import FullyShardedDataParallelPlugin
+
+        mesh = self.mesh
+        rules = getattr(inner, "partition_rules", None)
+        shardings = infer_param_sharding(
+            self._params, mesh, FullyShardedDataParallelPlugin(), rules
+        )
+        self._params = shard_params(self._params, shardings)
+        pool_sharding = paged_kv_sharding(mesh, self._kp.shape[3])
+        self._kp = jax.device_put(self._kp, pool_sharding)
+        self._vp = jax.device_put(self._vp, pool_sharding)
+        # scheduler-adjacent scalars must live on the SAME device set as the
+        # sharded params — a single-device-committed leaf among mesh-committed
+        # ones is an incompatible-devices error at dispatch
+        rep = NamedSharding(mesh, PartitionSpec())
+        self._key = jax.device_put(self._key, rep)
+        self._temp = jax.device_put(self._temp, rep)
 
     # -- compiled programs ---------------------------------------------------
 
@@ -306,6 +350,8 @@ class InferenceEngine:
             "iterations": self._iterations,
             "completed": len(self._completed),
             "queue_depth": self.scheduler.queue_depth,
+            "active_slots": len(self.scheduler.active()),
+            "num_slots": self.config.num_slots,
             "tokens_emitted": self._tokens_emitted,
             "decode_compiles": self._decode_traces,
             "prefill_compiles": self._prefill_traces,
@@ -315,6 +361,10 @@ class InferenceEngine:
                 self._occupancy_sum / self._iterations if self._iterations else 0.0
             ),
         }
+        if self.mesh is not None:
+            from ..mesh import mesh_axis_sizes
+
+            out["mesh"] = mesh_axis_sizes(self.mesh)
         if self.retrace_report is not None:
             out["retrace_report"] = self.retrace_report
         if self._start_time is not None:
@@ -505,6 +555,7 @@ class InferenceEngine:
                 iteration=self._iterations,
                 tokens_per_sec=(window_tokens / window_s) if window_s > 0 else None,
                 queue_depth=self.scheduler.queue_depth,
+                active_slots=len(self.scheduler.active()),
                 slot_occupancy=self.scheduler.occupancy,
                 free_blocks=self.allocator.free_count,
                 decode_compiles=self._decode_traces,

@@ -1,0 +1,517 @@
+"""Multi-replica request router: least-loaded + session-affinity dispatch
+over health-checked engine replicas.
+
+The engine scales *up* by sharding its decode step over the mesh
+(:mod:`.engine` with ``mesh=``); it scales *out* by replication — N engine
+processes, each with its own compiled executable, behind this router. The
+router is deliberately model-blind and jax-free: it speaks the serve front
+end's HTTP protocol (``POST /generate``, ``GET /healthz``) and owns only
+placement, affinity, retry, and drain:
+
+* **least-loaded dispatch** — a request goes to the ``ready`` replica with
+  the fewest in-flight + queued + decoding requests;
+* **session affinity** — requests carrying a ``session_id`` stick to the
+  replica that served the session before, so a multi-turn chat lands where
+  its KV prefix is warm (the substrate ROADMAP item 2's prefix cache will
+  exploit); affinity is *advisory* — a dead replica's sessions move on;
+* **failure requeue** — a transport-level dispatch failure (the replica
+  was killed mid-stream) re-enqueues the request at the *front* of the
+  queue for a different replica; each request is delivered to its caller
+  exactly once, so a kill -9 loses and duplicates nothing;
+* **drain** — stop admission, let in-flight requests finish, then SIGTERM
+  every spawned replica (the serve front end's PreemptionHandler drain)
+  and wait for clean exits.
+
+Per-replica health is appended to ``<logging_dir>/router/replicas.jsonl``
+(one row per replica per health tick) — the fleet panel in
+``accelerate-tpu monitor`` reads only this file, so fleet health survives
+a dead router the same way training health survives a wedged host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..logging import get_logger
+from .replica import ReplicaError, ReplicaHandle
+
+logger = get_logger(__name__)
+
+#: subdirectory of logging_dir holding the router's fleet trail
+ROUTER_SUBDIR = "router"
+#: schema stamp on every fleet row (readers skip newer-than-known rows)
+ROUTER_SCHEMA = 1
+
+
+@dataclass(eq=False)  # identity semantics: tickets live in per-replica sets
+class Ticket:
+    """One request's lifetime inside the router. ``result`` is set exactly
+    once; ``done`` fires after delivery (and after ``callback`` ran)."""
+
+    payload: dict
+    callback: object = None
+    attempts: int = 0
+    result: dict | None = None
+    replica_id: int | None = None
+    delivered: bool = False
+    done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def session_id(self):
+        return self.payload.get("session_id") if isinstance(self.payload, dict) else None
+
+
+class Router:
+    """Dispatch loop + health loop over a fixed replica set.
+
+    Args:
+        replicas: :class:`~.replica.ReplicaHandle` list (spawned or attached).
+        logging_dir: when set, per-replica JSONL health rows land under
+            ``<logging_dir>/router/replicas.jsonl``.
+        health_interval: seconds between ``/healthz`` sweeps.
+        max_attempts: dispatch attempts per request before it is answered
+            with an error (default: one try per replica + 1 retry).
+        request_timeout: per-dispatch HTTP timeout (None = wait forever;
+            a killed replica resets the connection immediately either way).
+    """
+
+    def __init__(
+        self,
+        replicas: list[ReplicaHandle],
+        logging_dir: str | None = None,
+        health_interval: float = 0.5,
+        max_attempts: int | None = None,
+        request_timeout: float | None = None,
+    ):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.logging_dir = logging_dir
+        self.health_interval = float(health_interval)
+        self.max_attempts = max_attempts or len(replicas) + 2
+        self.request_timeout = request_timeout
+        self._queue: deque[Ticket] = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._sessions: dict = {}  # session_id -> replica_id
+        # tickets currently POSTed to each replica: _mark_dead requeues these
+        # (a wedged-but-alive replica never produces the transport error the
+        # normal requeue path waits for)
+        self._inflight: dict[int, set] = {}
+        self._draining = False
+        self._health_paused = False  # drain owns replica states once set
+        self._stopped = threading.Event()
+        self._outstanding = 0  # submitted, not yet delivered
+        self._delivered = 0
+        self._requeues = 0
+        self._rejected = 0
+        self._tokens = 0
+        self._trail = None
+        if logging_dir:
+            os.makedirs(os.path.join(logging_dir, ROUTER_SUBDIR), exist_ok=True)
+            self._trail = open(
+                os.path.join(logging_dir, ROUTER_SUBDIR, "replicas.jsonl"), "a"
+            )
+        self._threads = [
+            threading.Thread(target=self._dispatch_loop, name="router-dispatch", daemon=True),
+            threading.Thread(target=self._health_loop, name="router-health", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, payload: dict, callback=None) -> Ticket:
+        """Enqueue one request; returns its ticket. While draining, the
+        request is answered immediately with an error instead of being
+        silently dropped (the caller always gets exactly one answer)."""
+        ticket = Ticket(payload=payload, callback=callback)
+        rejected = None
+        with self._lock:
+            if self._draining or self._stopped.is_set():
+                self._rejected += 1
+                rejected = {
+                    "id": payload.get("id") if isinstance(payload, dict) else None,
+                    "error": "router is draining: admission stopped",
+                }
+            else:
+                self._outstanding += 1
+                self._queue.append(ticket)
+                self._work.notify()
+        if rejected is not None:  # deliver outside the lock
+            self._finish(ticket, rejected, count_delivered=False)
+        return ticket
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _pick_replica(self, ticket: Ticket) -> ReplicaHandle | None:
+        """Session affinity first, least-loaded ready replica otherwise.
+        Caller holds the lock."""
+        candidates = [r for r in self.replicas if r.is_dispatchable()]
+        if not candidates:
+            return None
+        sid = ticket.session_id
+        if sid is not None:
+            mapped = self._sessions.get(sid)
+            for r in candidates:
+                if r.replica_id == mapped:
+                    return r
+        chosen = min(candidates, key=lambda r: (r.load, r.replica_id))
+        if sid is not None:
+            self._sessions[sid] = chosen.replica_id
+            chosen.sessions.add(sid)
+        return chosen
+
+    def _dispatch_loop(self):
+        while not self._stopped.is_set():
+            failed: list[Ticket] = []
+            with self._lock:
+                while not self._queue and not self._stopped.is_set():
+                    self._work.wait(timeout=0.2)
+                if self._stopped.is_set():
+                    return
+                ticket = self._queue[0]
+                if ticket.delivered:
+                    # a rescued ticket whose wedged dispatch answered late:
+                    # already delivered, nothing left to do
+                    self._queue.popleft()
+                    continue
+                replica = self._pick_replica(ticket)
+                if replica is None:
+                    # A spawned replica's death is permanent; if the whole
+                    # fleet is spawned-and-gone, waiting would hang drain()
+                    # for its full timeout with the tickets never answered.
+                    # Attached replicas can come back, so a fleet with any
+                    # attached member keeps waiting.
+                    if all(
+                        r.process is not None and r.state in ("dead", "terminated")
+                        for r in self.replicas
+                    ):
+                        failed = list(self._queue)
+                        self._queue.clear()
+                else:
+                    self._queue.popleft()
+                    replica.in_flight += 1
+                    replica.dispatched += 1
+                    ticket.replica_id = replica.replica_id
+                    ticket.attempts += 1
+                    self._inflight.setdefault(replica.replica_id, set()).add(ticket)
+            if replica is None:
+                for t in failed:
+                    self._finish(t, {
+                        "id": t.payload.get("id") if isinstance(t.payload, dict) else None,
+                        "error": "every replica is dead: request cannot be served",
+                    })
+                time.sleep(0.05)
+                continue
+            threading.Thread(
+                target=self._dispatch_one, args=(ticket, replica),
+                name=f"router-req-{replica.replica_id}", daemon=True,
+            ).start()
+
+    def _dispatch_one(self, ticket: Ticket, replica: ReplicaHandle):
+        try:
+            result = replica.generate(ticket.payload, timeout=self.request_timeout)
+        except ReplicaError as e:
+            with self._lock:
+                replica.in_flight -= 1
+                replica.consecutive_failures += 1
+                # if _mark_dead already requeued this ticket (wedged-replica
+                # rescue), this dispatch's failure is old news — a second
+                # requeue would dispatch the request twice concurrently
+                rescued = ticket not in self._inflight.get(replica.replica_id, ())
+                self._inflight.get(replica.replica_id, set()).discard(ticket)
+                if not rescued:
+                    self._requeues += 1
+                stopped = self._stopped.is_set()
+            self._note_failure(replica)
+            if rescued:
+                return
+            if ticket.attempts >= self.max_attempts:
+                self._finish(ticket, {
+                    "id": ticket.payload.get("id") if isinstance(ticket.payload, dict) else None,
+                    "error": f"gave up after {ticket.attempts} dispatch attempts: {e}",
+                })
+            elif stopped:
+                # the dispatch loop is gone — a requeue would be silence;
+                # an error row is still exactly one answer
+                self._finish(ticket, {
+                    "id": ticket.payload.get("id") if isinstance(ticket.payload, dict) else None,
+                    "error": f"router stopped before the request could be retried: {e}",
+                })
+            else:
+                with self._lock:
+                    # front of the queue: a victim of a replica crash has
+                    # already waited its turn once
+                    self._queue.appendleft(ticket)
+                    self._work.notify()
+            return
+        with self._lock:
+            replica.in_flight -= 1
+            replica.completed += 1
+            self._inflight.get(replica.replica_id, set()).discard(ticket)
+        self._finish(ticket, result)
+
+    def _finish(self, ticket: Ticket, result: dict, count_delivered: bool = True):
+        """Deliver exactly once — a retry racing a late first answer must
+        not double-deliver."""
+        with self._lock:
+            if ticket.delivered:
+                return
+            ticket.delivered = True
+            ticket.result = result
+            if count_delivered:
+                self._delivered += 1
+                self._outstanding -= 1
+            # token accounting lives under the delivered guard: a late
+            # answer from a wedged replica must not double-count
+            if isinstance(result, dict) and isinstance(result.get("tokens"), list):
+                self._tokens += len(result["tokens"])
+        if ticket.callback is not None:
+            try:
+                ticket.callback(result)
+            except Exception:
+                logger.warning("router result callback raised", exc_info=True)
+        ticket.done.set()
+
+    # -- health --------------------------------------------------------------
+
+    def _note_failure(self, replica: ReplicaHandle):
+        """A dispatch failed at the transport level: if the process is gone
+        (or an attached replica stopped answering), mark it dead *now* so
+        the very next dispatch decision excludes it — waiting for the next
+        health tick would bounce the requeued request straight back."""
+        # 3s, not 1s: a dead replica refuses the connection instantly, so the
+        # timeout only bites a slow-but-alive one — where marking dead is wrong
+        if replica.process_exited() or replica.check_health(timeout=3.0) is None:
+            self._mark_dead(replica)
+
+    def _mark_dead(self, replica: ReplicaHandle):
+        with self._lock:
+            if replica.state == "dead":
+                return
+            replica.state = "dead"
+            for sid in replica.sessions:
+                if self._sessions.get(sid) == replica.replica_id:
+                    del self._sessions[sid]
+            replica.sessions.clear()
+            # rescue the requests POSTed to it: a killed replica errors the
+            # dispatch thread out on its own, but a wedged-alive one keeps
+            # the socket open forever — requeue now, and the late dispatch
+            # thread (which sees its ticket gone from _inflight) stands down.
+            # A late *answer* still wins if it lands first: _finish delivers
+            # exactly once either way.
+            stranded = self._inflight.get(replica.replica_id, set())
+            rescued = len(stranded)
+            for t in stranded:
+                self._queue.appendleft(t)
+                self._requeues += 1
+            stranded.clear()
+            if rescued:
+                self._work.notify()
+        logger.warning(
+            "replica %d (pid %s) is dead — %d in-flight request(s) requeued, "
+            "sessions released", replica.replica_id, replica.pid, rescued,
+        )
+        self._write_fleet_rows()
+
+    def _probe_one(self, replica: ReplicaHandle):
+        """One replica's health-tick logic (runs on its own probe thread —
+        a sweep must not serialize N probe timeouts, or the fleet trail
+        goes stale and monitor reads healthy replicas as dead)."""
+        r = replica
+        if self._health_paused or self._stopped.is_set():
+            return  # drain/close started mid-sweep: its exits are expected
+        if r.state in ("dead", "terminated"):
+            if r.process is None and r.check_health() is not None:
+                logger.info("attached replica %d is back", r.replica_id)
+            return
+        if r.process_exited():
+            if not self._health_paused:
+                self._mark_dead(r)
+        elif r.check_health(timeout=5.0) is None:
+            if r.state == "starting" and r.process is not None:
+                # bring-up: the HTTP server may not even be bound
+                # yet — connection-refused here is not death
+                # evidence (process_exited above is), and the
+                # bring-up deadline is wait_until_ready's job
+                return
+            # For a spawned replica the process is the authoritative
+            # liveness signal — missed probes there mean wedged, not
+            # dead, and a busy box starves /healthz long before the
+            # engine stops serving (tiny-shape decode holds the GIL),
+            # so give spawned replicas a much longer horizon before
+            # the irreversible mark. Attached replicas have no
+            # process to ask: three strikes is all the signal there is.
+            r.consecutive_failures += 1
+            strikes = 3 if r.process is None else 10
+            if r.consecutive_failures >= strikes and not self._health_paused:
+                self._mark_dead(r)
+
+    def _health_loop(self):
+        while not self._stopped.wait(self.health_interval):
+            if self._health_paused:
+                # drain is SIGTERM-ing replicas: their exits are *expected*
+                # and must land as `terminated`, not `dead`
+                continue
+            probes = [
+                threading.Thread(
+                    target=self._probe_one, args=(r,),
+                    name=f"router-probe-{r.replica_id}", daemon=True,
+                )
+                for r in self.replicas
+            ]
+            for t in probes:
+                t.start()
+            for t in probes:
+                t.join(timeout=6.0)
+            if not self._health_paused:
+                self._write_fleet_rows()
+
+    def _write_fleet_rows(self):
+        trail = self._trail  # local ref: _shutdown may null the attribute
+        if trail is None:
+            return
+        now = time.time()
+        with self._lock:
+            rows = [
+                {  # built under the lock; written after releasing it so a
+                   # slow disk never stalls admission/dispatch/delivery
+
+                    "schema": ROUTER_SCHEMA,
+                    "ts": now,
+                    "replica_id": r.replica_id,
+                    "state": r.state,
+                    "base_url": r.base_url,
+                    "pid": r.pid,
+                    "queue_depth": r.queue_depth,
+                    "active_slots": r.active_slots,
+                    "num_slots": r.num_slots,
+                    "in_flight": r.in_flight,
+                    "dispatched": r.dispatched,
+                    "completed": r.completed,
+                    "sessions": len(r.sessions),
+                    "heartbeat_age_s": (
+                        round(now - r.last_heartbeat, 3)
+                        if r.last_heartbeat is not None else None
+                    ),
+                }
+                for r in self.replicas
+            ]
+        try:
+            for row in rows:
+                trail.write(json.dumps(row) + "\n")
+            trail.flush()
+        except (OSError, ValueError):
+            pass
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    def stop_admission(self):
+        """Flip to draining: every later ``submit`` is answered with an
+        admission-stopped error instead of being queued."""
+        with self._lock:
+            self._draining = True
+
+    def wait_idle(self, timeout: float | None = None, poll: float = 0.05) -> bool:
+        """Block until every submitted request has been delivered."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._outstanding == 0 and not self._queue:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(poll)
+
+    def drain(self, timeout: float = 300.0) -> bool:
+        """Stop admission, answer everything in flight, then SIGTERM the
+        spawned replicas and wait for clean exits. Returns True when every
+        request was answered and every spawned replica exited."""
+        with self._lock:
+            self._draining = True
+        drained = self.wait_idle(timeout=timeout)
+        # From here the replicas' exits are intentional: freeze the health
+        # loop so a SIGTERM'd replica is recorded as `terminated`, not `dead`.
+        self._health_paused = True
+        for r in self.replicas:
+            if r.state not in ("dead", "terminated"):
+                r.state = "draining"
+        self._write_fleet_rows()
+        for r in self.replicas:
+            r.drain()
+        clean = True
+        deadline = time.monotonic() + timeout
+        for r in self.replicas:
+            if r.state == "dead":
+                continue
+            if r.process is None:
+                # attached replicas have no process to wait on, but this
+                # router session is over: a final `terminated` row keeps
+                # monitor from reading the last `draining` row as a death
+                r.state = "terminated"
+                continue
+            rc = r.wait(timeout=max(0.1, deadline - time.monotonic()))
+            if rc is None:
+                logger.warning("replica %d did not exit on SIGTERM; killing", r.replica_id)
+                r.kill()
+                r.wait(timeout=10.0)
+                clean = False
+            r.state = "terminated"
+        self._write_fleet_rows()
+        self._shutdown()
+        return drained and clean
+
+    def _shutdown(self):
+        self._stopped.set()
+        with self._lock:
+            self._work.notify_all()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=10.0)
+        if self._trail is not None:
+            try:
+                self._trail.close()
+            except OSError:
+                pass
+            self._trail = None
+
+    def close(self):
+        """Abrupt teardown (tests, error paths): kill what we spawned."""
+        self._stopped.set()
+        with self._lock:
+            self._work.notify_all()
+        for r in self.replicas:
+            r.kill()
+        self._shutdown()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": len(self.replicas),
+                "ready": sum(r.state == "ready" for r in self.replicas),
+                "dead": sum(r.state == "dead" for r in self.replicas),
+                "queue_depth": len(self._queue),
+                "outstanding": self._outstanding,
+                "delivered": self._delivered,
+                "requeues": self._requeues,
+                "rejected": self._rejected,
+                "tokens": self._tokens,
+                "sessions": len(self._sessions),
+                "per_replica": {
+                    r.replica_id: {
+                        "state": r.state,
+                        "dispatched": r.dispatched,
+                        "completed": r.completed,
+                        "in_flight": r.in_flight,
+                    }
+                    for r in self.replicas
+                },
+            }

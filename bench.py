@@ -545,6 +545,28 @@ def _mode_serve(platform: str) -> None:
     )
 
 
+def _mode_route(platform: str) -> None:
+    """Router scale-out row: 2-replica fleet vs 1-replica baseline on the
+    same mixed sticky/free trace, with a kill -9 of one replica mid-run
+    (benchmarks/route_smoke.py). Emits the goodput ratio and per-replica
+    occupancy only — never absolute wall-clock gates, per the timing-noise
+    rule."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.route_smoke import run as route_run
+
+    r = route_run(platform)
+    occ = r.get("occupancy_by_replica", {})
+    occ_flat = " ".join(
+        f"{rid} {occ[rid]:.4f}" for rid in sorted(occ)
+    )
+    print(
+        f"BENCH_ROUTE {r['fleet_tok_s']:.2f} {r['single_tok_s']:.2f} "
+        f"{r['route_goodput_ratio']:.4f} {r['requeues']} {occ_flat}"
+    )
+
+
 def _mode_spec(platform: str) -> None:
     """Speculative-decode row (VERDICT r5 #2): a 2-layer early-exit draft
     (the target's first two layers + its embeddings/norm/head — the
@@ -1244,6 +1266,36 @@ def main():
     except Exception:
         pass
     try:
+        rt = _run_subprocess("route", platform, attempts=2)
+        vals = rt["BENCH_ROUTE"]
+        fleet_tok, single_tok, ratio, requeues = vals[:4]
+        occ_pairs = vals[4:]
+        occupancy = {
+            int(float(occ_pairs[i])): round(float(occ_pairs[i + 1]), 4)
+            for i in range(0, len(occ_pairs) - 1, 2)
+        }
+        extra_rows.append(
+            {
+                "metric": "route_goodput_ratio",
+                "value": round(float(ratio), 4),
+                "unit": "ratio",
+                "fleet_tokens_per_sec": round(float(fleet_tok), 2),
+                "single_replica_tokens_per_sec": round(float(single_tok), 2),
+                "kill_requeues": int(float(requeues)),
+                "occupancy_by_replica": occupancy,
+                "note": "2-replica router fleet vs 1-replica baseline on "
+                "the same mixed sticky/free trace, with a kill -9 of one "
+                "replica mid-run survived with zero lost or duplicated "
+                "requests (benchmarks/route_smoke.py). Ratio + per-replica "
+                "slot occupancy only — never absolute wall-clock gates, "
+                "per the timing-noise rule; on CPU both legs are dispatch-"
+                "bound at tiny shapes, the credible ratio is a real "
+                "multi-chip host",
+            }
+        )
+    except Exception:
+        pass
+    try:
         sp = _run_subprocess("spec", platform, attempts=2)
         plain_tok, k4_tok, k4_acc, k8_tok, k8_acc = (float(v) for v in sp["BENCH_SPEC"])
         best_k, best_tok, best_acc = (4, k4_tok, k4_acc) if k4_tok >= k8_tok else (8, k8_tok, k8_acc)
@@ -1565,6 +1617,9 @@ def main():
             headline["serve_legs_tok_s"] = (
                 row.get("engine_legs_tok_s", []) + row.get("static_legs_tok_s", [])
             )
+        if row.get("metric") == "route_goodput_ratio":
+            headline["route_goodput_ratio"] = row.get("value")
+            headline["route_occupancy"] = row.get("occupancy_by_replica")
         if row.get("metric") == "spec_decode_tokens_per_sec":
             headline["spec_accept_rate"] = row.get("accept_rate")
         if row.get("metric", "").startswith("disk_offload_"):
@@ -1578,7 +1633,7 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
         "decode", "telemetry", "watchdog", "metrics", "sanitize", "goodput",
-        "ckpt", "serve", "spec",
+        "ckpt", "serve", "spec", "route",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -1599,6 +1654,7 @@ if __name__ == "__main__":
             "ckpt": _mode_ckpt,
             "serve": _mode_serve,
             "spec": _mode_spec,
+            "route": _mode_route,
         }
         dispatch[mode](platform)
         sys.stdout.flush()

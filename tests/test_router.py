@@ -1,0 +1,541 @@
+"""Multi-replica router (``serving/router.py`` + ``serving/replica.py`` +
+``accelerate-tpu route``).
+
+Placement/affinity/requeue policy runs against in-process stub replicas
+(no jax, no subprocess — tier-1 cheap). Durability — kill -9 a replica
+mid-stream, SIGTERM drain — is proven against REAL serve processes through
+the real CLI, the same way the resilience kill→resume tests work.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu.serving.replica import ReplicaError, ReplicaHandle
+from accelerate_tpu.serving.router import Router
+
+# ---------------------------------------------------------------------------
+# stub-replica policy tests (tier-1: no jax, no processes)
+# ---------------------------------------------------------------------------
+
+
+class StubReplica(ReplicaHandle):
+    """In-process replica double: `generate` sleeps `latency` then answers;
+    `down=True` makes dispatch fail at the transport level and health
+    checks go silent (a kill -9 as the router experiences it)."""
+
+    def __init__(self, replica_id, latency=0.0):
+        super().__init__(replica_id, f"http://stub/{replica_id}")
+        self.state = "ready"
+        self.latency = latency
+        self.down = False
+        self.handled = []
+        self._hlock = threading.Lock()
+
+    def check_health(self, timeout=2.0):
+        if self.down:
+            return None
+        self.last_heartbeat = time.time()
+        return {"state": self.state, "queue_depth": 0, "active_slots": 0}
+
+    def generate(self, payload, timeout=None):
+        if self.down:
+            raise ReplicaError(f"stub {self.replica_id} is down")
+        time.sleep(self.latency)
+        with self._hlock:
+            self.handled.append(payload)
+        return {
+            "id": payload.get("id"),
+            "tokens": [1, 2, 3],
+            "finish_reason": "length",
+        }
+
+
+def _router(replicas, **kw):
+    kw.setdefault("health_interval", 60.0)  # policy tests drive health manually
+    return Router(replicas, **kw)
+
+
+def test_least_loaded_placement_splits_across_replicas():
+    r0, r1 = StubReplica(0, latency=0.5), StubReplica(1, latency=0.5)
+    router = _router([r0, r1])
+    try:
+        tickets = [router.submit({"id": i, "prompt": [1]}) for i in range(4)]
+        assert router.wait_idle(timeout=30)
+        assert all(t.result["tokens"] == [1, 2, 3] for t in tickets)
+        # with both replicas slower than dispatch, least-loaded alternates
+        assert len(r0.handled) == 2 and len(r1.handled) == 2
+    finally:
+        router.close()
+
+
+def test_session_affinity_beats_least_loaded():
+    r0, r1 = StubReplica(0, latency=0.5), StubReplica(1, latency=0.5)
+    router = _router([r0, r1])
+    try:
+        first = router.submit({"id": "s1", "prompt": [1], "session_id": "chat-a"})
+        assert first.done.wait(timeout=30)
+        assert any(p["id"] == "s1" for p in r0.handled)  # idle tie → replica 0
+        # skew load so replica 1 is now the least-loaded choice...
+        router.submit({"id": "f1", "prompt": [1]})  # → r0 (tie)
+        router.submit({"id": "f2", "prompt": [1]})  # → r1
+        router.submit({"id": "f3", "prompt": [1]})  # → r0 (tie at 1,1)
+        time.sleep(0.2)  # let dispatch place the free requests
+        # ...yet the session request still lands on its warm replica 0
+        sticky = router.submit({"id": "s2", "prompt": [1], "session_id": "chat-a"})
+        assert router.wait_idle(timeout=30)
+        assert any(p["id"] == "s2" for p in r0.handled)
+        assert sticky.result["finish_reason"] == "length"
+    finally:
+        router.close()
+
+
+def test_dead_replica_requeues_and_releases_sessions():
+    r0, r1 = StubReplica(0, latency=0.2), StubReplica(1, latency=0.2)
+    router = _router([r0, r1])
+    try:
+        warm = router.submit({"id": "w", "prompt": [1], "session_id": "chat-a"})
+        assert warm.done.wait(timeout=30)
+        assert any(p["id"] == "w" for p in r0.handled)
+        r0.down = True  # kill -9, as the router sees it
+        after = [
+            router.submit({"id": f"a{i}", "prompt": [1], "session_id": "chat-a"})
+            for i in range(3)
+        ]
+        assert router.wait_idle(timeout=30)
+        # every request answered exactly once, by the survivor
+        for t in after:
+            assert t.result["finish_reason"] == "length"
+        assert {p["id"] for p in r1.handled} >= {"a0", "a1", "a2"}
+        assert r0.state == "dead" and not r0.sessions
+        stats = router.stats()
+        assert stats["dead"] == 1 and stats["delivered"] == 4
+        assert stats["requeues"] >= 1
+    finally:
+        router.close()
+
+
+def test_wedged_replica_inflight_rescued():
+    """A replica whose process stays alive but stops answering (engine
+    deadlock) holds its POSTed requests on an open socket forever — no
+    transport error ever fires the normal requeue. Marking it dead must
+    rescue the stranded in-flight requests onto a survivor."""
+    release = threading.Event()
+
+    class WedgedStub(StubReplica):
+        wedged = False
+
+        def check_health(self, timeout=2.0):
+            if self.wedged:
+                return None  # /healthz starved, like the real wedge
+            return super().check_health(timeout)
+
+        def generate(self, payload, timeout=None):
+            if self.wedged:
+                release.wait(30)  # socket open, no answer, no error
+                raise ReplicaError("connection reset at teardown")
+            return super().generate(payload, timeout)
+
+    r0, r1 = WedgedStub(0), StubReplica(1)
+    r0.wedged = True
+    router = _router([r0, r1], health_interval=0.05)
+    try:
+        # idle tie-break sends the first request to the wedged replica
+        ticket = router.submit({"id": "stuck", "prompt": [1]})
+        assert ticket.done.wait(timeout=30), "stranded request never rescued"
+        assert ticket.result["tokens"] == [1, 2, 3]
+        assert any(p["id"] == "stuck" for p in r1.handled)
+        assert r0.state == "dead"
+        stats = router.stats()
+        assert stats["delivered"] == 1 and stats["requeues"] >= 1
+    finally:
+        release.set()
+        router.close()
+
+
+def test_stop_admission_answers_instead_of_dropping():
+    r0 = StubReplica(0)
+    router = _router([r0])
+    try:
+        router.stop_admission()
+        ticket = router.submit({"id": "late", "prompt": [1]})
+        assert ticket.done.wait(timeout=10)
+        assert "draining" in ticket.result["error"]
+        assert router.stats()["rejected"] == 1 and not r0.handled
+    finally:
+        router.close()
+
+
+def test_drain_finishes_inflight_before_returning(tmp_path):
+    r0 = StubReplica(0, latency=0.3)
+    router = _router([r0], logging_dir=str(tmp_path))
+    tickets = [router.submit({"id": i, "prompt": [1]}) for i in range(2)]
+    assert router.drain(timeout=30)
+    assert all(t.result["finish_reason"] == "length" for t in tickets)
+    # the fleet trail recorded the terminal state
+    trail = (tmp_path / "router" / "replicas.jsonl").read_text().splitlines()
+    last = json.loads(trail[-1])
+    assert last["state"] in ("draining", "terminated")
+
+
+def test_fleet_rows_carry_health_fields(tmp_path):
+    r0 = StubReplica(0)
+    router = Router([r0], logging_dir=str(tmp_path), health_interval=0.05)
+    try:
+        time.sleep(0.4)
+    finally:
+        router.close()
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "router" / "replicas.jsonl").read_text().splitlines()
+    ]
+    assert rows
+    row = rows[-1]
+    assert row["replica_id"] == 0 and row["state"] == "ready"
+    assert {"queue_depth", "active_slots", "in_flight", "heartbeat_age_s"} <= set(row)
+
+
+# ---------------------------------------------------------------------------
+# monitor fleet panel (tier-1: pure file reads)
+# ---------------------------------------------------------------------------
+
+
+def _write_fleet(tmp_path, rows):
+    d = tmp_path / "router"
+    d.mkdir(exist_ok=True)
+    with open(d / "replicas.jsonl", "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_monitor_fleet_panel_and_dead_detection(tmp_path):
+    from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+
+    now = time.time()
+    _write_fleet(
+        tmp_path,
+        [
+            {"schema": 1, "ts": now - 2, "replica_id": 0, "state": "ready",
+             "queue_depth": 3, "active_slots": 2, "num_slots": 4, "in_flight": 2,
+             "heartbeat_age_s": 0.1},
+            {"schema": 1, "ts": now - 1, "replica_id": 1, "state": "dead",
+             "queue_depth": 0, "active_slots": 0, "num_slots": 4, "in_flight": 0,
+             "heartbeat_age_s": 9.0},
+            {"schema": 1, "ts": now, "replica_id": 0, "state": "ready",
+             "queue_depth": 1, "active_slots": 2, "num_slots": 4, "in_flight": 1,
+             "heartbeat_age_s": 0.2},
+        ],
+    )
+    status = collect_status(str(tmp_path), now=now)
+    fleet = status["fleet"]
+    assert [r["replica_id"] for r in fleet] == [0, 1]
+    assert fleet[0]["state"] == "ready" and fleet[0]["queue_depth"] == 1  # newest row wins
+    assert status["fleet_dead"] == [1]
+    text = render_status(status)
+    assert "fleet" in text and "DEAD" in text
+
+
+def test_monitor_fleet_wedged_on_stale_rows(tmp_path):
+    from accelerate_tpu.diagnostics.monitor import ROUTER_STALE_S, collect_status
+
+    now = time.time()
+    _write_fleet(
+        tmp_path,
+        [{"schema": 1, "ts": now - ROUTER_STALE_S - 5, "replica_id": 0,
+          "state": "ready", "queue_depth": 0, "active_slots": 0, "in_flight": 0}],
+    )
+    status = collect_status(str(tmp_path), now=now)
+    assert status["fleet_dead"] == [0]
+    # a cleanly terminated fleet is NOT dead, however old the trail
+    _write_fleet(
+        tmp_path,
+        [{"schema": 1, "ts": now - 500, "replica_id": 0, "state": "terminated",
+          "queue_depth": 0, "active_slots": 0, "in_flight": 0}],
+    )
+    status = collect_status(str(tmp_path), now=now)
+    assert status["fleet_dead"] == []
+
+
+def test_monitor_once_exit_2_on_dead_replica(tmp_path, capsys):
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    _write_fleet(
+        tmp_path,
+        [{"schema": 1, "ts": time.time(), "replica_id": 0, "state": "dead",
+          "queue_depth": 0, "active_slots": 0, "in_flight": 0}],
+    )
+    assert main(["monitor", str(tmp_path), "--once"]) == 2
+    out = capsys.readouterr().out
+    assert "DEAD" in out
+
+
+# ---------------------------------------------------------------------------
+# real-process durability (the acceptance bars): kill -9 + SIGTERM drain
+# ---------------------------------------------------------------------------
+
+_TINY_ARGS = [
+    "--preset", "tiny", "--num-slots", "2", "--block-size", "8",
+    "--max-seq-len", "64", "--prefill-chunk", "8", "--decode-burst", "2",
+]
+
+
+def _cli_env():
+    """Single-device CPU replicas: strip the 8-device test mesh so each
+    spawned jax process starts fast and the box is not oversubscribed."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("ACCELERATE_TELEMETRY", None)
+    return env
+
+
+def _read_lines(stream, sink):
+    for line in stream:
+        line = line.strip()
+        if line:
+            sink.append(line)
+
+
+def _start_reader(proc, sink):
+    t = threading.Thread(target=_read_lines, args=(proc.stdout, sink), daemon=True)
+    t.start()
+    return t
+
+
+def _wait_results(sink, n, timeout, proc=None):
+    deadline = time.monotonic() + timeout
+    while len(sink) < n and time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    return [json.loads(line) for line in sink]
+
+
+def _req(i, session=None, n_new=4):
+    payload = {"id": i, "prompt": [1 + (i % 5), 7, 3], "max_new_tokens": n_new}
+    if session is not None:
+        payload["session_id"] = session
+    return json.dumps(payload) + "\n"
+
+
+def test_route_cli_survives_kill9_mid_stream(tmp_path):
+    """Acceptance: kill -9 one of two replicas with requests in flight —
+    every request is answered exactly once (requeued to the survivor)."""
+    logdir = tmp_path / "fleet"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "route", "--replicas", "2", "--logging-dir", str(logdir),
+         "--health-interval", "0.2", *_TINY_ARGS],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    results = []
+    _start_reader(proc, results)
+    try:
+        # warm both replicas with sticky sessions so the victim holds state
+        for i in range(4):
+            proc.stdin.write(_req(i, session=f"chat-{i % 2}"))
+        proc.stdin.flush()
+        assert len(_wait_results(results, 4, timeout=240, proc=proc)) == 4, (
+            f"fleet never answered warmup; rc={proc.poll()}"
+        )
+
+        # find a live replica pid from the fleet trail and kill -9 it with
+        # the next wave already submitted (in flight on both replicas)
+        rows = [
+            json.loads(line)
+            for line in (logdir / "router" / "replicas.jsonl").read_text().splitlines()
+        ]
+        pids = {r["replica_id"]: r["pid"] for r in rows if r.get("pid")}
+        assert len(pids) == 2
+        for i in range(4, 12):
+            proc.stdin.write(_req(i, session=f"chat-{i % 2}", n_new=8))
+        proc.stdin.flush()
+        os.kill(pids[0], signal.SIGKILL)
+
+        parsed = _wait_results(results, 12, timeout=240, proc=proc)
+        proc.stdin.close()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    parsed = [json.loads(line) for line in results]
+    ids = [r.get("id") for r in parsed]
+    assert sorted(ids) == list(range(12)), f"lost/duplicated requests: {sorted(ids)}"
+    assert len(ids) == len(set(ids)), "duplicated delivery"
+    errors = [r for r in parsed if "error" in r]
+    assert not errors, f"requests lost to the kill: {errors}"
+    # the router noticed the death
+    rows = [
+        json.loads(line)
+        for line in (logdir / "router" / "replicas.jsonl").read_text().splitlines()
+    ]
+    assert any(r["state"] == "dead" for r in rows)
+
+
+def test_route_cli_sigterm_drains_and_exits_zero(tmp_path):
+    """Acceptance: SIGTERM mid-stream answers every in-flight request, then
+    exits 0 (replica drained via its own SIGTERM path underneath)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "route", "--replicas", "1", "--logging-dir", str(tmp_path),
+         "--health-interval", "0.2", *_TINY_ARGS],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    results = []
+    _start_reader(proc, results)
+    try:
+        proc.stdin.write(_req(0))  # proves the fleet is up before the burst
+        proc.stdin.flush()
+        assert len(_wait_results(results, 1, timeout=240, proc=proc)) == 1
+        for i in range(1, 5):
+            proc.stdin.write(_req(i, n_new=8))
+        proc.stdin.flush()
+        time.sleep(0.3)  # let the pipe land in the router before the signal
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    parsed = [json.loads(line) for line in results]
+    answered = {r.get("id") for r in parsed}
+    assert answered == set(range(5)), f"drain lost requests: {sorted(answered)}"
+    # in-flight requests were *completed*, not error'd out
+    completed = [r for r in parsed if "tokens" in r]
+    assert completed, "drain answered nothing with a real completion"
+
+
+def test_serve_cli_sigterm_drains_inflight(tmp_path):
+    """Satellite: the single-engine serve CLI drains on SIGTERM — stops
+    admission, finishes in-flight via run_until_idle, answers stragglers,
+    exits 0."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "serve", *_TINY_ARGS],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    results = []
+    _start_reader(proc, results)
+    try:
+        proc.stdin.write(_req(0))
+        proc.stdin.flush()
+        assert len(_wait_results(results, 1, timeout=240, proc=proc)) == 1, (
+            f"serve never answered; rc={proc.poll()}"
+        )
+        for i in range(1, 4):
+            proc.stdin.write(_req(i, n_new=8))
+        proc.stdin.flush()
+        time.sleep(0.3)  # let the reader thread consume the pipe first
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    parsed = [json.loads(line) for line in results]
+    assert {r.get("id") for r in parsed} == set(range(4))
+    assert all("tokens" in r for r in parsed), f"straggler lost: {parsed}"
+
+
+# ---------------------------------------------------------------------------
+# serve front end /healthz state machine (in-process, stub engine)
+# ---------------------------------------------------------------------------
+
+
+class _StubScheduler:
+    queue_depth = 2
+
+    def active(self, state=None):
+        return [object()]
+
+    def has_work(self):
+        return False
+
+
+class _StubEngine:
+    scheduler = _StubScheduler()
+    config = type("C", (), {"num_slots": 4})()
+
+    def stats(self):
+        return {"queue_depth": 2, "completed": 0, "tokens_emitted": 0,
+                "decode_compiles": 1, "iterations": 0}
+
+    def step(self):
+        return []
+
+
+def _probe(url, timeout=5):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_serve_healthz_state_machine(monkeypatch):
+    import queue as queue_mod
+    import socket
+    import urllib.error
+
+    from accelerate_tpu.commands import serve as serve_mod
+    from accelerate_tpu.commands.serve import ServeHealth, _serve_http
+
+    # hold the drain grace open so probing the `draining` state can't race
+    # the loop's exit; the test ends the loop via `stop` instead
+    monkeypatch.setattr(serve_mod, "_DRAIN_IDLE_GRACE_S", 60.0)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    health = ServeHealth()
+    health.mark_ready()
+    stop = threading.Event()
+    inbox: queue_mod.Queue = queue_mod.Queue()
+    t = threading.Thread(
+        target=_serve_http, args=(_StubEngine(), inbox, stop, port),
+        kwargs={"health": health}, daemon=True,
+    )
+    t.start()
+    try:
+        payload = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                payload = _probe(f"http://127.0.0.1:{port}/healthz")
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert payload is not None
+        assert payload["state"] == "ready"
+        assert payload["queue_depth"] == 2 and payload["active_slots"] == 1
+        assert payload["num_slots"] == 4 and payload["pid"]
+
+        health.mark_draining()
+        assert _probe(f"http://127.0.0.1:{port}/healthz")["state"] == "draining"
+        # draining front end refuses new admissions with an answer, not a hang
+        req = __import__("urllib.request", fromlist=["request"]).Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"id": 1, "prompt": [1]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            __import__("urllib.request", fromlist=["request"]).urlopen(req, timeout=10)
+        assert exc_info.value.code == 503
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()

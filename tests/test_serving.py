@@ -145,6 +145,75 @@ def test_grow_for_decode_allocates_incrementally():
 
 
 # ---------------------------------------------------------------------------
+# mesh sharding policy (tier-1: pure placement decisions, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def _mesh4():
+    import jax
+
+    from accelerate_tpu.mesh import build_mesh
+    from accelerate_tpu.utils.dataclasses import MeshPlugin
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs a >= 4-device (virtual) mesh")
+    return build_mesh(MeshPlugin(dp=1, fsdp=2, tp=2), devices=devices[:4])
+
+
+def test_paged_kv_sharding_policy():
+    """The pool shards its kv-head dim over tp (K/V are produced tp-sharded
+    by wk/wv) and falls back to replicated when tp doesn't divide."""
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.parallel.sharding import paged_kv_sharding
+
+    mesh = _mesh4()
+    assert paged_kv_sharding(mesh, num_kv_heads=4).spec == P(
+        None, None, None, "tp", None
+    )
+    assert paged_kv_sharding(mesh, num_kv_heads=3).spec == P()
+
+
+# ---------------------------------------------------------------------------
+# sharded-engine parity (the acceptance bar: mesh decode == single device)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_engine_matches_single_device(tiny_model):
+    """Token-identical greedy output between the mesh-sharded engine
+    (fsdp=2 x tp=2 over 4 virtual CPU devices) and the single-device
+    engine, with the one-compiled-decode-executable contract still holding
+    under GSPMD and zero leaked blocks."""
+    mesh = _mesh4()
+    geometry = dict(num_slots=3, block_size=8, max_seq_len=64, prefill_chunk=8,
+                    decode_burst=2)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32) for n in (5, 12, 9)]
+    budgets = [4, 7, 5]
+
+    def run(mesh_arg):
+        engine = InferenceEngine(tiny_model, EngineConfig(**geometry), mesh=mesh_arg)
+        reqs = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+        engine.run_until_idle(max_iterations=5000)
+        return engine, [list(r.output_tokens) for r in reqs]
+
+    single_engine, single_tokens = run(None)
+    sharded_engine, sharded_tokens = run(mesh)
+    assert sharded_tokens == single_tokens
+    stats = sharded_engine.stats()
+    assert stats["decode_compiles"] == 1  # sharding never broke the contract
+    assert stats["prefill_compiles"] == 1
+    assert stats["allocated_blocks"] == 0
+    assert stats["mesh"] == {"fsdp": 2, "tp": 2}
+    assert single_engine.stats()["decode_compiles"] == 1
+    # the pool really is distributed: each device holds 1/tp of the kv heads
+    shard_shapes = {s.data.shape for s in sharded_engine._kp.addressable_shards}
+    full = sharded_engine._kp.shape
+    assert shard_shapes == {(*full[:3], full[3] // 2, full[4])}
+
+
+# ---------------------------------------------------------------------------
 # engine end-to-end (slow lane: compiles the tiny model)
 # ---------------------------------------------------------------------------
 
