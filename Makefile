@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -40,3 +40,6 @@ lint-smoke:       ## seeded-bad script trips the CLI (exit 2), clean tree passes
 
 route-smoke:      ## 2-replica router fleet, mixed sticky/free traffic, kill -9 one replica mid-run -> zero lost requests + clean drain
 	python benchmarks/route_smoke.py
+
+shard-smoke:      ## shard-check pre-flight: clean plan exits 0, seeded dead-rule/over-budget plans exit 2, --json round-trips
+	python benchmarks/shard_smoke.py

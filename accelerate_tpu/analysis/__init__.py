@@ -7,6 +7,9 @@
 * :mod:`.sanitizer` — the runtime mode (``ACCELERATE_SANITIZE=1`` /
   ``Accelerator(sanitize=True)``) that runs those analyzers on the live
   compile path and probes the loss for NaN/inf at step boundaries.
+* :mod:`.shardplan` — the static sharding-plan analyzer behind
+  ``accelerate-tpu shard-check``: per-device HBM tiers and SP001-SP006
+  findings computed from abstract shapes before anything allocates.
 """
 
 from .engine import lint_file, lint_paths, lint_source, normalize_rule_ids
@@ -24,6 +27,28 @@ def __getattr__(name):
         from . import sanitizer
 
         return getattr(sanitizer, name)
+    if name in (
+        "SP_RULES",
+        "PlanFinding",
+        "PlanReport",
+        "LeafPlan",
+        "analyze_plan",
+        "plan_params",
+        "plan_opt_state",
+        "plan_kv_pool",
+        "resharding_report",
+        "resharding_findings",
+        "manifest_findings",
+        "engine_preflight",
+        "auto_num_blocks",
+        "arg_bytes_report",
+        "parse_mesh_spec",
+        "mesh_sizes_of",
+        "normalize_sp_ids",
+    ):
+        from . import shardplan
+
+        return getattr(shardplan, name)
     if name in (
         "signature_entries",
         "fingerprint_of",

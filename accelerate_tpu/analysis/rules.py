@@ -116,6 +116,14 @@ RULES: dict[str, Rule] = {
             "hoist the collective out of the branch, or use jax.lax.cond so every host traces "
             "the same collective sequence",
         ),
+        Rule(
+            "TPU012",
+            "error",
+            "PartitionSpec names a mesh axis that no build_mesh mesh defines",
+            "use the canonical axis names (dp, pp, fsdp, ep, cp, tp) — an unknown axis is "
+            "silently dropped by the rule validator (shard-check SP003) or raises at "
+            "device_put/jit time",
+        ),
     )
 }
 
@@ -587,6 +595,77 @@ def check_unfenced_timing(fn: ast.FunctionDef | ast.Module, ctx: _Ctx) -> None:
     scan(fn.body)
 
 
+#: the canonical build_mesh vocabulary — a stdlib-only mirror of
+#: utils.dataclasses.MESH_AXIS_ORDER (the source of truth; shardplan
+#: imports it directly, this module must stay importable with zero
+#: package deps). Keep in sync when adding a mesh axis.
+_KNOWN_MESH_AXES = {"dp", "pp", "fsdp", "ep", "cp", "tp"}
+
+
+def _collect_partitionspec_names(tree: ast.Module) -> set[str]:
+    """Local names bound to jax's PartitionSpec by an import (``from
+    jax.sharding import PartitionSpec as P`` is the universal idiom)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("jax"):
+                for a in node.names:
+                    if a.name == "PartitionSpec":
+                        names.add(a.asname or a.name)
+    return names
+
+
+def _collect_local_mesh_axes(tree: ast.Module) -> set[str]:
+    """Axis-name string literals handed to a local ``Mesh(...)`` /
+    ``AbstractMesh(...)`` / ``make_mesh(...)`` construction — a file that
+    builds its own mesh with custom axis names legitimately uses them in
+    PartitionSpec. All three constructors take axis names as the second
+    positional argument or the ``axis_names`` keyword."""
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func).rsplit(".", 1)[-1] not in (
+            "Mesh", "AbstractMesh", "make_mesh",
+        ):
+            continue
+        candidates = list(node.args[1:]) + [
+            kw.value for kw in node.keywords if kw.arg == "axis_names"
+        ]
+        for arg in candidates:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    axes.add(sub.value)
+    return axes
+
+
+def check_partition_axes(tree: ast.Module, ctx: _Ctx) -> None:
+    """TPU012: a literal ``PartitionSpec("...")`` naming an axis absent
+    from every ``build_mesh`` axis set (and from any mesh this file
+    constructs itself)."""
+    spec_names = _collect_partitionspec_names(tree)
+    known = _KNOWN_MESH_AXES | _collect_local_mesh_axes(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if not (callee in spec_names or callee.rsplit(".", 1)[-1] == "PartitionSpec"):
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, str)
+                    and sub.value not in known
+                ):
+                    ctx.add(
+                        "TPU012",
+                        sub,
+                        f"axis {sub.value!r} is not one of "
+                        f"{', '.join(sorted(_KNOWN_MESH_AXES))}",
+                    )
+
+
 def check_scalar_retrace(tree: ast.Module, jitted_names: set[str], ctx: _Ctx) -> None:
     """TPU010: a jitted callable invoked with the bare induction variable of
     an enclosing ``for … in range(...)`` loop."""
@@ -652,5 +731,6 @@ def run_rules(tree: ast.Module, path: str) -> list[Finding]:
             check_unfenced_timing(node, ctx)
     check_unfenced_timing(tree, ctx)  # module-level script timing
     check_scalar_retrace(tree, jitted_names, ctx)
+    check_partition_axes(tree, ctx)
     ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return ctx.findings

@@ -7,7 +7,7 @@ import sys
 
 from . import (
     config, env, estimate, launch, lint, merge, metrics, monitor, route, serve,
-    test, tpu,
+    shardcheck, test, tpu,
 )
 
 
@@ -18,7 +18,7 @@ def main(argv: list[str] | None = None) -> int:
         allow_abbrev=False,
     )
     subparsers = parser.add_subparsers(dest="command")
-    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, route, serve, tpu):
+    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, route, serve, shardcheck, tpu):
         module.add_parser(subparsers)
 
     args = parser.parse_args(argv)

@@ -111,6 +111,75 @@ def _build_model(args):
     return LlamaForCausalLM.from_config(config, seed=args.seed, dtype=dtype)
 
 
+class _PreflightRefusal(Exception):
+    """Engine construction refused to start (the SP004 pre-flight, or
+    invalid geometry) — distinct from a ValueError escaping the live
+    serving loop, which must not be mislabeled as a startup refusal."""
+
+
+def _auto_num_blocks(args, model, mesh) -> int:
+    """``--auto-blocks``: size ``num_blocks`` from the shard-check HBM
+    model instead of a hand-picked constant. Budget = ``--hbm-gb`` or the
+    attached device's reported HBM; raises ValueError (the SP004 refusal)
+    when neither is known or even one request's blocks don't fit."""
+    from ..analysis.shardplan import auto_num_blocks, mesh_sizes_of, plan_kv_pool, plan_params
+    from ..mesh import device_hbm_bytes
+    from ..serving.blocks import blocks_needed
+
+    budget_bytes = (
+        int(args.hbm_gb * (1 << 30)) if args.hbm_gb is not None else device_hbm_bytes()
+    )
+    if budget_bytes is None:
+        raise ValueError(
+            "SP004: --auto-blocks needs an HBM budget, and this backend "
+            "reports no device memory limit — pass --hbm-gb"
+        )
+    inner = getattr(model, "_model", None) or model
+    cfg = inner.config
+    sizes = (
+        mesh_sizes_of(mesh) if mesh is not None
+        else {ax: 1 for ax in ("dp", "pp", "fsdp", "ep", "cp", "tp")}
+    )
+    rules = getattr(inner, "partition_rules", None)
+    params_bytes = sum(
+        p.bytes_per_device for p in plan_params(model.params, sizes, rules=rules)
+    )
+    n_kv = getattr(cfg, "num_key_value_heads", None) or cfg.num_attention_heads
+    per_block = sum(
+        p.bytes_per_device
+        for p in plan_kv_pool(
+            num_layers=cfg.num_hidden_layers,
+            num_kv_heads=n_kv,
+            head_dim=cfg.head_dim,
+            num_slots=1,
+            block_size=args.block_size,
+            max_seq_len=args.max_seq_len,
+            num_blocks=1,
+            mesh_sizes=sizes,
+            dtype="bfloat16" if args.dtype == "bf16" else "float32",
+        )
+    )
+    blocks_per_slot = blocks_needed(args.max_seq_len, args.block_size)
+    full_residency = args.num_slots * blocks_per_slot + 1
+    num_blocks, headroom = auto_num_blocks(
+        budget_bytes,
+        params_bytes,
+        per_block,
+        full_residency_blocks=full_residency,
+        min_blocks=blocks_per_slot + 1,  # one full request + the null block
+    )
+    gib = 1 << 30
+    print(
+        f"auto-blocks: {num_blocks} blocks "
+        f"({per_block / 1e6:.2f} MB/block/device; full residency "
+        f"{full_residency}) — params {params_bytes / gib:.3f} GiB/device, "
+        f"predicted headroom {headroom / gib:.3f} GiB under the "
+        f"{budget_bytes / gib:.3f} GiB budget",
+        file=sys.stderr,
+    )
+    return num_blocks
+
+
 def _make_engine(args):
     from ..serving import EngineConfig, InferenceEngine
 
@@ -120,12 +189,16 @@ def _make_engine(args):
 
         mesh = build_mesh()  # MeshPlugin reads ACCELERATE_MESH_* env vars
     model = _build_model(args)
+    num_blocks = args.num_blocks
+    if args.auto_blocks:
+        num_blocks = _auto_num_blocks(args, model, mesh)
     return InferenceEngine(
         model,
         EngineConfig(
             num_slots=args.num_slots,
             block_size=args.block_size,
             max_seq_len=args.max_seq_len,
+            num_blocks=num_blocks,
             prefill_chunk=args.prefill_chunk,
             decode_burst=args.decode_burst,
             eos_token_id=args.eos_token_id,
@@ -133,6 +206,7 @@ def _make_engine(args):
             temperature=args.temperature if args.temperature is not None else 1.0,
             seed=args.seed,
             max_new_tokens=args.max_new_tokens,
+            hbm_budget_gb=args.hbm_gb,
         ),
         mesh=mesh,
     )
@@ -238,11 +312,31 @@ def serve_command(args) -> int:
         if args.http:
             # factory form: the server binds FIRST (so /healthz answers
             # `starting` while the engine builds/compiles), then the engine
-            # comes up and the state flips to `ready`
-            return _serve_http(lambda: _make_engine(args), inbox, stop,
-                               args.http, health=health, handler=handler)
+            # comes up and the state flips to `ready`. Only a ValueError
+            # raised while BUILDING the engine is a refusal — one escaping
+            # the live serving loop later must keep its traceback.
+            def build_engine():
+                try:
+                    return _make_engine(args)
+                except ValueError as e:
+                    raise _PreflightRefusal(str(e)) from e
 
-        engine = _make_engine(args)
+            try:
+                return _serve_http(build_engine, inbox, stop,
+                                   args.http, health=health, handler=handler)
+            except _PreflightRefusal as e:
+                # SP004 pre-flight refusal (or invalid geometry): an error
+                # row + exit 2, the same contract as shard-check
+                emit({"error": str(e)})
+                print(f"serve: refusing to start: {e}", file=sys.stderr)
+                return 2
+
+        try:
+            engine = _make_engine(args)
+        except ValueError as e:
+            emit({"error": str(e)})
+            print(f"serve: refusing to start: {e}", file=sys.stderr)
+            return 2
         # stdin/JSONL mode: a reader thread feeds the inbox; EOF arms stop
         # and the loop drains what's in flight before exiting. Once
         # draining, admission stops — late lines are answered, not queued.
@@ -388,15 +482,17 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
     print(f"serving on http://127.0.0.1:{port} "
           f"(POST /generate, GET /healthz, GET /stats, GET /metrics)",
           file=sys.stderr)
-    if box["engine"] is None:
-        box["engine"] = engine()  # /healthz says `starting` during this build
-    health.mark_ready()
     try:
+        if box["engine"] is None:
+            box["engine"] = engine()  # /healthz says `starting` during this build
+        health.mark_ready()
         _engine_loop(box["engine"], inbox, lambda *a: None, stop,
                      health=health, handler=handler)
     except KeyboardInterrupt:
         pass
     finally:
+        # build failures (the pre-flight refusal) must also unbind the
+        # port — a leaked server thread answers /healthz `starting` forever
         server.shutdown()
     return 0
 
@@ -418,6 +514,17 @@ def add_parser(subparsers):
                    help="prompt tokens prefilled per engine iteration")
     p.add_argument("--decode-burst", type=int, default=8,
                    help="decode steps per dispatch (scheduling granularity)")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="paged KV pool blocks (default: full residency — "
+                   "num_slots x blocks-per-slot + 1)")
+    p.add_argument("--hbm-gb", type=float, default=None,
+                   help="per-device HBM budget: the engine runs the "
+                   "shard-check pre-flight and refuses to start (error row, "
+                   "exit 2) if params + pools exceed it")
+    p.add_argument("--auto-blocks", action="store_true",
+                   help="size num_blocks from the shard-check HBM model "
+                   "(budget: --hbm-gb, or the device's reported HBM) and log "
+                   "the chosen count + predicted headroom")
     p.add_argument("--max-new-tokens", type=int, default=64,
                    help="default output budget when a request omits it")
     p.add_argument("--eos-token-id", type=int, default=None)

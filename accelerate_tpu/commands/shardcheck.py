@@ -1,0 +1,288 @@
+"""``accelerate-tpu shard-check`` — static sharding-plan pre-flight.
+
+Given a model shape, a mesh (real devices via ``--mesh``, or a virtual
+axis declaration via ``--virtual dp,fsdp,tp`` — no devices touched), and
+the partition rules from ``parallel/sharding.py``, statically compute the
+per-device HBM footprint (params, optimizer state, paged KV block pool,
+optional gradient/activation estimate) and emit SP001-SP006 findings —
+the planning questions you otherwise answer by OOMing on the TPU.
+
+Exit codes mirror ``lint``:
+
+* ``0`` — clean, or warnings only
+* ``1`` — usage error (bad mesh spec, unknown finding id, missing file)
+* ``2`` — at least one **error**-severity finding (dead rule, forced
+  replication, non-divisible axis, over-budget HBM)
+
+The runtime twins: ``serve --hbm-gb`` arms the engine's refuse-to-start
+pre-flight, ``serve --auto-blocks`` sizes the block pool from the same
+model, and the sanitizer stamps predicted-vs-actual arg bytes onto
+compile facts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _parse_extra_rule(raw: str):
+    """``"regex=axis,axis"`` → ``(regex, PartitionSpec(...))``. Axis
+    entries: a mesh axis name, ``None`` (keep dim unsharded), or
+    ``a+b`` for a multi-axis entry. ``"regex="`` forces replication."""
+    from jax.sharding import PartitionSpec as P
+
+    pattern, sep, spec_str = raw.partition("=")
+    if not sep:
+        raise ValueError(
+            f"--extra-rule needs regex=spec (e.g. 'embed_tokens=tp,fsdp'), got {raw!r}"
+        )
+    entries = []
+    for part in spec_str.split(","):
+        part = part.strip()
+        if not part or part.lower() == "none":
+            entries.append(None)
+        elif "+" in part:
+            entries.append(tuple(p.strip() for p in part.split("+")))
+        else:
+            entries.append(part)
+    if entries == [None]:
+        entries = []
+    return pattern, P(*entries)
+
+
+def _build_abstract(args):
+    """(abstract params, model config, partition rules) for the preset —
+    ``jax.eval_shape`` only: no weights materialize, no device is used."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import (
+        LLAMA_PARTITION_RULES,
+        LlamaConfig,
+        init_llama_params,
+    )
+
+    presets = {
+        "tiny": lambda: LlamaConfig.tiny(),
+        "flagship": lambda: LlamaConfig.flagship_700m(),
+        "llama2-7b": lambda: LlamaConfig.llama2_7b(),
+    }
+    config = presets[args.preset]()
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    params = jax.eval_shape(
+        lambda key: init_llama_params(key, config, dtype=dtype),
+        jax.random.PRNGKey(0),
+    )
+    return params, config, list(LLAMA_PARTITION_RULES)
+
+
+def shard_check_command(args) -> int:
+    from ..analysis.shardplan import (
+        SP_RULES,
+        analyze_plan,
+        manifest_findings,
+        mesh_sizes_of,
+        normalize_sp_ids,
+        parse_mesh_spec,
+        resharding_findings,
+    )
+
+    if args.list_rules:
+        for rule in SP_RULES.values():
+            print(f"{rule.id}  [{rule.severity:7s}] {rule.summary}")
+        return 0
+
+    try:
+        select = normalize_sp_ids(args.select)
+        ignore = normalize_sp_ids(args.ignore)
+    except ValueError as e:
+        print(f"shard-check: {e}", file=sys.stderr)
+        return 1
+
+    if args.mesh:
+        from ..mesh import build_mesh
+
+        mesh_sizes = mesh_sizes_of(build_mesh())
+    else:
+        try:
+            mesh_sizes = parse_mesh_spec(args.virtual)
+        except ValueError as e:
+            print(f"shard-check: {e}", file=sys.stderr)
+            return 1
+
+    params, config, rules = _build_abstract(args)
+    if args.extra_rule:
+        try:
+            extra = [_parse_extra_rule(raw) for raw in args.extra_rule]
+        except ValueError as e:
+            print(f"shard-check: {e}", file=sys.stderr)
+            return 1
+        rules = extra + rules  # prepended: extra rules take priority
+
+    kv_pool = None
+    if not args.no_serve_pool:
+        kv_pool = dict(
+            num_layers=config.num_hidden_layers,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            num_slots=args.num_slots,
+            block_size=args.block_size,
+            max_seq_len=min(args.max_seq_len, config.max_position_embeddings),
+            num_blocks=args.num_blocks,
+            dtype="float32" if args.dtype == "f32" else "bfloat16",
+        )
+    activations = None
+    include_grads = False
+    if args.batch:
+        include_grads = True
+        activations = dict(
+            apply_fn=lambda p, **kw: _abstract_apply(config, p, **kw),
+            params=params,
+            batch=args.batch,
+            seq=args.seq or config.max_position_embeddings,
+            hidden=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            remat=bool(config.remat),
+            dtype="float32" if args.dtype == "f32" else "bfloat16",
+        )
+
+    try:
+        report = analyze_plan(
+            params,
+            mesh_sizes,
+            rules=rules,
+            optimizer=args.optimizer,
+            kv_pool=kv_pool,
+            activations=activations,
+            include_grads=include_grads,
+            hbm_gb=args.hbm_gb,
+            replicated_threshold_bytes=int(args.replicated_threshold_mb * (1 << 20)),
+        )
+    except ValueError as e:
+        print(f"shard-check: {e}", file=sys.stderr)
+        return 1
+
+    if args.hlo:
+        if not os.path.exists(args.hlo):
+            print(f"shard-check: no such HLO file: {args.hlo}", file=sys.stderr)
+            return 1
+        with open(args.hlo, encoding="utf-8", errors="replace") as f:
+            report.findings.extend(
+                resharding_findings(f.read(), label=os.path.basename(args.hlo))
+            )
+    if args.manifest:
+        from ..resilience.manifest import read_manifest
+
+        manifest = read_manifest(args.manifest)
+        if manifest is None:
+            print(
+                f"shard-check: no readable manifest.json under {args.manifest}",
+                file=sys.stderr,
+            )
+            return 1
+        report.findings.extend(
+            manifest_findings(manifest, [l for l in report.leaves if l.tier == "params"])
+        )
+
+    findings = [
+        f
+        for f in report.findings
+        if (not select or f.rule in select) and (not ignore or f.rule not in ignore)
+    ]
+    report.findings = findings
+    errors = [f for f in findings if f.severity == "error"]
+
+    if args.json:
+        payload = report.to_dict()
+        if not args.leaves:
+            payload.pop("leaves")
+        print(json.dumps(payload, indent=2))
+    else:
+        gib = 1 << 30
+        mesh_str = ", ".join(f"{ax}={n}" for ax, n in report.mesh.items() if n > 1) or "1 device"
+        print(f"shard-check: {args.preset} over mesh ({mesh_str})")
+        for tier, t in sorted(report.tiers.items(), key=lambda kv: -kv[1]["bytes_per_device"]):
+            print(
+                f"  {tier:12s} {t['bytes_per_device'] / gib:8.3f} GiB/device "
+                f"(global {t['bytes_global'] / gib:.3f} GiB)"
+            )
+        total = report.bytes_per_device / gib
+        budget = f" / budget {args.hbm_gb:.3f} GiB" if args.hbm_gb is not None else ""
+        print(f"  {'TOTAL':12s} {total:8.3f} GiB/device{budget}")
+        for f in findings:
+            print(f.render())
+        print(
+            f"shard-check: {len(errors)} error(s), "
+            f"{len(findings) - len(errors)} warning(s)"
+        )
+    return 2 if errors else 0
+
+
+def _abstract_apply(config, params, **kw):
+    from ..models.llama import llama_apply
+
+    return llama_apply(config, params, **kw)
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "shard-check",
+        help="Static sharding-plan pre-flight: per-device HBM tiers + "
+        "SP001-SP006 findings before the job runs",
+    )
+    p.add_argument("--preset", choices=("tiny", "flagship", "llama2-7b"),
+                   default="flagship", help="model shape to plan")
+    p.add_argument("--dtype", choices=("f32", "bf16"), default="f32")
+    p.add_argument("--virtual", default="1,1,1", metavar="DP,FSDP,TP",
+                   help="virtual mesh axis sizes — positional dp,fsdp,tp or "
+                   "named dp=1,fsdp=2,tp=2,cp=1; no devices needed")
+    p.add_argument("--mesh", action="store_true",
+                   help="plan over the attached mesh (ACCELERATE_MESH_* env "
+                   "vars) instead of --virtual")
+    p.add_argument("--optimizer", choices=("adam", "adamw", "sgd", "none"),
+                   default="adam", help="optimizer whose state the plan prices")
+    p.add_argument("--hbm-gb", type=float, default=None,
+                   help="per-device HBM budget; exceeding it is an "
+                   "error-severity SP004 finding (exit 2)")
+    p.add_argument("--extra-rule", action="append", default=[],
+                   metavar="REGEX=SPEC",
+                   help="prepend a partition rule (takes priority), e.g. "
+                   "'embed_tokens=tp,fsdp' or 'lm_head=' (force replicated); "
+                   "repeatable")
+    p.add_argument("--replicated-threshold-mb", type=float, default=16.0,
+                   help="SP002 fires for replicated params at or above this size")
+    # serving-pool tier (priced by default: the capacity question ROADMAP
+    # item 3 asks is params + pool)
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="paged pool blocks (default: full residency)")
+    p.add_argument("--no-serve-pool", action="store_true",
+                   help="drop the paged KV pool tier (training-only plan)")
+    # training estimate tier
+    p.add_argument("--batch", type=int, default=None,
+                   help="global batch size: adds gradient + activation-"
+                   "estimate tiers")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length for the activation estimate")
+    # extra analyses
+    p.add_argument("--hlo", default=None, metavar="FILE",
+                   help="compiled-HLO text dump: SP005 reshard/wire-bytes "
+                   "ranking")
+    p.add_argument("--manifest", default=None, metavar="CHECKPOINT_DIR",
+                   help="checkpoint dir: SP006 manifest-vs-plan sharding diff")
+    # output
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument("--leaves", action="store_true",
+                   help="include the per-leaf plan in --json output")
+    p.add_argument("--select", default=None,
+                   help="comma-separated finding IDs to report exclusively")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated finding IDs to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the finding catalogue and exit")
+    p.set_defaults(func=shard_check_command)
+    return p

@@ -588,6 +588,19 @@ def _cost_aware_jit(fn, donate_argnums=(), label="", arg_names=()):
                 except Exception:
                     entries, diff = (), None
                 if sanitizer:
+                    # predicted-vs-actual per-device arg bytes: the static
+                    # shard-plan model (global bytes / sharding extents)
+                    # against the real shard buffers — a drift means the
+                    # placement the planner promised is not the placement
+                    # the program got
+                    try:
+                        from .analysis.shardplan import arg_bytes_report
+
+                        predicted, actual = arg_bytes_report(args)
+                        entry[1]["arg_bytes_predicted"] = predicted
+                        entry[1]["arg_bytes_actual"] = actual
+                    except Exception:
+                        pass
                     # the digest also rides the compile record so the
                     # telemetry trail carries cross-host-comparable state;
                     # observe_compile already computed it for the host

@@ -104,6 +104,20 @@ def mesh_axis_sizes(mesh: Mesh, trivial: bool = False) -> dict[str, int]:
     }
 
 
+def device_hbm_bytes(device=None) -> int | None:
+    """Per-device accelerator memory limit in bytes, or ``None`` when the
+    backend doesn't report one (CPU; some older runtimes). The shard-check
+    capacity model's default budget: on a real TPU ``serve --auto-blocks``
+    can size the pool without the operator looking up the chip's HBM."""
+    try:
+        device = device or jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+    except Exception:
+        return None
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    return int(limit) if limit else None
+
+
 def batch_axis_size(mesh: Mesh, extra_axes: tuple[str, ...] = ("fsdp",)) -> int:
     """Number of ways the global batch is split (the 'dp world size')."""
     n = mesh.shape["dp"]

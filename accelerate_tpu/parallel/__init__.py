@@ -1,5 +1,7 @@
 from .pipeline import gpipe, pipeline_microbatches
 from .sharding import (
+    PlacementDecision,
+    explain_partition_spec,
     infer_param_sharding,
     opt_state_sharding_like,
     partition_spec_for,

@@ -17,7 +17,9 @@ Sharding policy, in priority order:
 
 from __future__ import annotations
 
+import logging
 import re
+from dataclasses import dataclass
 from typing import Any
 
 import jax
@@ -27,7 +29,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..utils.dataclasses import FullyShardedDataParallelPlugin
 
+logger = logging.getLogger(__name__)
+
 P = PartitionSpec
+
+#: (param path, axis repr) pairs already warned about — the divisibility
+#: fallback warns ONCE per site, not once per step (the runtime twin of
+#: shard-check's SP003 finding)
+_DIVISIBILITY_WARNED: set[tuple[str, str]] = set()
 
 
 def _path_to_str(path) -> str:
@@ -44,19 +53,39 @@ def _path_to_str(path) -> str:
     return ".".join(parts)
 
 
-def partition_spec_for(
+@dataclass(frozen=True)
+class PlacementDecision:
+    """One parameter's placement, with the *why* attached — the record the
+    ``shard-check`` static analyzer turns into SP001/SP002/SP003 findings.
+
+    ``dropped`` lists rule entries the divisibility validation discarded:
+    ``(dim, axis_repr, extent)`` triples, ``extent`` 0 when the axis is
+    absent from the mesh entirely."""
+
+    spec: PartitionSpec
+    #: "rule" (a partition rule matched), "fsdp" (size policy), or
+    #: "replicated" (no rule, policy declined or found no divisible dim)
+    source: str
+    rule_index: int | None
+    dropped: tuple[tuple[int, str, int], ...]
+
+
+def explain_partition_spec(
     path_str: str,
     shape: tuple[int, ...],
-    mesh: Mesh,
+    mesh,
     plugin: FullyShardedDataParallelPlugin | None,
     rules: list[tuple[str, PartitionSpec]] | None,
-) -> PartitionSpec:
-    """Decide the PartitionSpec for one parameter."""
+) -> PlacementDecision:
+    """Decide one parameter's PartitionSpec and say why. ``mesh`` only needs
+    a ``.shape`` mapping — the shard-check analyzer passes a virtual axis
+    map, the runtime passes a real :class:`jax.sharding.Mesh`."""
     # GPipe stage placement: layer-stacked params (leading [layers] axis,
     # path under "layers") split their stack over the pp axis so each stage
     # group holds only its own layers. Applied as an overlay on whatever
     # rule/policy decides for the other dims.
-    pp_size = dict(mesh.shape).get("pp", 1)
+    sizes = dict(mesh.shape)
+    pp_size = sizes.get("pp", 1)
     stacked = (
         pp_size > 1
         and re.search(r"(^|\.)layers(\.|$)", path_str) is not None
@@ -73,17 +102,18 @@ def partition_spec_for(
         return P(*entries)
 
     if rules:
-        for pattern, spec in rules:
+        for i, (pattern, spec) in enumerate(rules):
             if re.search(pattern, path_str):
-                return overlay(_validated(spec, shape, mesh))
+                validated, dropped = _validated(spec, shape, mesh)
+                return PlacementDecision(overlay(validated), "rule", i, dropped)
     if plugin is None or not plugin.shards_params:
-        return overlay(P())
-    fsdp_size = mesh.shape["fsdp"]
+        return PlacementDecision(overlay(P()), "replicated", None, ())
+    fsdp_size = sizes.get("fsdp", 1)
     if fsdp_size <= 1:
-        return overlay(P())
+        return PlacementDecision(overlay(P()), "replicated", None, ())
     n_elements = int(np.prod(shape)) if shape else 0
     if n_elements < max(plugin.min_num_params, 2):
-        return overlay(P())
+        return PlacementDecision(overlay(P()), "replicated", None, ())
     # shard the largest divisible dim over fsdp (dim 0 is reserved for the
     # stage split when the pp overlay applies)
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
@@ -93,23 +123,72 @@ def partition_spec_for(
         if shape[dim] % fsdp_size == 0:
             spec = [None] * len(shape)
             spec[dim] = "fsdp"
-            return overlay(P(*spec))
-    return overlay(P())
+            return PlacementDecision(overlay(P(*spec)), "fsdp", None, ())
+    return PlacementDecision(overlay(P()), "replicated", None, ())
 
 
-def _validated(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
-    """Drop axes that don't divide the dim (defensive against bad rules)."""
+def partition_spec_for(
+    path_str: str,
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    plugin: FullyShardedDataParallelPlugin | None,
+    rules: list[tuple[str, PartitionSpec]] | None,
+) -> PartitionSpec:
+    """Decide the PartitionSpec for one parameter. A rule entry the
+    divisibility validation discards is warned about once per (param, axis)
+    — silently replicating a dim a rule asked to shard is exactly the
+    surprise ``shard-check``'s SP003 exists to catch before the run."""
+    decision = explain_partition_spec(path_str, shape, mesh, plugin, rules)
+    for dim, axis, extent in decision.dropped:
+        key = (path_str, axis)
+        if key in _DIVISIBILITY_WARNED:
+            continue
+        _DIVISIBILITY_WARNED.add(key)
+        if extent:
+            logger.warning(
+                "partition rule for %r asks to shard dim %d (size %s) over "
+                "axis %s (extent %d), which does not divide — falling back "
+                "to unsharded for that dim (shard-check names this SP003)",
+                path_str, dim, shape[dim] if dim < len(shape) else "?",
+                axis, extent,
+            )
+        else:
+            logger.warning(
+                "partition rule for %r names axis %s, which is not a mesh "
+                "axis — entry ignored (shard-check names this SP003; lint "
+                "rule TPU012 catches the literal)",
+                path_str, axis,
+            )
+    return decision.spec
+
+
+def _validated(
+    spec: PartitionSpec, shape: tuple[int, ...], mesh
+) -> tuple[PartitionSpec, tuple[tuple[int, str, int], ...]]:
+    """Drop axes that don't divide the dim (defensive against bad rules).
+    Returns the surviving spec plus the dropped entries as
+    ``(dim, axis_repr, extent)`` — extent 0 for an axis the mesh lacks."""
+    sizes = dict(mesh.shape)
     out = []
+    dropped: list[tuple[int, str, int]] = []
     for i, entry in enumerate(tuple(spec)):
         if entry is None:
             out.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
         extent = 1
+        known = True
         for ax in axes:
-            extent *= mesh.shape[ax]
-        out.append(entry if i < len(shape) and shape[i] % extent == 0 else None)
-    return P(*out)
+            if ax not in sizes:
+                known = False
+                continue
+            extent *= sizes[ax]
+        if known and i < len(shape) and shape[i] % extent == 0:
+            out.append(entry)
+        else:
+            out.append(None)
+            dropped.append((i, repr(entry), extent if known else 0))
+    return P(*out), tuple(dropped)
 
 
 def infer_param_sharding(

@@ -69,6 +69,12 @@ class EngineConfig:
     decode_burst: int = 8
     #: emit a telemetry "serving" row every N iterations (0 disables)
     stats_interval: int = 32
+    #: per-device HBM budget in GiB; when set, the engine runs the
+    #: shard-check pre-flight BEFORE allocating anything and refuses to
+    #: start (ValueError naming SP004) if params + the paged pools exceed
+    #: it — the capacity-planning contract: fail at bring-up, not OOM
+    #: mid-request
+    hbm_budget_gb: float | None = None
 
     @property
     def blocks_per_slot(self) -> int:
@@ -115,10 +121,11 @@ class InferenceEngine:
             )
 
         self._mb = cfg.blocks_per_slot  # block-table width
-        num_blocks = cfg.num_blocks or cfg.num_slots * self._mb + 1
-        self.allocator = BlockAllocator(num_blocks)
-        self.scheduler = SlotScheduler(
-            cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len
+        # explicit is-None test: an explicit num_blocks=0 must reach the
+        # allocator's >= 2 guard, not be silently rewritten to full residency
+        num_blocks = (
+            cfg.num_blocks if cfg.num_blocks is not None
+            else cfg.num_slots * self._mb + 1
         )
 
         # device state: per-layer page pools in the params' compute dtype
@@ -126,6 +133,14 @@ class InferenceEngine:
         embed = jax.tree.leaves(self._params)[0]
         dtype = embed.dtype if jnp.issubdtype(embed.dtype, jnp.floating) else jnp.float32
         shape = (mcfg.num_hidden_layers, num_blocks, cfg.block_size, n_kv, mcfg.head_dim)
+        self.hbm_preflight: dict | None = None
+        if cfg.hbm_budget_gb is not None:
+            self._hbm_preflight(inner, shape, dtype, mesh)
+
+        self.allocator = BlockAllocator(num_blocks)
+        self.scheduler = SlotScheduler(
+            cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len
+        )
         self._kp = jnp.zeros(shape, dtype)
         self._vp = jnp.zeros(shape, dtype)
         self._key = jax.random.PRNGKey(cfg.seed)
@@ -193,6 +208,34 @@ class InferenceEngine:
         rep = NamedSharding(mesh, PartitionSpec())
         self._key = jax.device_put(self._key, rep)
         self._temp = jax.device_put(self._temp, rep)
+
+    def _hbm_preflight(self, inner, pool_shape, pool_dtype, mesh) -> None:
+        """shard-check's SP004 at the serving seam: predicted per-device
+        bytes of params (under the placement ``_place_on_mesh`` would pick)
+        plus both paged pools, refused against ``hbm_budget_gb`` BEFORE a
+        single buffer allocates."""
+        from ..analysis.shardplan import engine_preflight
+
+        report = engine_preflight(
+            self._params,
+            getattr(inner, "partition_rules", None),
+            mesh,
+            pool_shape,
+            pool_dtype,
+            self.config.hbm_budget_gb,
+        )
+        self.hbm_preflight = report
+        if report["over"]:
+            gib = 1 << 30
+            raise ValueError(
+                f"SP004: engine refuses to start — predicted "
+                f"{report['total_bytes'] / gib:.3f} GiB/device "
+                f"(params {report['params_bytes'] / gib:.3f} + "
+                f"kv pools {report['pool_bytes'] / gib:.3f}) exceeds the "
+                f"{self.config.hbm_budget_gb:.3f} GiB budget. Lower "
+                f"num_blocks/max_seq_len (or use serve --auto-blocks), shard "
+                f"over a larger mesh, or raise the budget"
+            )
 
     # -- compiled programs ---------------------------------------------------
 
@@ -367,6 +410,8 @@ class InferenceEngine:
             out["mesh"] = mesh_axis_sizes(self.mesh)
         if self.retrace_report is not None:
             out["retrace_report"] = self.retrace_report
+        if self.hbm_preflight is not None:
+            out["hbm_preflight"] = self.hbm_preflight
         if self._start_time is not None:
             elapsed = time.perf_counter() - self._start_time
             out["elapsed_s"] = elapsed

@@ -47,7 +47,10 @@ see :data:`SCHEMA_VERSION`):
                (``lower_start``/``compile_start``/``compile_end``, same
                ``perf_counter`` clock the diagnostics trace spans use).
                ``ts`` stays wall-clock like every record; ``mono`` is what
-               lines a compile record up with the per-host trace timeline
+               lines a compile record up with the per-host trace timeline.
+               Sanitizer-armed compiles add ``fingerprint``/``changed_args``
+               /``collective_digest`` and ``arg_bytes_predicted``/
+               ``arg_bytes_actual`` (shard-plan model vs real shard buffers)
                (trace export / ``accelerate-tpu trace merge``). When the
                AOT path fingerprinted the signature (always on the AOT
                path): ``fingerprint``, and on a re-trace ``changed_args``
@@ -460,8 +463,11 @@ class TelemetryRecorder:
         # analysis/compiled.py fingerprint: present whenever the AOT path
         # computed one. ``changed_args`` NAMES the argument whose
         # shape/dtype perturbed the signature — the "why did this
-        # re-trace" answer, directly in the trail
-        for key in ("fingerprint", "changed_args", "collective_digest"):
+        # re-trace" answer, directly in the trail. The arg_bytes pair is
+        # the shard-plan model's predicted per-device bytes vs the real
+        # shard buffers (sanitizer-armed compiles only)
+        for key in ("fingerprint", "changed_args", "collective_digest",
+                    "arg_bytes_predicted", "arg_bytes_actual"):
             if facts.get(key) is not None:
                 record[key] = facts[key]
         self._emit(record, step=self.optimizer_step_count)

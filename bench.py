@@ -847,6 +847,53 @@ def _mode_sanitize(platform: str) -> None:
     print(f"BENCH_SANITIZE {guard_s:.12f} {step_off_s:.9f} {step_on_s:.9f}")
 
 
+def _mode_shard(platform: str) -> None:
+    """shard-check cost row: timeit min-of-5 (per the timing-noise rule —
+    tight per-call timing, never loop differencing) of the FULL flagship
+    static analysis: abstract params + adam-state placement + kv-pool tier
+    + findings over a virtual (dp=1, fsdp=2, tp=2) mesh. Pure host work;
+    the ratio framing is vs the toy train step the other overhead rows
+    use, not an absolute wall-clock gate."""
+    import timeit
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.analysis.shardplan import analyze_plan
+    from accelerate_tpu.models.llama import (
+        LLAMA_PARTITION_RULES,
+        LlamaConfig,
+        init_llama_params,
+    )
+
+    config = LlamaConfig.flagship_700m()
+    params = jax.eval_shape(
+        lambda key: init_llama_params(key, config, dtype=jnp.float32),
+        jax.random.PRNGKey(0),
+    )
+    kv_pool = dict(
+        num_layers=config.num_hidden_layers,
+        num_kv_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        num_slots=8,
+        block_size=16,
+        max_seq_len=512,
+    )
+
+    def check():
+        report = analyze_plan(
+            params, {"dp": 1, "fsdp": 2, "tp": 2},
+            rules=list(LLAMA_PARTITION_RULES), optimizer="adam",
+            kv_pool=kv_pool, hbm_gb=32.0,
+        )
+        assert report.findings == []  # a bench that times a broken plan lies
+        return report
+
+    check()  # warm optax/jax imports outside the timing
+    t = min(timeit.repeat(check, number=3, repeat=5)) / 3
+    print(f"BENCH_SHARD {t:.6f}")
+
+
 def _mode_goodput(platform: str) -> None:
     """Goodput-ledger row: a toy loop with telemetry + diagnostics writing
     real trace trails, then the ledger attributes the run's wall-clock.
@@ -1420,6 +1467,25 @@ def main():
     except Exception:
         pass
     try:
+        sh = _run_subprocess("shard", platform, attempts=2)
+        shard_s = float(sh["BENCH_SHARD"][0])
+        extra_rows.append(
+            {
+                "metric": "shard_check_seconds",
+                "value": round(shard_s, 4),
+                "unit": "s",
+                "note": "timeit min-of-5 (timing-noise rule) of the full "
+                "flagship shard-check: abstract param + adam-state "
+                "placement, kv-pool tier, SP findings over a virtual "
+                "dp=1/fsdp=2/tp=2 mesh. Pure host work, ratio framing: "
+                "a few hundred ms of pre-flight vs the multi-minute XLA "
+                "compile (or OOM'd job) it runs ahead of — no absolute "
+                "wall-clock gate",
+            }
+        )
+    except Exception:
+        pass
+    try:
         gp = _run_subprocess("goodput", platform, attempts=2)
         gp_pct, gp_elapsed = (float(v) for v in gp["BENCH_GOODPUT"][:2])
         gp_buckets = {
@@ -1590,6 +1656,7 @@ def main():
         "watchdog_overhead_pct": ("watchdog_overhead_pct", "value"),
         "metrics_overhead_pct": ("metrics_overhead_pct", "value"),
         "sanitize_overhead_pct": ("sanitize_overhead_pct", "value"),
+        "shard_check_seconds": ("shard_check_s", "value"),
         "goodput_pct": ("goodput_pct", "value"),
         "ckpt_save_seconds": ("ckpt_save_s", "value"),
         "ckpt_restore_seconds": ("ckpt_restore_s", "value"),
@@ -1632,8 +1699,8 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
-        "decode", "telemetry", "watchdog", "metrics", "sanitize", "goodput",
-        "ckpt", "serve", "spec", "route",
+        "decode", "telemetry", "watchdog", "metrics", "sanitize", "shard",
+        "goodput", "ckpt", "serve", "spec", "route",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -1650,6 +1717,7 @@ if __name__ == "__main__":
             "watchdog": _mode_watchdog,
             "metrics": _mode_metrics,
             "sanitize": _mode_sanitize,
+            "shard": _mode_shard,
             "goodput": _mode_goodput,
             "ckpt": _mode_ckpt,
             "serve": _mode_serve,

@@ -1,0 +1,76 @@
+"""``make shard-smoke``: the static sharding-plan pre-flight end to end.
+
+Four assertions, exit code is the CI signal:
+
+1. the clean flagship plan over a virtual (dp=1, fsdp=2, tp=2) mesh exits
+   0 through the REAL CLI with zero findings;
+2. a seeded dead partition rule exits 2 naming SP001;
+3. an over-budget ``--hbm-gb`` cap exits 2 naming SP004 with the tier
+   breakdown attached;
+4. ``--json`` round-trips: the payload parses, the tier totals sum to the
+   reported per-device bytes, and every finding carries a catalogued ID.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "shard-check", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240,
+    )
+
+
+def main() -> int:
+    # 1. clean plan exits 0
+    proc = _run("--preset", "flagship", "--virtual", "1,2,2", "--json")
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    clean = json.loads(proc.stdout)
+    assert clean["findings"] == [], clean["findings"]
+    assert set(clean["tiers"]) == {"params", "opt_state", "kv_pool"}, clean["tiers"]
+
+    # 2. seeded dead rule exits 2 naming SP001
+    proc = _run("--virtual", "1,2,2", "--json", "--extra-rule", "no_such_param=tp")
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+    payload = json.loads(proc.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"SP001"}, payload["findings"]
+
+    # 3. over-budget cap exits 2 naming SP004 with a tier breakdown
+    proc = _run("--preset", "flagship", "--virtual", "1,2,2", "--json",
+                "--hbm-gb", "0.5")
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+    payload = json.loads(proc.stdout)
+    sp004 = [f for f in payload["findings"] if f["rule"] == "SP004"]
+    assert sp004, payload["findings"]
+    assert sp004[0]["detail"]["tiers"]["opt_state"] > 0, sp004[0]
+
+    # 4. --json round-trips and is internally consistent
+    from accelerate_tpu.analysis.shardplan import SP_RULES
+
+    for payload in (clean, json.loads(proc.stdout)):
+        assert payload["bytes_per_device"] == sum(
+            t["bytes_per_device"] for t in payload["tiers"].values()
+        ), payload["tiers"]
+        assert all(f["rule"] in SP_RULES for f in payload["findings"])
+        assert payload["errors"] == sum(
+            1 for f in payload["findings"] if f["severity"] == "error"
+        )
+
+    print("SHARD_SMOKE_OK: clean plan exit 0, seeded SP001/SP004 exit 2, "
+          "--json round-trip consistent")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -258,6 +258,23 @@ def train_step(params, grads):
     return params - grads * big
 """,
     ),
+    "TPU012": (
+        """
+from jax.sharding import PartitionSpec as P
+
+PARTITION_RULES = [
+    ("wq", P(None, "model")),
+]
+""",
+        """
+from jax.sharding import PartitionSpec as P
+
+PARTITION_RULES = [
+    ("wq", P(None, "tp")),
+    ("embed", P("tp", "fsdp")),
+]
+""",
+    ),
 }
 
 
@@ -336,6 +353,39 @@ def loop(params, loader):
     return params
 """
         assert {f.rule for f in lint_source(src, "enum_idx.py")} == {"TPU010"}
+
+    def test_tpu012_local_mesh_axes_exempt(self):
+        """A file that constructs its own Mesh with custom axis names may
+        name them in PartitionSpec — the rule only polices axes no mesh in
+        sight defines."""
+        src = """
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.array(devices).reshape(2, 2), ("x", "y"))
+spec = P("x", "y")
+"""
+        assert lint_source(src, "custom_mesh.py") == []
+
+    def test_tpu012_make_mesh_axes_exempt(self):
+        """jax.make_mesh is the modern constructor — axes it declares are
+        just as legitimate as Mesh(...)'s."""
+        src = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+mesh = jax.make_mesh((2, 2), ("x", "y"))
+spec = P("x", "y")
+"""
+        assert lint_source(src, "make_mesh.py") == []
+
+    def test_tpu012_multi_axis_tuple_entry_checked(self):
+        src = """
+from jax.sharding import PartitionSpec as P
+
+spec = P(("dp", "model"), None)
+"""
+        assert {f.rule for f in lint_source(src, "tuple_axis.py")} == {"TPU012"}
 
     def test_tpu007_still_fires_on_stdlib_random(self):
         src = """
