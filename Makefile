@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -43,3 +43,6 @@ route-smoke:      ## 2-replica router fleet, mixed sticky/free traffic, kill -9 
 
 shard-smoke:      ## shard-check pre-flight: clean plan exits 0, seeded dead-rule/over-budget plans exit 2, --json round-trips
 	python benchmarks/shard_smoke.py
+
+radix-smoke:      ## shared-prefix trace hits the radix cache (>0 ratio, one decode executable); swap preemption finishes what out_of_blocks truncated
+	python benchmarks/radix_smoke.py

@@ -447,6 +447,31 @@ def plan_kv_pool(
     ]
 
 
+def plan_swap_pool(
+    num_layers: int,
+    num_kv_heads: int,
+    head_dim: int,
+    block_size: int,
+    swap_gb: float,
+    dtype: str = "float32",
+) -> dict:
+    """Host-DRAM footprint of the serving engine's KV swap tier
+    (``EngineConfig(swap_gb=...)``): the capacity-bounded NumPy mirror
+    preempted requests' unshared blocks are parked in. This is **host**
+    memory, deliberately excluded from the per-device HBM totals — it is
+    reported alongside them so an ``--hbm-gb`` pre-flight stays truthful
+    about where the swapped bytes actually live."""
+    block_shape = (num_layers, block_size, num_kv_heads, head_dim)
+    per_block = 2 * _leaf_nbytes(block_shape, dtype)  # K + V mirrors
+    blocks = max(0, int(swap_gb * (1 << 30)) // per_block) if per_block else 0
+    return {
+        "swap_gb": float(swap_gb),
+        "swap_blocks": blocks,
+        "bytes_per_block": per_block,
+        "swap_pool_host_bytes": blocks * per_block,
+    }
+
+
 def plan_activation_estimate(
     apply_fn,
     params,
@@ -530,6 +555,9 @@ class PlanReport:
     leaves: list[LeafPlan]
     findings: list[PlanFinding]
     hbm_budget_bytes: int | None = None
+    #: host-DRAM tiers (the KV swap pool) — reported alongside HBM but
+    #: never summed into ``bytes_per_device`` (they live on the host)
+    host: dict | None = None
 
     @property
     def tiers(self) -> dict[str, dict[str, int]]:
@@ -554,6 +582,7 @@ class PlanReport:
             "devices": int(np.prod(list(self.mesh.values()))),
             "hbm_budget_bytes": self.hbm_budget_bytes,
             "bytes_per_device": self.bytes_per_device,
+            "host": self.host,
             "tiers": self.tiers,
             "errors": len(self.errors),
             "warnings": len(self.findings) - len(self.errors),
@@ -672,6 +701,7 @@ def analyze_plan(
     activations: dict | None = None,
     include_grads: bool = False,
     hbm_gb: float | None = None,
+    swap_gb: float | None = None,
     replicated_threshold_bytes: int = 16 << 20,
 ) -> PlanReport:
     """The full static pre-flight: tiers (params, optimizer state, grads,
@@ -707,6 +737,16 @@ def analyze_plan(
         ]
     if kv_pool:
         leaves += plan_kv_pool(mesh_sizes=sizes, **kv_pool)
+    host = None
+    if kv_pool and swap_gb:
+        host = plan_swap_pool(
+            num_layers=kv_pool["num_layers"],
+            num_kv_heads=kv_pool["num_kv_heads"],
+            head_dim=kv_pool["head_dim"],
+            block_size=kv_pool["block_size"],
+            swap_gb=swap_gb,
+            dtype=kv_pool.get("dtype", "float32"),
+        )
     if activations:
         leaves += plan_activation_estimate(mesh_sizes=sizes, **activations)
     budget = int(hbm_gb * (1 << 30)) if hbm_gb is not None else None
@@ -717,7 +757,10 @@ def analyze_plan(
         hbm_budget_bytes=budget,
         replicated_threshold_bytes=replicated_threshold_bytes,
     )
-    return PlanReport(mesh=sizes, leaves=leaves, findings=findings, hbm_budget_bytes=budget)
+    return PlanReport(
+        mesh=sizes, leaves=leaves, findings=findings,
+        hbm_budget_bytes=budget, host=host,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -869,6 +912,7 @@ def engine_preflight(
     pool_shape: tuple[int, ...],
     pool_dtype,
     hbm_budget_gb: float,
+    swap_gb: float | None = None,
 ) -> dict:
     """The serving engine's capacity check, run BEFORE the pools allocate:
     predicted per-device bytes of params (under the same planner
@@ -876,7 +920,11 @@ def engine_preflight(
 
     Returns ``{params_bytes, pool_bytes, total_bytes, budget_bytes,
     headroom_bytes, over}`` — the engine raises on ``over`` (the SP004
-    contract: refuse to start, don't OOM mid-request)."""
+    contract: refuse to start, don't OOM mid-request). With ``swap_gb``
+    set, ``swap_pool_host_bytes`` reports the host-DRAM swap tier's
+    footprint alongside — deliberately *excluded* from ``total_bytes`` (a
+    swapped block lives in host memory, not HBM), so the HBM pre-flight
+    stays truthful with swap on."""
     sizes = mesh_sizes_of(mesh) if mesh is not None else {ax: 1 for ax in MESH_AXES}
     param_plans = plan_params(params, sizes, rules=rules)
     params_bytes = sum(p.bytes_per_device for p in param_plans)
@@ -894,7 +942,7 @@ def engine_preflight(
     pool_bytes = sum(p.bytes_per_device for p in pool_plans)
     budget = int(hbm_budget_gb * (1 << 30))
     total = params_bytes + pool_bytes
-    return {
+    report = {
         "params_bytes": params_bytes,
         "pool_bytes": pool_bytes,
         "total_bytes": total,
@@ -902,6 +950,16 @@ def engine_preflight(
         "headroom_bytes": budget - total,
         "over": total > budget,
     }
+    if swap_gb:
+        report["swap_pool_host_bytes"] = plan_swap_pool(
+            num_layers=pool_shape[0],
+            num_kv_heads=pool_shape[3],
+            head_dim=pool_shape[4],
+            block_size=pool_shape[2],
+            swap_gb=swap_gb,
+            dtype=str(np.dtype(pool_dtype)),
+        )["swap_pool_host_bytes"]
+    return report
 
 
 def auto_num_blocks(

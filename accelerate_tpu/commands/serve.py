@@ -2,9 +2,12 @@
 JSONL on stdin or a local HTTP endpoint.
 
 Request protocol (one JSON object per line / per POST body):
-``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>}``;
+``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>,
+"priority": "interactive"|"batch"?}``;
 each completion is written back as
-``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``.
+``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``. ``priority``
+defaults to ``interactive``; under pool pressure the scheduler swaps
+``batch`` victims to host DRAM before ever touching interactive ones.
 Prompts are raw token ids — tokenization is deliberately out of scope (the
 engine is model-zoo-generic and this box ships no tokenizer assets).
 
@@ -207,6 +210,8 @@ def _make_engine(args):
             seed=args.seed,
             max_new_tokens=args.max_new_tokens,
             hbm_budget_gb=args.hbm_gb,
+            prefix_cache=args.prefix_cache,
+            swap_gb=args.swap_gb,
         ),
         mesh=mesh,
     )
@@ -253,7 +258,8 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None):
                 payload, cb = inbox.get_nowait()
                 try:
                     req = engine.add_request(
-                        payload["prompt"], payload.get("max_new_tokens")
+                        payload["prompt"], payload.get("max_new_tokens"),
+                        priority=payload.get("priority", "interactive"),
                     )
                 except Exception as e:  # noqa: BLE001 — reported, not fatal
                     req_id = payload.get("id") if isinstance(payload, dict) else None
@@ -527,6 +533,32 @@ def add_parser(subparsers):
                    "the chosen count + predicted headroom")
     p.add_argument("--max-new-tokens", type=int, default=64,
                    help="default output budget when a request omits it")
+    # prefix sharing + swap preemption knobs (env defaults let a fleet
+    # flip them without touching every replica's command line). Parsed
+    # defensively: add_parser runs while building EVERY subcommand's
+    # parser, so a malformed env value must warn, not kill `monitor`.
+    prefix_env = os.environ.get("ACCELERATE_SERVE_PREFIX_CACHE", "1")
+    p.add_argument("--prefix-cache", dest="prefix_cache", action="store_true",
+                   default=prefix_env.strip().lower()
+                   not in ("0", "false", "no", "off", ""),
+                   help="radix prefix sharing over the block pool (default "
+                   "on; env ACCELERATE_SERVE_PREFIX_CACHE=0 disables)")
+    p.add_argument("--no-prefix-cache", dest="prefix_cache", action="store_false",
+                   help="disable prefix sharing (every prompt prefills cold)")
+    try:
+        swap_default = float(os.environ.get("ACCELERATE_SERVE_SWAP_GB", "0") or 0)
+    except ValueError:
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_SWAP_GB="
+            f"{os.environ['ACCELERATE_SERVE_SWAP_GB']!r} (want GiB as a float)",
+            file=sys.stderr,
+        )
+        swap_default = 0.0
+    p.add_argument("--swap-gb", type=float, default=swap_default,
+                   help="host-DRAM KV swap tier in GiB (default 0 = off; env "
+                   "ACCELERATE_SERVE_SWAP_GB): under pool exhaustion the "
+                   "lowest-priority request is swapped out instead of being "
+                   "truncated with finish_reason=out_of_blocks")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
                    help="enable sampling at this temperature (default: greedy)")

@@ -122,6 +122,15 @@ def shard_check_command(args) -> int:
         rules = extra + rules  # prepended: extra rules take priority
 
     kv_pool = None
+    if args.no_serve_pool and args.swap_gb:
+        # the host tier's geometry comes from the serve pool's; pricing it
+        # without that tier would be a guess — say so instead of silently
+        # dropping an explicitly requested number from the pre-flight
+        print(
+            "shard-check: --swap-gb needs the serve-pool tier for its block "
+            "geometry; ignored with --no-serve-pool",
+            file=sys.stderr,
+        )
     if not args.no_serve_pool:
         kv_pool = dict(
             num_layers=config.num_hidden_layers,
@@ -158,6 +167,7 @@ def shard_check_command(args) -> int:
             activations=activations,
             include_grads=include_grads,
             hbm_gb=args.hbm_gb,
+            swap_gb=args.swap_gb,
             replicated_threshold_bytes=int(args.replicated_threshold_mb * (1 << 20)),
         )
     except ValueError as e:
@@ -211,6 +221,12 @@ def shard_check_command(args) -> int:
         total = report.bytes_per_device / gib
         budget = f" / budget {args.hbm_gb:.3f} GiB" if args.hbm_gb is not None else ""
         print(f"  {'TOTAL':12s} {total:8.3f} GiB/device{budget}")
+        if report.host:
+            print(
+                f"  {'kv_swap':12s} {report.host['swap_pool_host_bytes'] / gib:8.3f} GiB "
+                f"host DRAM ({report.host['swap_blocks']} blocks — excluded "
+                "from the HBM budget)"
+            )
         for f in findings:
             print(f.render())
         print(
@@ -262,6 +278,11 @@ def add_parser(subparsers):
                    help="paged pool blocks (default: full residency)")
     p.add_argument("--no-serve-pool", action="store_true",
                    help="drop the paged KV pool tier (training-only plan)")
+    p.add_argument("--swap-gb", type=float, default=None,
+                   help="serving KV swap tier (EngineConfig(swap_gb=...)): "
+                   "report its host-DRAM footprint alongside the HBM tiers "
+                   "(never counted against --hbm-gb — swapped blocks live "
+                   "on the host)")
     # training estimate tier
     p.add_argument("--batch", type=int, default=None,
                    help="global batch size: adds gradient + activation-"

@@ -219,6 +219,12 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "ttft_p99_s": (
                 ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))] if ttfts else None
             ),
+            # prefix-cache + swap-preemption health (cumulative step-row
+            # counters, so the bounded tail still shows run totals)
+            "prefix_hit_ratio": last_step.get("prefix_hit_ratio"),
+            "preemptions": last_step.get("preemptions"),
+            "swapped_out_blocks": last_step.get("swapped_out_blocks"),
+            "out_of_blocks_total": last_step.get("out_of_blocks_total"),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -347,6 +353,13 @@ def render_status(status: dict[str, Any]) -> str:
             f"p99 {_fmt(srv.get('ttft_p99_s'), '{:.2f}')}s)   "
             f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
         )
+        if srv.get("prefix_hit_ratio") is not None or srv.get("preemptions"):
+            lines.append(
+                f"  prefix cache: hit {_fmt(srv.get('prefix_hit_ratio'), '{:.0%}')}   "
+                f"preemptions {_fmt(srv.get('preemptions'), '{}')}   "
+                f"swapped-out blocks {_fmt(srv.get('swapped_out_blocks'), '{}')}   "
+                f"out-of-blocks {_fmt(srv.get('out_of_blocks_total'), '{}')}"
+            )
     fleet = status.get("fleet")
     if fleet:
         lines.append(f"  fleet ({len(fleet)} replica(s)):")

@@ -104,6 +104,27 @@ def observe_record(registry, record: dict) -> None:
         )
 
 
+#: prefix-sharing/preemption counters shared by the telemetry step-row path
+#: (_observe_serving) and the live stats()-dict path (observe_engine_stats) —
+#: one table, so the two export surfaces can never silently diverge
+_SHARING_COUNTERS = (
+    ("prefix_hit_tokens", "serving_prefix_hit_tokens",
+     "Prompt tokens mapped from the radix prefix cache"),
+    ("preemptions", "serving_preemptions",
+     "Requests swapped to host DRAM under pool pressure"),
+    ("swapped_out_blocks", "serving_swapped_out_blocks",
+     "KV blocks device_get-swapped to the host pool"),
+    ("swapped_in_blocks", "serving_swapped_in_blocks",
+     "KV blocks restored from the host pool on re-admission"),
+    ("out_of_blocks_total", "serving_out_of_blocks",
+     "Requests truncated with finish_reason=out_of_blocks (last resort)"),
+)
+_PREFIX_HIT_GAUGE = (
+    "prefix_hit_ratio", "serving_prefix_hit_ratio",
+    "Prompt tokens served from the radix prefix cache (fraction)",
+)
+
+
 def _observe_serving(registry, record: dict) -> None:
     kind = record.get("kind")
     if kind == "request":
@@ -131,17 +152,18 @@ def _observe_serving(registry, record: dict) -> None:
             ("active_slots", "serving_active_slots", "Decode slots holding a live request"),
             ("slot_occupancy", "serving_slot_occupancy", "Fraction of decode slots busy"),
             ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
+            _PREFIX_HIT_GAUGE,
         ):
             if _num(record.get(field)) is not None:
                 registry.gauge(name, help).set(record[field])
-        if _num(record.get("decode_compiles")) is not None:
-            registry.counter(
-                "serving_decode_compiles", "Decode executable re-traces"
-            ).set_total(record["decode_compiles"])
-        if _num(record.get("completed_total")) is not None:
-            registry.counter(
-                "serving_completed", "Engine-reported completed requests (cumulative)"
-            ).set_total(record["completed_total"])
+        for field, name, help in (
+            ("decode_compiles", "serving_decode_compiles", "Decode executable re-traces"),
+            ("completed_total", "serving_completed",
+             "Engine-reported completed requests (cumulative)"),
+            *_SHARING_COUNTERS,
+        ):
+            if _num(record.get(field)) is not None:
+                registry.counter(name, help).set_total(record[field])
 
 
 def observe_span(registry, name: str, seconds: float) -> None:
@@ -186,3 +208,9 @@ def observe_engine_stats(registry, stats: dict) -> None:
         registry.counter("serving_iterations", "Engine scheduler iterations").set_total(
             stats["iterations"]
         )
+    field, name, help = _PREFIX_HIT_GAUGE
+    if _num(stats.get(field)) is not None:
+        registry.gauge(name, help).set(stats[field])
+    for field, name, help in _SHARING_COUNTERS:
+        if _num(stats.get(field)) is not None:
+            registry.counter(name, help).set_total(stats[field])

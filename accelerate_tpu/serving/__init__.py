@@ -11,7 +11,8 @@ names are NOT imported here eagerly — use
 
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .engine import EngineConfig, InferenceEngine
-from .scheduler import Request, RequestState, SlotScheduler
+from .radix import RadixCache, SwapPool
+from .scheduler import PRIORITY_CLASSES, Request, RequestState, SlotScheduler
 
 __all__ = [
     "NULL_BLOCK",
@@ -19,7 +20,10 @@ __all__ = [
     "blocks_needed",
     "EngineConfig",
     "InferenceEngine",
+    "PRIORITY_CLASSES",
+    "RadixCache",
     "Request",
     "RequestState",
     "SlotScheduler",
+    "SwapPool",
 ]

@@ -25,7 +25,15 @@ class BlockAllocator:
     """Freelist over pool blocks ``1 .. num_blocks-1`` (0 is the null
     block). Strict accounting: allocating more than is free raises, freeing
     a block that is not currently allocated (double-free, the null block, an
-    out-of-range id) raises — the engine's invariant tests lean on this."""
+    out-of-range id) raises — the engine's invariant tests lean on this.
+
+    Blocks are **refcounted** for prefix sharing (:mod:`.radix`): ``allocate``
+    hands a block out at refcount 1, ``incref`` adds a holder (a request
+    mapping a cached prefix block, or the radix cache itself), ``decref``
+    drops one and returns the block to the freelist only when the last
+    holder lets go. ``free`` keeps its PR 4 strictness and additionally
+    refuses a *shared* block (refcount > 1) — releasing a block other
+    requests still read must go through ``decref``, never a hard free."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -35,6 +43,7 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self._free: deque[int] = deque(range(1, self.num_blocks))
         self._allocated: set[int] = set()
+        self._refcounts: dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -58,16 +67,56 @@ class BlockAllocator:
             )
         blocks = [self._free.popleft() for _ in range(n)]
         self._allocated.update(blocks)
+        for b in blocks:
+            self._refcounts[b] = 1
         return blocks
 
-    def free(self, blocks: list[int]) -> None:
-        """Return blocks to the freelist; rejects double-frees and the null
-        block so leaks/corruption surface as exceptions, not wrong tokens."""
+    def refcount(self, block: int) -> int:
+        """Current holder count (0 for free / never-allocated blocks)."""
+        return self._refcounts.get(block, 0)
+
+    def _check_allocated(self, b: int, verb: str) -> None:
+        if b == NULL_BLOCK:
+            raise ValueError(f"cannot {verb} the null block")
+        if b not in self._allocated:
+            raise ValueError(f"double free (or never allocated): block {b}")
+
+    def incref(self, blocks: list[int]) -> None:
+        """Add one holder to each (already-allocated) block — a request
+        mapping a cached prefix, or the radix cache adopting a block."""
         for b in blocks:
-            if b == NULL_BLOCK:
-                raise ValueError("cannot free the null block")
-            if b not in self._allocated:
-                raise ValueError(f"double free (or never allocated): block {b}")
+            self._check_allocated(b, "share")
+            self._refcounts[b] += 1
+
+    def decref(self, blocks: list[int]) -> list[int]:
+        """Drop one holder from each block; blocks whose last holder left
+        return to the freelist. Returns the blocks actually freed. Dropping
+        a holder from a free block raises (the double-free invariant holds
+        for shared blocks too)."""
+        freed = []
+        for b in blocks:
+            self._check_allocated(b, "release")
+            self._refcounts[b] -= 1
+            if self._refcounts[b] == 0:
+                del self._refcounts[b]
+                self._allocated.remove(b)
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def free(self, blocks: list[int]) -> None:
+        """Return blocks to the freelist; rejects double-frees, the null
+        block, and **shared** blocks (refcount > 1 — another holder still
+        reads them; use :meth:`decref`) so leaks/corruption surface as
+        exceptions, not wrong tokens."""
+        for b in blocks:
+            self._check_allocated(b, "free")
+            if self._refcounts[b] > 1:
+                raise ValueError(
+                    f"cannot free shared block {b} "
+                    f"(refcount {self._refcounts[b]}): use decref"
+                )
+            del self._refcounts[b]
             self._allocated.remove(b)
             self._free.append(b)
 

@@ -36,8 +36,9 @@ from ..analysis.sanitizer import get_active_sanitizer as _get_sanitizer
 from ..diagnostics.tracing import trace_span
 from ..generation import _pick_traced
 from ..telemetry import get_active_recorder
-from .blocks import BlockAllocator, blocks_needed
-from .scheduler import Request, RequestState, SlotScheduler
+from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
+from .radix import RadixCache, SwapPool
+from .scheduler import Request, RequestState, SlotScheduler, priority_rank
 
 
 @dataclass
@@ -75,6 +76,18 @@ class EngineConfig:
     #: it — the capacity-planning contract: fail at bring-up, not OOM
     #: mid-request
     hbm_budget_gb: float | None = None
+    #: radix prefix sharing (:mod:`.radix`): admission maps a request's
+    #: longest cached prompt prefix into its block table at refcount+1 and
+    #: chunk-prefills only the tail; finished prompts' full blocks stay
+    #: cached (LRU-evicted under pool pressure). Sharing edits only block
+    #: tables and refcounts — the one-compiled-executable contract holds.
+    prefix_cache: bool = True
+    #: host-DRAM swap tier in GiB (0 disables): under pool exhaustion the
+    #: lowest-priority victim's unshared blocks are device_get-swapped to
+    #: a :class:`~.radix.SwapPool` and the request re-queues at the front
+    #: of its class; ``finish_reason="out_of_blocks"`` truncation becomes
+    #: the last resort for when even swap capacity is gone.
+    swap_gb: float = 0.0
 
     @property
     def blocks_per_slot(self) -> int:
@@ -138,8 +151,21 @@ class InferenceEngine:
             self._hbm_preflight(inner, shape, dtype, mesh)
 
         self.allocator = BlockAllocator(num_blocks)
+        self.radix = (
+            RadixCache(self.allocator, cfg.block_size) if cfg.prefix_cache else None
+        )
+        self._swap = (
+            SwapPool(
+                num_layers=shape[0], block_size=cfg.block_size,
+                num_kv_heads=n_kv, head_dim=mcfg.head_dim,
+                dtype=dtype, capacity_gb=cfg.swap_gb,
+            )
+            if cfg.swap_gb and cfg.swap_gb > 0
+            else None
+        )
         self.scheduler = SlotScheduler(
-            cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len
+            cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len,
+            radix=self.radix,
         )
         self._kp = jnp.zeros(shape, dtype)
         self._vp = jnp.zeros(shape, dtype)
@@ -172,9 +198,33 @@ class InferenceEngine:
         self._completed: list[Request] = []
         self._last_stats_t: float | None = None
         self._last_stats_tokens = 0
+        # sharing / preemption counters (reset_stats zeroes them with the
+        # rest of the measurement state; the radix cache itself stays warm)
+        self._preemptions = 0
+        self._swapped_out_blocks = 0
+        self._swapped_in_blocks = 0
+        self._out_of_blocks_total = 0
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fn = self._build_prefill_fn()
+        # block-granular pool edits for CoW copies and swap restores:
+        # donated so XLA aliases the pool buffer instead of copying the
+        # whole pool per block. These are *separate* tiny executables —
+        # the one-compiled-DECODE-executable contract is about
+        # ``_decode_fn``, whose trace counter they never touch. Block ids
+        # ride as traced int32 scalars so every block reuses one compile.
+        self._copy_block_fn = jax.jit(
+            lambda pool, src, dst: pool.at[:, dst].set(pool[:, src]),
+            donate_argnums=(0,),
+        )
+        # batched restore: the id vector's length is padded to a power of
+        # two (pad entries scatter zeros into the null block, which is
+        # never attended), so the executable count stays O(log blocks),
+        # not one per distinct swap size
+        self._write_blocks_fn = jax.jit(
+            lambda pool, ids, rows: pool.at[:, ids].set(rows),
+            donate_argnums=(0,),
+        )
 
     def _place_on_mesh(self, inner) -> None:
         """GSPMD placement over ``self.mesh``: every device-side input to
@@ -223,6 +273,7 @@ class InferenceEngine:
             pool_shape,
             pool_dtype,
             self.config.hbm_budget_gb,
+            swap_gb=self.config.swap_gb or None,
         )
         self.hbm_preflight = report
         if report["over"]:
@@ -302,12 +353,14 @@ class InferenceEngine:
         prompt,
         max_new_tokens: int | None = None,
         arrival_time: float | None = None,
+        priority: str = "interactive",
     ) -> Request:
         req = Request(
             prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
             max_new_tokens=int(
                 self.config.max_new_tokens if max_new_tokens is None else max_new_tokens
             ),
+            priority=priority,
         )
         if arrival_time is not None:
             req.arrival_time = arrival_time
@@ -324,7 +377,7 @@ class InferenceEngine:
 
         with trace_span("serve/schedule"):
             sched.evict_finished()
-            sched.admit()
+            self._admit_and_place()
 
         with trace_span("serve/prefill"):
             # one chunk per PREFILLING SLOT per iteration: slot turnover is
@@ -384,26 +437,66 @@ class InferenceEngine:
         self._completed = []
         self._last_stats_t = None
         self._last_stats_tokens = 0
+        self._preemptions = 0
+        self._swapped_out_blocks = 0
+        self._swapped_in_blocks = 0
+        self._out_of_blocks_total = 0
+        # hit accounting restarts with the measurement window; the trie and
+        # its cached blocks deliberately stay warm (steady-state behaviour
+        # is what a warmed bench leg measures)
+        self.scheduler.prompt_tokens_admitted = 0
+        self.scheduler.prefix_hit_tokens = 0
+        if self.radix is not None:
+            self.radix.evicted_blocks = 0
+            self.radix.inserted_blocks = 0
 
     def stats(self) -> dict:
         """Aggregate serving health: goodput, TTFT/TPOT percentiles over
         completed requests, mean slot occupancy, and the compile counters
         the one-executable contract is asserted against."""
+        sched = self.scheduler
+        cached = self.radix.cached_block_count if self.radix is not None else 0
+        cached_exclusive = (
+            self.radix.exclusive_block_count() if self.radix is not None else 0
+        )
         out = {
             "iterations": self._iterations,
             "completed": len(self._completed),
-            "queue_depth": self.scheduler.queue_depth,
-            "active_slots": len(self.scheduler.active()),
+            "queue_depth": sched.queue_depth,
+            "active_slots": len(sched.active()),
             "num_slots": self.config.num_slots,
             "tokens_emitted": self._tokens_emitted,
             "decode_compiles": self._decode_traces,
             "prefill_compiles": self._prefill_traces,
             "free_blocks": self.allocator.free_count,
-            "allocated_blocks": self.allocator.allocated_count,
+            # blocks live requests hold (shared prefix blocks included);
+            # blocks held ONLY by the radix cache are reported separately —
+            # at idle, allocated_blocks is 0 and free + cached == usable
+            "allocated_blocks": self.allocator.allocated_count - cached_exclusive,
+            "cached_blocks": cached,
             "slot_occupancy_mean": (
                 self._occupancy_sum / self._iterations if self._iterations else 0.0
             ),
+            "prefix_hit_tokens": sched.prefix_hit_tokens,
+            "prefix_hit_ratio": (
+                sched.prefix_hit_tokens / sched.prompt_tokens_admitted
+                if sched.prompt_tokens_admitted
+                else 0.0
+            ),
+            "preemptions": self._preemptions,
+            "swapped_out_blocks": self._swapped_out_blocks,
+            "swapped_in_blocks": self._swapped_in_blocks,
+            "out_of_blocks_total": self._out_of_blocks_total,
         }
+        if self.radix is not None:
+            out["radix_inserted_blocks"] = self.radix.inserted_blocks
+            out["radix_evicted_blocks"] = self.radix.evicted_blocks
+        if self._swap is not None:
+            out["swap_used_blocks"] = self._swap.used_blocks
+            out["swap_capacity_blocks"] = self._swap.capacity_blocks
+            out["swap_pool_host_bytes"] = (
+                self._swap.capacity_blocks * self._swap.bytes_per_block
+            )
         if self.mesh is not None:
             from ..mesh import mesh_axis_sizes
 
@@ -432,6 +525,123 @@ class InferenceEngine:
 
     # -- iteration internals -------------------------------------------------
 
+    def _admit_and_place(self) -> None:
+        """Admission plus its device obligations (CoW copies, swap-in
+        restores), looped with priority preemption: when the head of the
+        waiting queue outranks a running request and cannot be admitted
+        (no slot, or no blocks even after cache eviction), the
+        lowest-priority victim is swapped to host DRAM and admission
+        retries. Strictly-higher rank only — equal classes never thrash
+        each other at admission."""
+        sched = self.scheduler
+        while True:
+            for req in sched.admit():
+                self._place_admitted(req)
+            head = sched.peek_head()
+            if head is None or self._swap is None:
+                return
+            victim = sched.pick_victim()
+            if victim is None or priority_rank(victim.priority) <= priority_rank(
+                head.priority
+            ):
+                return
+            if not self._swap_out(victim):
+                return  # swap full: the head waits its turn
+
+    def _place_admitted(self, req: Request) -> None:
+        """The device half of admission: restore a preempted request's
+        swapped rows into its freshly allocated blocks, or run the pending
+        copy-on-write block copy for a partial-prefix hit."""
+        if req.swap_plan:
+            # one gathered scatter per pool (mirrors _swap_out's batched
+            # device_get), padded with null-block zero rows
+            n = len(req.swap_plan)
+            m = 1 << max(0, (n - 1).bit_length())
+            layers, _, bs, kv, hd = self._kp.shape
+            dtype = np.dtype(self._kp.dtype)
+            ids = np.full((m,), NULL_BLOCK, np.int32)
+            k_rows = np.zeros((layers, m, bs, kv, hd), dtype)
+            v_rows = np.zeros_like(k_rows)
+            for j, (idx, handle) in enumerate(req.swap_plan):
+                ids[j] = req.blocks[idx]
+                k, v = self._swap.load(handle)
+                k_rows[:, j] = k
+                v_rows[:, j] = v
+            self._kp = self._write_blocks_fn(self._kp, ids, k_rows)
+            self._vp = self._write_blocks_fn(self._vp, ids, v_rows)
+            for _, handle in req.swap_plan:
+                self._swap.release(handle)
+            self._swapped_in_blocks += n
+            req.swap_plan = []
+            req.preempted = False
+            if req.state is RequestState.DECODE:
+                # resume feeding the last emitted token at context_len
+                self._pending_tok[req.slot] = req.output_tokens[-1]
+        elif req.cow is not None:
+            src, dst = req.cow
+            self._kp = self._copy_block_fn(self._kp, np.int32(src), np.int32(dst))
+            self._vp = self._copy_block_fn(self._vp, np.int32(src), np.int32(dst))
+            self.allocator.decref([src])  # drop the eviction pin
+            req.cow = None
+
+    def _swap_out(self, victim: Request) -> bool:
+        """Preempt ``victim``: device_get its unshared blocks into the host
+        swap pool, release them, free the slot, and re-queue the request at
+        the front of its priority class. "Unshared" means no *other live
+        request* reads the block: a block shared only with the radix cache
+        is swapped too (the victim's reference drops; the cache's copy
+        stays resident at refcount 1, LRU-evictable — retaining it under
+        the victim's ref would pin capacity the preemption exists to
+        free). Blocks another live request maps keep the victim's
+        reference and stay resident — their HBM is shared anyway. Returns
+        False when the swap pool cannot hold the victim (caller falls back
+        to truncation or waiting)."""
+        swappable = []
+        for i, b in enumerate(victim.blocks):
+            rc = self.allocator.refcount(b)
+            if rc == 1 or (
+                rc == 2 and self.radix is not None and self.radix.is_cached(b)
+            ):
+                swappable.append(i)
+        if self._swap is None or not self._swap.can_hold(len(swappable)):
+            return False
+        plan: list[tuple[int, int]] = []
+        released = [victim.blocks[i] for i in swappable]
+        if released:
+            # one gathered transfer per pool, not 2 round trips per block;
+            # ids padded to a power of two (null-block reads, rows
+            # discarded host-side) so the gather compiles O(log blocks)
+            # executables, symmetric with _place_admitted's restore
+            n = len(released)
+            m = 1 << max(0, (n - 1).bit_length())
+            idx = np.full((m,), NULL_BLOCK, np.int32)
+            idx[:n] = released
+            k_rows = jax.device_get(self._kp[:, idx])  # [layers, m, bs, kv, hd]
+            v_rows = jax.device_get(self._vp[:, idx])
+            for j, i in enumerate(swappable):
+                plan.append((i, self._swap.store(k_rows[:, j], v_rows[:, j])))
+        # refcount-1 blocks return to the freelist; cache-shared ones stay
+        # allocated under the cache's own (now sole, evictable) reference
+        self.allocator.decref(released)
+        victim.swap_plan = plan
+        self.scheduler.requeue_preempted(victim)
+        self._preemptions += 1
+        self._swapped_out_blocks += len(plan)
+        return True
+
+    def _force_finish_out_of_blocks(
+        self, req: Request, finished: list[Request]
+    ) -> None:
+        req.finish_reason = "out_of_blocks"
+        req.finish_time = time.perf_counter()
+        req.state = RequestState.FINISHED
+        self._out_of_blocks_total += 1
+        finished.append(req)
+        # free the blocks NOW (not at next step's evict sweep) so the
+        # requests this truncation is making room for can grow this
+        # iteration
+        self.scheduler.evict_finished()
+
     def _sync_block_table(self, req: Request) -> None:
         row = self._block_tables[req.slot]
         row[:] = 0
@@ -458,13 +668,72 @@ class InferenceEngine:
         )
         req.prefill_pos = end
         if is_final:
+            if self.radix is not None:
+                # the prompt's full blocks now hold valid K/V: adopt them
+                # into the prefix trie (refcount+1 = the cache's reference)
+                # so later admissions with the same leading tokens map them
+                self.radix.insert(req.prompt, req.blocks)
             self._emit_token(req, int(tok), finished)
             if req.state is not RequestState.FINISHED:
                 req.state = RequestState.DECODE
 
+    def _ensure_decode_capacity(self, req: Request, finished: list[Request]) -> None:
+        """Growth for one decode lane, with swap preemption under pool
+        exhaustion. Eviction of refcount-1 cached blocks happens inside
+        ``grow_for_decode``; when even that fails, the lowest-priority
+        victim (possibly ``req`` itself — a request never preempts a
+        *higher*-priority one) is swapped to host DRAM and growth retries.
+        Truncation (``out_of_blocks``) is the last resort: swap disabled or
+        full, or ``req`` alone in the pool with nothing left to reclaim."""
+        sched = self.scheduler
+        burst = self.config.decode_burst
+        while not sched.grow_for_decode(req, tokens_ahead=burst):
+            if self._swap is None:
+                # no swap tier: keep PR 4's FCFS contract — the request
+                # that failed to grow is the one truncated, never an
+                # innocent neighbor that fit its reservation
+                self._force_finish_out_of_blocks(req, finished)
+                return
+            victim = sched.pick_victim() or req
+            if priority_rank(victim.priority) < priority_rank(req.priority):
+                victim = req  # never evict someone more important than req
+            if victim is req and len(sched.active()) <= 1:
+                # req is the sole tenant. Swapping itself out only helps if
+                # something else would run first — a strictly higher-priority
+                # waiting head admits before req's front-of-class re-queue.
+                # Otherwise req re-admits immediately and ping-pongs through
+                # the swap pool forever: the pool is simply too small for it,
+                # and truncation is the honest answer.
+                head = sched.peek_head()
+                if head is None or priority_rank(head.priority) >= priority_rank(
+                    req.priority
+                ):
+                    self._force_finish_out_of_blocks(req, finished)
+                    return
+            if not self._swap_out(victim):
+                # swap full: truncation may only roll downhill — a
+                # strictly lower-priority victim pays, equal priority
+                # keeps the requester-pays rule (no innocent neighbor
+                # truncated for a peer)
+                if priority_rank(victim.priority) > priority_rank(req.priority):
+                    self._force_finish_out_of_blocks(victim, finished)
+                    continue
+                self._force_finish_out_of_blocks(req, finished)
+                return
+            if victim is req:
+                return  # req is queued for re-admission; lane goes idle
+
     def _decode_once(self, decoding: list[Request], finished: list[Request]) -> None:
         cfg = self.config
         burst = cfg.decode_burst
+        # pass 1 — capacity: grow every lane (evicting cached blocks,
+        # preempting victims, truncating last-resort). A later lane's
+        # preemption may take an *earlier* lane out of its slot, so lane
+        # state is only materialised in pass 2, over the survivors.
+        for req in decoding:
+            if req.slot is None or req.state is not RequestState.DECODE:
+                continue  # preempted or force-finished by an earlier lane
+            self._ensure_decode_capacity(req, finished)
         pos0 = np.zeros((cfg.num_slots,), np.int32)
         active = np.zeros((cfg.num_slots, 1), bool)
         toks = np.zeros((cfg.num_slots, 1), np.int32)
@@ -473,11 +742,7 @@ class InferenceEngine:
             # the burst writes up to `burst` positions ahead (capped at the
             # request's own budget); lane-steps past the budget scatter into
             # the null block and are dropped host-side
-            if not self.scheduler.grow_for_decode(req, tokens_ahead=burst):
-                req.finish_reason = "out_of_blocks"
-                req.finish_time = time.perf_counter()
-                req.state = RequestState.FINISHED
-                finished.append(req)
+            if req.slot is None or req.state is not RequestState.DECODE:
                 continue
             self._sync_block_table(req)
             pos0[req.slot] = req.context_len
@@ -595,17 +860,28 @@ class InferenceEngine:
             window_s = now - (self._last_stats_t or now)
             window_tokens = self._tokens_emitted - self._last_stats_tokens
             self._last_stats_t, self._last_stats_tokens = now, self._tokens_emitted
+            sched = self.scheduler
             tel.record_serving(
                 kind="step",
                 iteration=self._iterations,
                 tokens_per_sec=(window_tokens / window_s) if window_s > 0 else None,
-                queue_depth=self.scheduler.queue_depth,
-                active_slots=len(self.scheduler.active()),
-                slot_occupancy=self.scheduler.occupancy,
+                queue_depth=sched.queue_depth,
+                active_slots=len(sched.active()),
+                slot_occupancy=sched.occupancy,
                 free_blocks=self.allocator.free_count,
                 decode_compiles=self._decode_traces,
                 # cumulative totals: the monitor reads a bounded JSONL tail,
                 # so run-total counts must ride every row, not be re-counted
                 completed_total=len(self._completed),
                 tokens_total=self._tokens_emitted,
+                prefix_hit_tokens=sched.prefix_hit_tokens,
+                prefix_hit_ratio=(
+                    sched.prefix_hit_tokens / sched.prompt_tokens_admitted
+                    if sched.prompt_tokens_admitted
+                    else 0.0
+                ),
+                preemptions=self._preemptions,
+                swapped_out_blocks=self._swapped_out_blocks,
+                swapped_in_blocks=self._swapped_in_blocks,
+                out_of_blocks_total=self._out_of_blocks_total,
             )

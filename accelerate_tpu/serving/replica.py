@@ -19,6 +19,7 @@ import sys
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 
 from ..logging import get_logger
 
@@ -58,6 +59,10 @@ class ReplicaHandle:
         self.consecutive_failures = 0
         self.dispatched = 0
         self.completed = 0
+        # leading-block hashes of recently dispatched prompts: the router's
+        # prefix-affinity signal (this replica's radix cache is likely warm
+        # for these) — see Router._pick_replica
+        self.recent_prefixes: deque = deque(maxlen=128)
 
     # -- health --------------------------------------------------------------
 

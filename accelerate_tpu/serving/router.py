@@ -12,8 +12,18 @@ placement, affinity, retry, and drain:
   the fewest in-flight + queued + decoding requests;
 * **session affinity** — requests carrying a ``session_id`` stick to the
   replica that served the session before, so a multi-turn chat lands where
-  its KV prefix is warm (the substrate ROADMAP item 2's prefix cache will
-  exploit); affinity is *advisory* — a dead replica's sessions move on;
+  its KV prefix is warm; affinity is *advisory* — a dead replica's
+  sessions move on;
+* **prefix affinity** — a free request (no ``session_id``) prefers the
+  ready replica whose recent dispatches share its prompt's leading block
+  hash (the first :data:`AFFINITY_PREFIX_TOKENS` token ids), so requests
+  with a common system prompt land on the replica whose radix prefix
+  cache is already warm for it; falls back to least-loaded. Affinity
+  yields once the warm replica is more than ``affinity_load_slack``
+  requests busier than the fleet's least-loaded member — the spillover
+  replica then records the prefix on its own first dispatch and becomes
+  warm too, so a dominant system prompt scales across the fleet instead
+  of starving it onto one box;
 * **failure requeue** — a transport-level dispatch failure (the replica
   was killed mid-stream) re-enqueues the request at the *front* of the
   queue for a different replica; each request is delivered to its caller
@@ -46,6 +56,23 @@ logger = get_logger(__name__)
 ROUTER_SUBDIR = "router"
 #: schema stamp on every fleet row (readers skip newer-than-known rows)
 ROUTER_SCHEMA = 1
+#: leading token ids hashed into a request's prefix-affinity key — one
+#: engine block at the default block_size, the granularity the radix cache
+#: actually shares at
+AFFINITY_PREFIX_TOKENS = 16
+
+
+def _prefix_key(payload) -> tuple | None:
+    """Leading-block hash key of a request's prompt (None when the payload
+    has no usable prompt, or the prompt is too short to say anything about
+    prefix reuse — sub-block prompts hit nothing in the radix cache)."""
+    prompt = payload.get("prompt") if isinstance(payload, dict) else None
+    if not isinstance(prompt, (list, tuple)) or len(prompt) < AFFINITY_PREFIX_TOKENS:
+        return None
+    try:
+        return tuple(int(t) for t in prompt[:AFFINITY_PREFIX_TOKENS])
+    except (TypeError, ValueError):
+        return None
 
 
 @dataclass(eq=False)  # identity semantics: tickets live in per-replica sets
@@ -78,6 +105,9 @@ class Router:
             with an error (default: one try per replica + 1 retry).
         request_timeout: per-dispatch HTTP timeout (None = wait forever;
             a killed replica resets the connection immediately either way).
+        affinity_load_slack: how many requests busier than the fleet's
+            least-loaded replica a prefix-warm replica may be before
+            affinity yields to load balance (~one slot set's worth).
     """
 
     def __init__(
@@ -87,6 +117,7 @@ class Router:
         health_interval: float = 0.5,
         max_attempts: int | None = None,
         request_timeout: float | None = None,
+        affinity_load_slack: int = 8,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -95,6 +126,7 @@ class Router:
         self.health_interval = float(health_interval)
         self.max_attempts = max_attempts or len(replicas) + 2
         self.request_timeout = request_timeout
+        self.affinity_load_slack = int(affinity_load_slack)
         self._queue: deque[Ticket] = deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -150,8 +182,10 @@ class Router:
     # -- dispatch ------------------------------------------------------------
 
     def _pick_replica(self, ticket: Ticket) -> ReplicaHandle | None:
-        """Session affinity first, least-loaded ready replica otherwise.
-        Caller holds the lock."""
+        """Session affinity first, then prefix affinity (the replica whose
+        recent requests share this prompt's leading block hash — its radix
+        cache is warm for the prefix), least-loaded ready replica
+        otherwise. Caller holds the lock."""
         candidates = [r for r in self.replicas if r.is_dispatchable()]
         if not candidates:
             return None
@@ -161,7 +195,32 @@ class Router:
             for r in candidates:
                 if r.replica_id == mapped:
                     return r
-        chosen = min(candidates, key=lambda r: (r.load, r.replica_id))
+        key = _prefix_key(ticket.payload)
+        pool = candidates
+        if key is not None:
+            # affinity yields under skew: once every warm replica is more
+            # than the slack busier than the least-loaded member, spill —
+            # the spillover replica's own dispatch records the key, so a
+            # dominant prefix warms the fleet instead of starving it
+            floor = min(r.load for r in candidates)
+            warm = [
+                r for r in candidates
+                if key in r.recent_prefixes
+                and r.load <= floor + self.affinity_load_slack
+            ]
+            if warm:
+                pool = warm  # least-loaded among the warm replicas
+        chosen = min(pool, key=lambda r: (r.load, r.replica_id))
+        if key is not None:
+            # move-to-back on hit: recency must reflect USE, or a dominant
+            # prefix dispatched constantly ages out of the window behind
+            # 128 one-off prompts and affinity silently stops for exactly
+            # the workload it targets
+            try:
+                chosen.recent_prefixes.remove(key)
+            except ValueError:
+                pass
+            chosen.recent_prefixes.append(key)
         if sid is not None:
             self._sessions[sid] = chosen.replica_id
             chosen.sessions.add(sid)
@@ -300,6 +359,7 @@ class Router:
                 if self._sessions.get(sid) == replica.replica_id:
                     del self._sessions[sid]
             replica.sessions.clear()
+            replica.recent_prefixes.clear()  # its radix cache died with it
             # rescue the requests POSTed to it: a killed replica errors the
             # dispatch thread out on its own, but a wedged-alive one keeps
             # the socket open forever — requeue now, and the late dispatch

@@ -7,14 +7,24 @@ Python — admission, eviction, and block accounting never touch the
 compiled program, which is why the engine compiles exactly one decode
 executable for its lifetime.
 
-Policy (FCFS, no preemption):
+Policy (priority classes, prefix sharing, swap preemption):
 
 * **evict** — finished requests release their slot and KV blocks first, so
-  the capacity freed this iteration is admittable this iteration;
-* **admit** — queued requests enter free slots in arrival order when the
-  freelist covers their prompt (decode blocks are allocated incrementally
-  as generation crosses block boundaries, so admission only reserves the
-  prompt's footprint + one decode block);
+  the capacity freed this iteration is admittable this iteration; shared
+  blocks are *decref'd* (the radix cache or other requests keep them),
+  never hard-freed;
+* **admit** — queued requests enter free slots in (priority class,
+  arrival) order. Admission first maps the request's longest cached prefix
+  from the :class:`~.radix.RadixCache` at refcount+1, then allocates only
+  the tail; when the freelist is short, refcount-1 cached blocks are LRU
+  evicted before admission gives up. Head-of-line blocking is per-fleet
+  and intentional (no starvation of long prompts) — but the *engine* may
+  preempt a lower-priority running request to unblock a higher-priority
+  head (see ``InferenceEngine._admit_and_place``);
+* **preemption** — under pool exhaustion the engine swaps a victim
+  (:meth:`SlotScheduler.pick_victim`: lowest priority class first, latest
+  arrival within it) to the host-DRAM swap pool and the victim re-queues
+  at the *front* of its class via :meth:`requeue_preempted`;
 * a request whose prompt is still being chunk-prefilled occupies its slot
   in ``PREFILL`` state; the engine advances one chunk per iteration so a
   long prompt never stalls in-flight decodes.
@@ -29,6 +39,15 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from .blocks import BlockAllocator, blocks_needed
+
+#: admission-priority order, highest first (``interactive`` preempts
+#: ``batch``, never the reverse)
+PRIORITY_CLASSES = ("interactive", "batch")
+
+
+def priority_rank(priority: str) -> int:
+    """Smaller = more important. Unknown classes raise at submit()."""
+    return PRIORITY_CLASSES.index(priority)
 
 
 class RequestState(Enum):
@@ -47,10 +66,17 @@ class Request:
     ``output_tokens`` grows as the engine emits. Timing fields are
     ``time.perf_counter`` seconds: ``ttft_s`` spans arrival → first emitted
     token (queue wait + prefill included), ``tpot_s`` is the mean
-    inter-token interval after the first."""
+    inter-token interval after the first.
+
+    Prefix-sharing/preemption state: ``prefill_pos`` starts at the matched
+    prefix length (cached tokens are never re-prefilled); ``cow`` is a
+    ``(src_block, dst_block)`` device copy the engine owes before the first
+    prefill chunk; ``swap_plan`` is ``[(block_index, swap_handle), ...]``
+    for a preempted request's swapped-out rows, restored on re-admission."""
 
     prompt: list[int]
     max_new_tokens: int
+    priority: str = "interactive"  # see PRIORITY_CLASSES
     request_id: int = field(default_factory=lambda: next(_request_ids))
     arrival_time: float = field(default_factory=time.perf_counter)
     state: RequestState = RequestState.QUEUED
@@ -61,6 +87,11 @@ class Request:
     prefill_pos: int = 0  # prompt tokens whose K/V are already cached
     first_token_time: float | None = None
     finish_time: float | None = None
+    matched_tokens: int = 0  # prefix-cache hit length at admission
+    cow: tuple[int, int] | None = None  # (src, dst) pending device copy
+    swap_plan: list[tuple[int, int]] = field(default_factory=list)
+    preempted: bool = False
+    preemptions: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -86,22 +117,28 @@ class Request:
 
 
 class SlotScheduler:
-    """Owns the waiting queue, the slot table, and the block allocator."""
+    """Owns the waiting queues (one per priority class), the slot table,
+    the block allocator, and (optionally) the radix prefix cache."""
 
     def __init__(self, num_slots: int, allocator: BlockAllocator, block_size: int,
-                 max_seq_len: int):
+                 max_seq_len: int, radix=None):
         self.num_slots = int(num_slots)
         self.allocator = allocator
         self.block_size = int(block_size)
         self.max_seq_len = int(max_seq_len)
-        self.waiting: deque[Request] = deque()
+        self.radix = radix
+        self.waiting: dict[str, deque[Request]] = {p: deque() for p in PRIORITY_CLASSES}
         self.slots: list[Request | None] = [None] * self.num_slots
+        #: cumulative prompt tokens of admitted (fresh) requests — the
+        #: denominator of the prefix hit ratio
+        self.prompt_tokens_admitted = 0
+        self.prefix_hit_tokens = 0
 
     # -- queries -------------------------------------------------------------
 
     @property
     def queue_depth(self) -> int:
-        return len(self.waiting)
+        return sum(len(q) for q in self.waiting.values())
 
     def active(self, state: RequestState | None = None) -> list[Request]:
         reqs = [r for r in self.slots if r is not None]
@@ -114,11 +151,24 @@ class SlotScheduler:
         return sum(r is not None for r in self.slots) / self.num_slots
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(r is not None for r in self.slots)
+        return self.queue_depth > 0 or any(r is not None for r in self.slots)
+
+    def peek_head(self) -> Request | None:
+        """The next request admission would consider (highest nonempty
+        class, FCFS within it; preempted victims sit at the front)."""
+        for p in PRIORITY_CLASSES:
+            if self.waiting[p]:
+                return self.waiting[p][0]
+        return None
 
     # -- transitions ---------------------------------------------------------
 
     def submit(self, request: Request) -> Request:
+        if request.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {request.priority!r}: "
+                f"expected one of {PRIORITY_CLASSES}"
+            )
         total = request.prompt_len + request.max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(
@@ -140,43 +190,108 @@ class SlotScheduler:
                 f"only has {usable}: raise num_blocks or shrink the prompt"
             )
         request.state = RequestState.QUEUED
-        self.waiting.append(request)
+        self.waiting[request.priority].append(request)
         return request
 
+    def requeue_preempted(self, request: Request) -> None:
+        """A swapped-out victim goes back to the *front* of its class: it
+        already waited its turn once, and its swap handles hold host DRAM
+        that should drain as soon as capacity returns."""
+        self.slots[request.slot] = None
+        request.slot = None
+        request.state = RequestState.QUEUED
+        request.preempted = True
+        request.preemptions += 1
+        self.waiting[request.priority].appendleft(request)
+
     def evict_finished(self) -> list[Request]:
-        """Release slots + blocks of finished requests (engine marks them)."""
+        """Release slots + blocks of finished requests (engine marks them).
+        Blocks are decref'd: a block the radix cache (or another request)
+        still holds stays resident; the rest return to the freelist."""
         evicted = []
         for i, req in enumerate(self.slots):
             if req is not None and req.state is RequestState.FINISHED:
-                self.allocator.free(req.blocks)
+                self.allocator.decref(req.blocks)
                 req.blocks = []
                 req.slot = None
                 self.slots[i] = None
                 evicted.append(req)
         return evicted
 
+    def _ensure_free(self, need: int) -> bool:
+        """Freelist coverage for ``need`` blocks, LRU-evicting refcount-1
+        cached blocks to make room."""
+        short = need - self.allocator.free_count
+        if short > 0 and self.radix is not None:
+            self.radix.evict(short)
+        return self.allocator.can_allocate(need)
+
     def admit(self) -> list[Request]:
-        """FCFS admission into free slots, bounded by the block freelist.
-        Head-of-line blocking on blocks is intentional (no starvation of
-        long prompts); a free slot with an unaffordable head request stays
-        empty until eviction refills the freelist."""
+        """Priority-then-FCFS admission into free slots, bounded by the
+        block freelist (after radix eviction). Fresh requests map their
+        longest cached prefix at refcount+1 and allocate only the tail;
+        preempted requests re-allocate exactly their swapped-out blocks
+        (the engine restores the rows). Head-of-line blocking on blocks is
+        intentional; a free slot with an unaffordable head request stays
+        empty until eviction/preemption refills the freelist."""
         admitted = []
         free_slots = [i for i, r in enumerate(self.slots) if r is None]
-        while free_slots and self.waiting:
-            req = self.waiting[0]
-            # prompt footprint + the first decode block, so a request can
-            # always emit at least one token once admitted
-            need = max(blocks_needed(req.prompt_len + 1, self.block_size), 1)
-            if not self.allocator.can_allocate(need):
+        while free_slots:
+            req = self.peek_head()
+            if req is None:
                 break
-            self.waiting.popleft()
-            req.blocks = self.allocator.allocate(need)
+            if req.preempted:
+                need = len(req.swap_plan)
+                if not self._ensure_free(need):
+                    break
+                fresh = self.allocator.allocate(need)
+                for (idx, _handle), nb in zip(req.swap_plan, fresh):
+                    req.blocks[idx] = nb
+                req.state = (
+                    RequestState.PREFILL
+                    if req.prefill_pos < req.prompt_len
+                    else RequestState.DECODE
+                )
+            else:
+                total_need = max(
+                    blocks_needed(req.prompt_len + 1, self.block_size), 1
+                )
+                shared, matched, cow_src = [], 0, None
+                if self.radix is not None:
+                    shared, matched, cow_src = self.radix.acquire(req.prompt)
+                need = total_need - len(shared)
+                if not self._ensure_free(need):
+                    if self.radix is not None:
+                        self.radix.release_acquired(shared, cow_src)
+                    break
+                fresh = self.allocator.allocate(need)
+                req.blocks = shared + fresh
+                req.matched_tokens = matched
+                req.prefill_pos = matched
+                if cow_src is not None:
+                    # the engine copies src -> the first private block
+                    # before this request's first prefill chunk
+                    req.cow = (cow_src, fresh[0])
+                self.prompt_tokens_admitted += req.prompt_len
+                self.prefix_hit_tokens += matched
+                req.state = RequestState.PREFILL
+            self.waiting[req.priority].popleft()
             req.slot = free_slots.pop(0)
-            req.state = RequestState.PREFILL
-            req.prefill_pos = 0
             self.slots[req.slot] = req
             admitted.append(req)
         return admitted
+
+    def pick_victim(self) -> Request | None:
+        """Preemption order: lowest priority class first, latest arrival
+        within it (the youngest request has the least sunk prefill/decode
+        work and re-queues at the front of its class anyway)."""
+        cands = [
+            r for r in self.slots
+            if r is not None and r.state is not RequestState.FINISHED
+        ]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: (priority_rank(r.priority), r.arrival_time))
 
     def grow_for_decode(self, req: Request, tokens_ahead: int = 1) -> bool:
         """Ensure blocks exist for the next ``tokens_ahead`` cache writes
@@ -185,10 +300,11 @@ class SlotScheduler:
         own ``prompt + max_new`` budget (and the per-slot maximum): burst
         lane-steps past the budget may scatter into the null block, which
         is harmless, and allocating for them would truncate requests under
-        pool pressure whose real remaining tokens already fit. False = the
-        pool is exhausted; the engine force-finishes the request
-        (truncation is observable via ``finish_reason="out_of_blocks"`` —
-        with no preemption support, stalling could deadlock a full pool)."""
+        pool pressure whose real remaining tokens already fit. When the
+        freelist is dry, refcount-1 cached blocks are LRU-evicted first.
+        False = the pool is exhausted even after eviction; the engine
+        preempts a victim to the swap pool (or, with swap off/full,
+        force-finishes with ``finish_reason="out_of_blocks"``)."""
         need = blocks_needed(
             min(
                 req.context_len + tokens_ahead,
@@ -198,7 +314,7 @@ class SlotScheduler:
             self.block_size,
         )
         while len(req.blocks) < need:
-            if not self.allocator.can_allocate(1):
+            if not self._ensure_free(1):
                 return False
             req.blocks.extend(self.allocator.allocate(1))
         return True

@@ -74,6 +74,47 @@ def make_trace(
     return trace
 
 
+def make_shared_prefix_trace(
+    n_requests: int,
+    arrival_rate_per_s: float,
+    prefix_len: int,
+    tail_range: tuple[int, int],
+    mean_new_tokens: int,
+    max_new_cap: int,
+    vocab_size: int,
+    shared_frac: float = 0.8,
+    seed: int = 0,
+):
+    """The production-chat mix: ``shared_frac`` of requests open with ONE
+    common system prompt of ``prefix_len`` tokens followed by a short
+    unique tail; the rest are cold (fully random prompts of comparable
+    total length). Prefill work is prefix-dominated by construction, so a
+    prefix-sharing engine collapses TTFT on the shared fraction while the
+    no-sharing engine re-prefills the same tokens every time."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    system_prompt = rng.integers(0, vocab_size, size=prefix_len).astype(np.int32)
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_per_s, size=n_requests))
+    lo, hi = tail_range
+    trace = []
+    for t in arrivals:
+        tail_len = int(rng.integers(lo, hi + 1))
+        new = int(min(1 + rng.geometric(1.0 / mean_new_tokens), max_new_cap))
+        if rng.random() < shared_frac:
+            prompt = np.concatenate(
+                [system_prompt, rng.integers(0, vocab_size, size=tail_len).astype(np.int32)]
+            )
+        else:
+            prompt = rng.integers(
+                0, vocab_size, size=prefix_len + tail_len
+            ).astype(np.int32)
+        trace.append(
+            TraceRequest(arrival_s=float(t), prompt=prompt, max_new_tokens=new)
+        )
+    return trace
+
+
 def warm_engine(model, engine_config, trace):
     """Build the engine and compile its two programs on a dummy request."""
     from accelerate_tpu.serving import InferenceEngine
@@ -121,6 +162,8 @@ def run_engine_leg(model, engine_config, trace, engine=None) -> dict:
         "occupancy": stats["slot_occupancy_mean"],
         "decode_compiles": stats["decode_compiles"],
         "prefill_compiles": stats["prefill_compiles"],
+        "prefix_hit_ratio": stats.get("prefix_hit_ratio", 0.0),
+        "preemptions": stats.get("preemptions", 0),
     }
     for key in ("ttft_s", "tpot_s"):
         if key in stats:
@@ -290,10 +333,92 @@ def run(platform: str, legs: int = 3) -> dict:
     }
 
 
+def radix_workload(platform: str):
+    """(model, engine config, 80%-shared-prefix trace) for the prefix-
+    sharing leg. Prompts are prefix-dominated (the production chat shape);
+    tails and output budgets stay short so prefill — the work sharing
+    removes — is the bottleneck under load."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import EngineConfig
+
+    if platform == "cpu":  # smoke sizing (see default_workload's caveat)
+        config = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, seq=128)
+        model = LlamaForCausalLM.from_config(config, seed=0)
+        engine_cfg = EngineConfig(
+            num_slots=8, block_size=8, max_seq_len=128, prefill_chunk=16
+        )
+        trace = make_shared_prefix_trace(
+            n_requests=48, arrival_rate_per_s=500.0, prefix_len=64,
+            tail_range=(4, 12), mean_new_tokens=8, max_new_cap=24,
+            vocab_size=config.vocab_size,
+        )
+    else:
+        config = LlamaConfig.flagship_700m(max_position_embeddings=512)
+        model = LlamaForCausalLM.from_config(config, seed=0)
+        model.params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            model.params,
+        )
+        engine_cfg = EngineConfig(
+            num_slots=16, block_size=16, max_seq_len=512, prefill_chunk=128
+        )
+        trace = make_shared_prefix_trace(
+            n_requests=64, arrival_rate_per_s=400.0, prefix_len=256,
+            tail_range=(8, 48), mean_new_tokens=24, max_new_cap=96,
+            vocab_size=config.vocab_size,
+        )
+    return model, engine_cfg, trace
+
+
+def run_radix(platform: str, legs: int = 3) -> dict:
+    """Prefix sharing on vs off (the FCFS/no-sharing PR 4 engine) on the
+    SAME 80%-shared-prefix trace and model — interleaved R/C legs,
+    median-of-``legs`` per side, ratios only (the timing-noise rule). The
+    sharing engine's radix cache warms on leg 1 and stays warm (the
+    steady-state a long-lived server sits in); both engines keep the
+    one-decode-executable contract, asserted inside every leg."""
+    from dataclasses import replace
+
+    model, engine_cfg, trace = radix_workload(platform)
+    sharing_cfg = replace(engine_cfg, prefix_cache=True)
+    cold_cfg = replace(engine_cfg, prefix_cache=False)
+    sharing_engine = warm_engine(model, sharing_cfg, trace)
+    cold_engine = warm_engine(model, cold_cfg, trace)
+    share_legs, cold_legs = [], []
+    for _ in range(legs):
+        share_legs.append(run_engine_leg(model, sharing_cfg, trace, engine=sharing_engine))
+        cold_legs.append(run_engine_leg(model, cold_cfg, trace, engine=cold_engine))
+    share = sorted(share_legs, key=lambda r: r["serve_tok_s"])[legs // 2]
+    cold = sorted(cold_legs, key=lambda r: r["serve_tok_s"])[legs // 2]
+    return {
+        "sharing": share,
+        "no_sharing": cold,
+        "sharing_legs_tok_s": [round(r["serve_tok_s"], 1) for r in share_legs],
+        "no_sharing_legs_tok_s": [round(r["serve_tok_s"], 1) for r in cold_legs],
+        "radix_goodput_ratio": (
+            share["serve_tok_s"] / cold["serve_tok_s"]
+            if cold["serve_tok_s"] else None
+        ),
+        "prefix_hit_ratio": share["prefix_hit_ratio"],
+        "ttft_p50_sharing_s": share.get("ttft_s", {}).get("p50"),
+        "ttft_p50_cold_s": cold.get("ttft_s", {}).get("p50"),
+        "num_slots": engine_cfg.num_slots,
+        "block_size": engine_cfg.block_size,
+        "n_requests": len(trace),
+    }
+
+
 if __name__ == "__main__":
     import jax
 
     platform = jax.devices()[0].platform
-    result = run(platform)
+    if len(sys.argv) > 1 and sys.argv[1] == "radix":
+        result = run_radix(platform)
+    else:
+        result = run(platform)
     print(json.dumps(result, indent=2, default=float))
     sys.exit(0)

@@ -1,0 +1,459 @@
+"""Radix prefix-sharing cache + priority scheduler + swap preemption
+(``accelerate_tpu/serving/radix.py`` and friends).
+
+Host-side invariant tests (refcounts, trie matching/eviction, priority
+admission, victim ordering, swap-pool accounting) run in the tier-1 lane —
+pure Python, no compiles. Engine end-to-end proofs (prefix-hit logit
+parity, swap round-trip parity, priority preemption, pool pressure
+completing un-truncated) compile the tiny model and ride the slow lane
+like the rest of the serving suite.
+"""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.serving import (
+    BlockAllocator,
+    EngineConfig,
+    InferenceEngine,
+    RadixCache,
+    Request,
+    RequestState,
+    SlotScheduler,
+    SwapPool,
+    blocks_needed,
+)
+
+# ---------------------------------------------------------------------------
+# refcounted allocator (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_incref_decref_round_trip():
+    alloc = BlockAllocator(num_blocks=5)
+    blocks = alloc.allocate(2)
+    alloc.incref(blocks)  # second holder
+    assert all(alloc.refcount(b) == 2 for b in blocks)
+    assert alloc.decref(blocks) == []  # still held
+    assert alloc.free_count == 2
+    assert alloc.decref(blocks) == blocks  # last holder -> freelist
+    assert alloc.free_count == 4 and alloc.allocated_count == 0
+
+
+def test_free_shared_block_raises():
+    """Hard-freeing a block another holder still reads must raise — the
+    CoW/sharing invariant the whole cache leans on."""
+    alloc = BlockAllocator(num_blocks=5)
+    blocks = alloc.allocate(1)
+    alloc.incref(blocks)
+    with pytest.raises(ValueError, match="shared"):
+        alloc.free(blocks)
+    alloc.decref(blocks)
+    alloc.free(blocks)  # sole holder again: strict free works
+
+
+def test_decref_double_release_raises():
+    alloc = BlockAllocator(num_blocks=5)
+    blocks = alloc.allocate(1)
+    alloc.decref(blocks)
+    with pytest.raises(ValueError, match="double free"):
+        alloc.decref(blocks)
+    with pytest.raises(ValueError, match="null block"):
+        alloc.decref([0])
+
+
+# ---------------------------------------------------------------------------
+# radix trie (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _cache(num_blocks=17, block_size=4):
+    alloc = BlockAllocator(num_blocks)
+    return RadixCache(alloc, block_size), alloc
+
+
+def _insert_prompt(cache, alloc, tokens):
+    """Simulate a finished request: allocate its blocks, adopt the full
+    ones into the trie, then drop the request's own references."""
+    n = max(blocks_needed(len(tokens) + 1, cache.block_size), 1)
+    blocks = alloc.allocate(n)
+    cache.insert(tokens, blocks)
+    alloc.decref(blocks)
+    return blocks
+
+
+def test_match_full_blocks_and_cap():
+    cache, alloc = _cache()
+    _insert_prompt(cache, alloc, list(range(12)))  # 3 full blocks cached
+    # identical prompt: the cap leaves the final token to prefill — two
+    # full blocks match outright and the third contributes 3 of its 4
+    # tokens through the CoW path (11 of 12, never all 12)
+    blocks, matched, cow = cache.match(list(range(12)))
+    assert matched == 11 and len(blocks) == 2 and cow is not None
+    # longer prompt with the same prefix: all 3 full blocks match
+    blocks, matched, cow = cache.match(list(range(12)) + [99, 98])
+    assert matched == 12 and len(blocks) == 3 and cow is None
+    # divergent first block: no match
+    assert cache.match([7, 1, 2, 3, 4])[1] == 0
+
+
+def test_partial_block_match_returns_cow_source():
+    cache, alloc = _cache()
+    _insert_prompt(cache, alloc, list(range(8)))  # blocks (0-3), (4-7)
+    # agree through token 5, diverge at 6: one full block + 2 partial
+    prompt = [0, 1, 2, 3, 4, 5, 77, 78, 79]
+    blocks, matched, cow = cache.match(prompt)
+    assert len(blocks) == 1 and matched == 6
+    assert cow is not None  # the (4,5,6,7) node's block, to be copied
+    # acquire pins both the matched block and the CoW source
+    shared, m, cow2 = cache.acquire(prompt)
+    assert m == 6 and alloc.refcount(shared[0]) == 2 and alloc.refcount(cow2) == 2
+    cache.release_acquired(shared, cow2)
+    assert alloc.refcount(shared[0]) == 1 and alloc.refcount(cow2) == 1
+
+
+def test_lru_eviction_leaves_first_and_skips_shared():
+    cache, alloc = _cache(num_blocks=9, block_size=4)
+    _insert_prompt(cache, alloc, list(range(8)))       # chain A: a0 -> a1
+    _insert_prompt(cache, alloc, [50, 51, 52, 53])     # leaf B (younger)
+    assert cache.cached_block_count == 3
+    # touch chain A so B becomes the LRU leaf
+    cache.release_acquired(*cache.acquire(list(range(8)) + [99])[::2])
+    # a live request holds B's block: eviction must skip it
+    b_node = cache.root.children[(50, 51, 52, 53)]
+    alloc.incref([b_node.block])
+    assert cache.evict(10) == 2  # a1 then a0 (leaf-first), B protected
+    assert cache.cached_block_count == 1
+    alloc.decref([b_node.block])
+    assert cache.evict(1) == 1 and cache.cached_block_count == 0
+    assert alloc.allocated_count == 0  # everything back on the freelist
+
+
+def test_insert_keeps_existing_nodes():
+    cache, alloc = _cache()
+    first = _insert_prompt(cache, alloc, list(range(8)))
+    # a second request with the same prompt prefilled its own duplicate
+    # blocks: the cache keeps the original nodes, the duplicates stay out
+    dup = alloc.allocate(2)
+    assert cache.insert(list(range(8)), dup) == 0
+    node = cache.root.children[(0, 1, 2, 3)]
+    assert node.block == first[0]
+    assert alloc.refcount(dup[0]) == 1  # not adopted
+    alloc.free(dup)
+
+
+# ---------------------------------------------------------------------------
+# priority scheduler (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _sched(num_slots=2, num_blocks=9, block_size=8, max_seq=32, radix=False):
+    alloc = BlockAllocator(num_blocks)
+    cache = RadixCache(alloc, block_size) if radix else None
+    return SlotScheduler(num_slots, alloc, block_size, max_seq, radix=cache)
+
+
+def test_priority_admission_order():
+    """Interactive requests admit before earlier-arrived batch ones; FCFS
+    holds within a class."""
+    sched = _sched(num_slots=3)
+    b1 = sched.submit(Request(prompt=[1] * 4, max_new_tokens=4, priority="batch"))
+    b2 = sched.submit(Request(prompt=[2] * 4, max_new_tokens=4, priority="batch"))
+    i1 = sched.submit(Request(prompt=[3] * 4, max_new_tokens=4, priority="interactive"))
+    admitted = sched.admit()
+    assert [r.request_id for r in admitted] == [r.request_id for r in (i1, b1, b2)]
+
+
+def test_submit_rejects_unknown_priority():
+    sched = _sched()
+    with pytest.raises(ValueError, match="priority"):
+        sched.submit(Request(prompt=[1] * 4, max_new_tokens=4, priority="urgent"))
+
+
+def test_pick_victim_lowest_class_latest_arrival():
+    sched = _sched(num_slots=3, num_blocks=17)
+    i1 = sched.submit(Request(prompt=[1] * 4, max_new_tokens=4, priority="interactive"))
+    b1 = sched.submit(Request(prompt=[2] * 4, max_new_tokens=4, priority="batch"))
+    b2 = sched.submit(Request(prompt=[3] * 4, max_new_tokens=4, priority="batch"))
+    b1.arrival_time, b2.arrival_time = 1.0, 2.0
+    sched.admit()
+    assert sched.pick_victim() is b2  # batch before interactive, youngest first
+    b2.state = RequestState.FINISHED
+    sched.evict_finished()
+    assert sched.pick_victim() is b1
+    b1.state = RequestState.FINISHED
+    sched.evict_finished()
+    assert sched.pick_victim() is i1  # interactive only as a last resort
+
+
+def test_requeue_preempted_goes_to_class_front():
+    sched = _sched(num_slots=1)
+    b1 = sched.submit(Request(prompt=[1] * 4, max_new_tokens=4, priority="batch"))
+    sched.submit(Request(prompt=[2] * 4, max_new_tokens=4, priority="batch"))
+    sched.admit()
+    sched.requeue_preempted(b1)
+    assert b1.preempted and b1.slot is None and sched.peek_head() is b1
+    assert sched.waiting["batch"][0] is b1  # ahead of the never-run b2
+
+
+def test_prefix_aware_admission_maps_shared_blocks():
+    """Admission with a warm radix cache: the shared prefix arrives as
+    refcount-2 blocks, prefill_pos skips the matched tokens, and only the
+    tail is freshly allocated."""
+    sched = _sched(num_slots=2, num_blocks=17, block_size=8, max_seq=64, radix=True)
+    warm = sched.submit(Request(prompt=list(range(24)), max_new_tokens=4))
+    (req,) = sched.admit()
+    assert req is warm and req.matched_tokens == 0
+    sched.radix.insert(req.prompt, req.blocks)
+    req.state = RequestState.FINISHED
+    sched.evict_finished()
+
+    r2 = sched.submit(Request(prompt=list(range(24)) + [99] * 4, max_new_tokens=4))
+    (admitted,) = sched.admit()
+    assert admitted is r2
+    assert r2.matched_tokens == 24 and r2.prefill_pos == 24
+    total = max(blocks_needed(r2.prompt_len + 1, 8), 1)
+    assert len(r2.blocks) == total
+    assert all(sched.allocator.refcount(b) == 2 for b in r2.blocks[:3])
+    assert sched.prefix_hit_tokens == 24
+    assert sched.prompt_tokens_admitted == 24 + 28
+
+
+def test_grow_for_decode_evicts_cached_blocks():
+    """A dry freelist with evictable cached blocks is not exhaustion:
+    growth LRU-evicts refcount-1 cache blocks before giving up."""
+    sched = _sched(num_slots=1, num_blocks=5, block_size=8, max_seq=64, radix=True)
+    warm = sched.submit(Request(prompt=list(range(16)), max_new_tokens=4))
+    (req,) = sched.admit()  # 3 blocks (17 positions)
+    sched.radix.insert(req.prompt, req.blocks)
+    req.state = RequestState.FINISHED
+    sched.evict_finished()
+    assert sched.allocator.free_count == 2  # 2 of 4 held by the cache
+
+    r2 = sched.submit(Request(prompt=[99] * 16, max_new_tokens=24))
+    (r2a,) = sched.admit()  # cold: takes the 2 free + evicts 1 cached
+    assert r2a is r2 and len(r2.blocks) == 3
+    r2.prefill_pos = 16
+    r2.output_tokens = [1] * 9  # context 24: next write needs block 4
+    assert sched.grow_for_decode(r2, tokens_ahead=1)  # evicts the last cached
+    assert len(r2.blocks) == 4
+    assert sched.radix.cached_block_count == 0
+    assert not sched.grow_for_decode(r2, tokens_ahead=99)  # now truly full
+    assert warm.blocks == []  # eviction never resurrected the old request
+
+
+# ---------------------------------------------------------------------------
+# swap pool (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_swap_pool_round_trip_and_capacity():
+    pool = SwapPool(num_layers=2, block_size=4, num_kv_heads=2, head_dim=8,
+                    dtype=np.float32, capacity_gb=3 * 2 * 4 * (2 * 4 * 2 * 8) / (1 << 30))
+    assert pool.capacity_blocks == 3
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 4, 2, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 4, 2, 8)).astype(np.float32)
+    h = pool.store(k, v)
+    assert pool.used_blocks == 1 and pool.can_hold(2) and not pool.can_hold(3)
+    k2, v2 = pool.load(h)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    pool.release(h)
+    assert pool.used_blocks == 0
+    with pytest.raises(ValueError, match="double release"):
+        pool.release(h)
+    for _ in range(3):
+        pool.store(k, v)
+    with pytest.raises(RuntimeError, match="swap pool exhausted"):
+        pool.store(k, v)
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end (slow lane: compiles the tiny model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4, seq=96)
+    return LlamaForCausalLM.from_config(config, seed=0)
+
+
+GEOM = dict(num_slots=3, block_size=8, max_seq_len=64, prefill_chunk=8, decode_burst=2)
+
+
+def _drain(engine):
+    return engine.run_until_idle(max_iterations=5000)
+
+
+@pytest.mark.slow
+def test_prefix_hit_token_parity(tiny_model):
+    """A warm-cache admission (full-block hits) produces token-identical
+    greedy output to the no-sharing engine — the acceptance bar for
+    sharing never changing results."""
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 64, size=24).astype(np.int32)
+    p1 = np.concatenate([shared, rng.integers(0, 64, size=4).astype(np.int32)])
+    p2 = np.concatenate([shared, rng.integers(0, 64, size=5).astype(np.int32)])
+
+    eng = InferenceEngine(tiny_model, EngineConfig(**GEOM))
+    eng.add_request(p1, 6)
+    _drain(eng)
+    r2 = eng.add_request(p2, 6)
+    _drain(eng)
+    stats = eng.stats()
+    assert stats["prefix_hit_tokens"] == 24  # 3 full blocks of the prefix
+    assert stats["prefix_hit_ratio"] > 0
+    assert stats["decode_compiles"] == 1 and stats["prefill_compiles"] == 1
+
+    cold = InferenceEngine(tiny_model, EngineConfig(prefix_cache=False, **GEOM))
+    rc = cold.add_request(p2, 6)
+    _drain(cold)
+    assert r2.output_tokens == rc.output_tokens
+    # idle-engine invariant: every remaining allocation is cache-held
+    assert stats["allocated_blocks"] == 0
+    assert stats["cached_blocks"] > 0
+    assert stats["free_blocks"] + stats["cached_blocks"] == eng.allocator.num_blocks - 1
+
+
+@pytest.mark.slow
+def test_cow_partial_block_parity(tiny_model):
+    """A prompt diverging mid-block reuses the common rows via the CoW
+    copy and still matches the cold engine token-for-token."""
+    rng = np.random.default_rng(8)
+    p1 = rng.integers(0, 64, size=32).astype(np.int32)
+    p2 = p1.copy()
+    p2[20] = (p2[20] + 1) % 64  # diverge inside block 2 (tokens 16-23)
+
+    eng = InferenceEngine(tiny_model, EngineConfig(**GEOM))
+    eng.add_request(p1, 4)
+    _drain(eng)
+    r2 = eng.add_request(p2, 6)
+    _drain(eng)
+    stats = eng.stats()
+    assert stats["prefix_hit_tokens"] == 20  # 2 full blocks + 4 CoW tokens
+    assert stats["decode_compiles"] == 1
+
+    cold = InferenceEngine(tiny_model, EngineConfig(prefix_cache=False, **GEOM))
+    rc = cold.add_request(p2, 6)
+    _drain(cold)
+    assert r2.output_tokens == rc.output_tokens
+    # the pinned CoW source was released: nothing but the cache holds refs
+    assert stats["allocated_blocks"] == 0
+
+
+@pytest.mark.slow
+def test_swap_round_trip_parity_and_untruncated(tiny_model):
+    """THE acceptance scenario: a pool too small for both requests, where
+    the PR 4 engine answered out_of_blocks, now completes BOTH requests
+    fully via swap preemption — token-identical to a full-residency run —
+    while the no-swap engine still truncates (regression reference)."""
+    geom = dict(num_slots=2, block_size=8, max_seq_len=64, prefill_chunk=8,
+                prefix_cache=False)
+    prompts = [np.arange(8, dtype=np.int32), np.arange(8, dtype=np.int32) + 1]
+
+    def run(num_blocks=None, swap_gb=0.0):
+        eng = InferenceEngine(
+            tiny_model, EngineConfig(num_blocks=num_blocks, swap_gb=swap_gb, **geom)
+        )
+        reqs = [eng.add_request(p, max_new_tokens=30) for p in prompts]
+        _drain(eng)
+        return eng.stats(), reqs
+
+    # 5 usable blocks: each request needs 5 alone (38 positions), so they
+    # cannot both be resident — preemption or truncation must pick
+    no_swap_stats, no_swap = run(num_blocks=6)
+    assert any(r.finish_reason == "out_of_blocks" for r in no_swap)
+    assert no_swap_stats["out_of_blocks_total"] >= 1
+
+    swap_stats, swapped = run(num_blocks=6, swap_gb=0.01)
+    assert [r.finish_reason for r in swapped] == ["length", "length"]
+    assert all(len(r.output_tokens) == 30 for r in swapped)
+    assert swap_stats["preemptions"] >= 1
+    assert swap_stats["swapped_out_blocks"] == swap_stats["swapped_in_blocks"] > 0
+    assert swap_stats["out_of_blocks_total"] == 0
+    assert swap_stats["decode_compiles"] == 1
+    assert swap_stats["swap_used_blocks"] == 0  # every handle came home
+    assert swap_stats["allocated_blocks"] == 0
+
+    full_stats, full = run()
+    for s, f in zip(swapped, full):
+        assert s.output_tokens == f.output_tokens
+
+    # same pressure with the prefix cache ON (the default): a victim's
+    # cache-shared blocks are swapped as well — retaining them under the
+    # victim's ref would pin capacity and force the truncation swap exists
+    # to prevent (regression: the cache-only-shared pinning bug)
+    geom["prefix_cache"] = True
+    cache_stats, cached = run(num_blocks=6, swap_gb=0.01)
+    assert [r.finish_reason for r in cached] == ["length", "length"]
+    assert cache_stats["out_of_blocks_total"] == 0
+    assert cache_stats["decode_compiles"] == 1
+    for s, f in zip(cached, full):
+        assert s.output_tokens == f.output_tokens
+
+
+@pytest.mark.slow
+def test_priority_preemption_ordering(tiny_model):
+    """An interactive arrival under pool pressure swaps out the youngest
+    BATCH request — never another interactive one, never itself."""
+    geom = dict(num_slots=2, block_size=8, max_seq_len=64, prefill_chunk=8,
+                prefix_cache=False, num_blocks=8, swap_gb=0.01)
+    eng = InferenceEngine(tiny_model, EngineConfig(**geom))
+    b1 = eng.add_request(np.arange(8, dtype=np.int32), 20, priority="batch")
+    b2 = eng.add_request(np.arange(8, dtype=np.int32) + 2, 20, priority="batch")
+    for _ in range(4):
+        eng.step()
+    i1 = eng.add_request(np.arange(8, dtype=np.int32) + 5, 8, priority="interactive")
+    _drain(eng)
+    stats = eng.stats()
+    assert stats["preemptions"] >= 1
+    assert i1.preemptions == 0
+    assert b1.preemptions + b2.preemptions == stats["preemptions"]
+    assert all(r.finish_reason == "length" for r in (b1, b2, i1))
+    assert stats["out_of_blocks_total"] == 0
+    assert stats["decode_compiles"] == 1
+
+
+@pytest.mark.slow
+def test_serving_stats_carry_sharing_fields(tiny_model, tmp_path):
+    """The new counters ride the telemetry step rows and the monitor's
+    serving panel."""
+    from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+    from accelerate_tpu.telemetry import TelemetryRecorder, set_active_recorder
+
+    recorder = TelemetryRecorder(logging_dir=str(tmp_path))
+    set_active_recorder(recorder)
+    try:
+        eng = InferenceEngine(
+            tiny_model, EngineConfig(stats_interval=2, swap_gb=0.01, **GEOM)
+        )
+        rng = np.random.default_rng(4)
+        shared = rng.integers(0, 64, size=16).astype(np.int32)
+        for i in range(3):
+            eng.add_request(
+                np.concatenate([shared, rng.integers(0, 64, size=2 + i).astype(np.int32)]),
+                4,
+            )
+            _drain(eng)
+    finally:
+        set_active_recorder(None)
+        recorder.close()
+
+    steps = [
+        r for r in recorder.records
+        if r.get("type") == "serving" and r.get("kind") == "step"
+    ]
+    assert steps
+    assert steps[-1]["prefix_hit_tokens"] > 0
+    assert 0 < steps[-1]["prefix_hit_ratio"] < 1
+    for field in ("preemptions", "swapped_out_blocks", "swapped_in_blocks",
+                  "out_of_blocks_total"):
+        assert field in steps[-1]
+
+    status = collect_status(str(tmp_path))
+    assert status["serving"]["prefix_hit_ratio"] > 0
+    assert "prefix cache:" in render_status(status)

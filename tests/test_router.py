@@ -62,6 +62,34 @@ def _router(replicas, **kw):
     return Router(replicas, **kw)
 
 
+def test_prefix_affinity_prefers_warm_replica():
+    """Free requests sharing a leading block hash pile onto the replica
+    that recently served that prefix (its radix cache is warm), even when
+    it is no longer the least-loaded choice; short prompts never affine."""
+    from accelerate_tpu.serving.router import AFFINITY_PREFIX_TOKENS
+
+    r0, r1 = StubReplica(0, latency=0.3), StubReplica(1, latency=0.3)
+    router = _router([r0, r1])
+    shared = list(range(AFFINITY_PREFIX_TOKENS)) + [7, 7]
+    try:
+        first = router.submit({"id": "w0", "prompt": shared})
+        assert first.done.wait(timeout=30)
+        assert any(p["id"] == "w0" for p in r0.handled)  # idle tie → replica 0
+        # skew load toward r0 with a short (non-affining) request...
+        router.submit({"id": "f1", "prompt": [1, 2]})  # → r0 (tie at 0,0)
+        time.sleep(0.1)
+        # ...yet the shared-prefix request still lands on warm r0, while a
+        # cold long prompt balances to the emptier r1
+        warm = router.submit({"id": "w1", "prompt": shared + [9]})
+        cold = router.submit({"id": "c1", "prompt": [500 + i for i in range(20)]})
+        assert warm.done.wait(timeout=30) and cold.done.wait(timeout=30)
+        assert router.wait_idle(timeout=30)
+        assert any(p["id"] == "w1" for p in r0.handled)
+        assert any(p["id"] == "c1" for p in r1.handled)
+    finally:
+        router.close()
+
+
 def test_least_loaded_placement_splits_across_replicas():
     r0, r1 = StubReplica(0, latency=0.5), StubReplica(1, latency=0.5)
     router = _router([r0, r1])

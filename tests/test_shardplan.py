@@ -402,6 +402,48 @@ class TestEnginePreflight:
         assert report["total_bytes"] == report["params_bytes"] + report["pool_bytes"]
         assert engine.stats()["hbm_preflight"]["over"] is False
 
+    def test_swap_pool_host_bytes_reported_not_budgeted(self, tiny_paged_model):
+        """With swap_gb set, the preflight reports the host-DRAM swap tier
+        alongside the HBM tiers but never counts it against the budget —
+        swapped blocks live on the host (the tier's whole point)."""
+        from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+        engine = InferenceEngine(
+            tiny_paged_model,
+            EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                         hbm_budget_gb=1.0, swap_gb=0.25),
+        )
+        report = engine.hbm_preflight
+        assert report["swap_pool_host_bytes"] > 0
+        assert report["total_bytes"] == report["params_bytes"] + report["pool_bytes"]
+
+    def test_plan_swap_pool_and_analyze_plan_host_tier(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.analysis.shardplan import analyze_plan, plan_swap_pool
+
+        swap = plan_swap_pool(num_layers=2, num_kv_heads=4, head_dim=16,
+                              block_size=8, swap_gb=0.5, dtype="float32")
+        per_block = 2 * 4 * 2 * 8 * 4 * 16
+        assert swap["bytes_per_block"] == per_block
+        assert swap["swap_blocks"] == int(0.5 * (1 << 30)) // per_block
+        assert swap["swap_pool_host_bytes"] == swap["swap_blocks"] * per_block
+
+        params = {"w": jnp.zeros((8, 8))}
+        kv_pool = dict(num_layers=2, num_kv_heads=4, head_dim=16, num_slots=2,
+                       block_size=8, max_seq_len=64, dtype="float32")
+        report = analyze_plan(
+            params, {"dp": 1}, optimizer="none", kv_pool=kv_pool, swap_gb=0.5
+        )
+        assert report.host["swap_pool_host_bytes"] == swap["swap_pool_host_bytes"]
+        assert report.to_dict()["host"] == report.host
+        # host bytes never leak into the per-device HBM sum
+        assert report.bytes_per_device == sum(
+            l.bytes_per_device for l in report.leaves
+        )
+        no_swap = analyze_plan(params, {"dp": 1}, optimizer="none", kv_pool=kv_pool)
+        assert no_swap.host is None
+
     def test_auto_num_blocks_math(self):
         from accelerate_tpu.analysis.shardplan import auto_num_blocks
 
