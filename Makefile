@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -46,3 +46,6 @@ shard-smoke:      ## shard-check pre-flight: clean plan exits 0, seeded dead-rul
 
 radix-smoke:      ## shared-prefix trace hits the radix cache (>0 ratio, one decode executable); swap preemption finishes what out_of_blocks truncated
 	python benchmarks/radix_smoke.py
+
+kvq-smoke:        ## quantized KV cache: int8 holds ~2x the blocks of bf16 at equal budget and completes the pressure trace un-truncated; fused == gather on the same bytes
+	python benchmarks/kvq_smoke.py

@@ -271,11 +271,46 @@ def _spec_divisor(spec, sizes: dict[str, int]) -> int:
     return div
 
 
+#: itemsize fallback for dtype names plain numpy only resolves once
+#: ml_dtypes is imported — this module stays importable jax-free
+_EXT_DTYPE_ITEMSIZE = {
+    "bfloat16": 2,
+    "float8_e4m3fn": 1,
+    "float8_e5m2": 1,
+    "fp8": 1,
+}
+
+
 def _leaf_nbytes(shape, dtype) -> int:
     n = 1
     for d in shape:
         n *= int(d)
-    return n * np.dtype(dtype).itemsize
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = _EXT_DTYPE_ITEMSIZE[str(dtype)]
+    return n * itemsize
+
+
+#: storage-dtype names the kv pool treats as quantized (scale arrays ride
+#: beside the payload); "fp8" is the CLI spelling of float8_e4m3fn
+_KV_QUANTIZED_DTYPES = ("int8", "fp8", "float8_e4m3fn")
+
+
+def kv_storage_name(kv_dtype: str | None, compute_dtype: str = "float32") -> str:
+    """CLI ``kv_dtype`` policy name → the storage dtype string the
+    planners price blocks with. ONE mapping for ``serve --auto-blocks``
+    and ``shard-check --kv-dtype`` (both must price exactly what the
+    engine allocates, or predicted-vs-live bytes drift); ``auto`` follows
+    the params' compute dtype, matching ``EngineConfig`` resolution."""
+    if kv_dtype in (None, "auto"):
+        return compute_dtype
+    return {
+        "f32": "float32",
+        "bf16": "bfloat16",
+        "int8": "int8",
+        "fp8": "float8_e4m3fn",
+    }[kv_dtype]
 
 
 def plan_params(
@@ -418,33 +453,49 @@ def plan_kv_pool(
     """Placement plan for the serving engine's two paged pools, mirroring
     :func:`parallel.sharding.paged_kv_sharding`: kv-head dim over ``tp``
     when it divides, else replicated. ``num_blocks`` defaults to the
-    engine's full-residency default (slots × per-slot max + null block)."""
+    engine's full-residency default (slots × per-slot max + null block).
+
+    Quantized storage (``dtype`` of ``int8``/``fp8``/``float8_e4m3fn`` —
+    the engine's ``kv_dtype`` policy) adds the two f32 amax scale arrays
+    (``[layers, num_blocks, block_size, n_kv]``, kv-head dim sharded the
+    same way) so predicted pool bytes stay byte-exact against the live
+    engine's ``_kp/_vp/_ks/_vs`` footprint."""
     blocks_per_slot = -(-max_seq_len // block_size)  # ceil
     if num_blocks is None:
         num_blocks = num_slots * blocks_per_slot + 1
+    quantized = str(dtype) in _KV_QUANTIZED_DTYPES
+    if str(dtype) == "fp8":
+        dtype = "float8_e4m3fn"
     shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
     tp = mesh_sizes.get("tp", 1)
     sharded = tp > 1 and num_kv_heads % tp == 0
-    spec = (
-        "PartitionSpec(None, None, None, 'tp', None)" if sharded else "PartitionSpec()"
-    )
     divisor = tp if sharded else 1
-    nbytes = _leaf_nbytes(shape, dtype)
-    return [
-        LeafPlan(
+
+    def _leaf(name, shape, dtype, spec_sharded):
+        nbytes = _leaf_nbytes(shape, dtype)
+        return LeafPlan(
             path=f"kv_pool.{name}",
             shape=shape,
-            dtype=str(np.dtype(dtype)),
+            dtype=str(dtype),
             tier="kv_pool",
-            spec=spec,
+            spec=spec_sharded if sharded else "PartitionSpec()",
             source="rule" if sharded else "replicated",
             rule_index=None,
             dropped=(),
             bytes_global=nbytes,
             bytes_per_device=nbytes // divisor,
         )
-        for name in ("k", "v")
-    ]
+
+    pool_spec = "PartitionSpec(None, None, None, 'tp', None)"
+    leaves = [_leaf(name, shape, dtype, pool_spec) for name in ("k", "v")]
+    if quantized:
+        scale_shape = (num_layers, num_blocks, block_size, num_kv_heads)
+        scale_spec = "PartitionSpec(None, None, None, 'tp')"
+        leaves += [
+            _leaf(name, scale_shape, "float32", scale_spec)
+            for name in ("k_scale", "v_scale")
+        ]
+    return leaves
 
 
 def plan_swap_pool(
@@ -460,9 +511,16 @@ def plan_swap_pool(
     preempted requests' unshared blocks are parked in. This is **host**
     memory, deliberately excluded from the per-device HBM totals — it is
     reported alongside them so an ``--hbm-gb`` pre-flight stays truthful
-    about where the swapped bytes actually live."""
+    about where the swapped bytes actually live. Quantized ``dtype``
+    (``kv_dtype`` int8/fp8) adds the f32 scale mirrors per block, exactly
+    matching :class:`serving.radix.SwapPool`'s accounting."""
+    quantized = str(dtype) in _KV_QUANTIZED_DTYPES
+    if str(dtype) == "fp8":
+        dtype = "float8_e4m3fn"
     block_shape = (num_layers, block_size, num_kv_heads, head_dim)
     per_block = 2 * _leaf_nbytes(block_shape, dtype)  # K + V mirrors
+    if quantized:
+        per_block += 2 * _leaf_nbytes(block_shape[:-1], "float32")  # scales
     blocks = max(0, int(swap_gb * (1 << 30)) // per_block) if per_block else 0
     return {
         "swap_gb": float(swap_gb),

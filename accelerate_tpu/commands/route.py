@@ -34,6 +34,7 @@ _ENGINE_FLAGS = (
     ("--prefill-chunk", "prefill_chunk"), ("--decode-burst", "decode_burst"),
     ("--max-new-tokens", "max_new_tokens"), ("--eos-token-id", "eos_token_id"),
     ("--temperature", "temperature"), ("--seed", "seed"),
+    ("--kv-dtype", "kv_dtype"),
 )
 
 
@@ -206,6 +207,11 @@ def add_parser(subparsers):
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-dtype", choices=("auto", "bf16", "f32", "int8", "fp8"),
+                   default=None,
+                   help="forwarded to every replica's serve --kv-dtype "
+                   "(replicas must store KV identically for dispatch to "
+                   "treat them as interchangeable)")
     p.add_argument("--mesh", action="store_true",
                    help="each replica shards its engine over the attached mesh "
                    "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")

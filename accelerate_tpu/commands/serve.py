@@ -114,6 +114,18 @@ def _build_model(args):
     return LlamaForCausalLM.from_config(config, seed=args.seed, dtype=dtype)
 
 
+def _plan_kv_dtype(args) -> str:
+    """The storage dtype string the shard-check HBM model prices blocks
+    with — quantized policies price payload + scale arrays, so
+    ``--auto-blocks`` sizes the pool from the bytes the engine will
+    actually allocate (capacity ~doubles at int8/fp8)."""
+    from ..analysis.shardplan import kv_storage_name
+
+    return kv_storage_name(
+        args.kv_dtype, "bfloat16" if args.dtype == "bf16" else "float32"
+    )
+
+
 class _PreflightRefusal(Exception):
     """Engine construction refused to start (the SP004 pre-flight, or
     invalid geometry) — distinct from a ValueError escaping the live
@@ -159,7 +171,7 @@ def _auto_num_blocks(args, model, mesh) -> int:
             max_seq_len=args.max_seq_len,
             num_blocks=1,
             mesh_sizes=sizes,
-            dtype="bfloat16" if args.dtype == "bf16" else "float32",
+            dtype=_plan_kv_dtype(args),
         )
     )
     blocks_per_slot = blocks_needed(args.max_seq_len, args.block_size)
@@ -212,6 +224,7 @@ def _make_engine(args):
             hbm_budget_gb=args.hbm_gb,
             prefix_cache=args.prefix_cache,
             swap_gb=args.swap_gb,
+            kv_dtype=args.kv_dtype,
         ),
         mesh=mesh,
     )
@@ -559,6 +572,20 @@ def add_parser(subparsers):
                    "ACCELERATE_SERVE_SWAP_GB): under pool exhaustion the "
                    "lowest-priority request is swapped out instead of being "
                    "truncated with finish_reason=out_of_blocks")
+    kv_env = os.environ.get("ACCELERATE_SERVE_KV_DTYPE", "auto").strip().lower()
+    if kv_env not in ("auto", "bf16", "f32", "int8", "fp8"):
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_KV_DTYPE="
+            f"{kv_env!r} (want auto|bf16|f32|int8|fp8)",
+            file=sys.stderr,
+        )
+        kv_env = "auto"
+    p.add_argument("--kv-dtype", choices=("auto", "bf16", "f32", "int8", "fp8"),
+                   default=kv_env,
+                   help="KV pool storage policy (default auto = the params' "
+                   "compute dtype; env ACCELERATE_SERVE_KV_DTYPE): int8/fp8 "
+                   "quantize on scatter with per-row amax scales — half the "
+                   "decode bytes, ~2x the slot capacity at equal --hbm-gb")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
                    help="enable sampling at this temperature (default: greedy)")

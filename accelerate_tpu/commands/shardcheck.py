@@ -132,6 +132,15 @@ def shard_check_command(args) -> int:
             file=sys.stderr,
         )
     if not args.no_serve_pool:
+        # kv_dtype policy: "auto" stores the pool in the params' compute
+        # dtype; int8/fp8 price the 1-byte payload PLUS the f32 scale
+        # arrays, matching the engine's live footprint byte-exactly
+        # (kv_storage_name: the one mapping shared with serve --auto-blocks)
+        from ..analysis.shardplan import kv_storage_name
+
+        kv_dtype = kv_storage_name(
+            args.kv_dtype, "float32" if args.dtype == "f32" else "bfloat16"
+        )
         kv_pool = dict(
             num_layers=config.num_hidden_layers,
             num_kv_heads=config.num_key_value_heads,
@@ -140,7 +149,7 @@ def shard_check_command(args) -> int:
             block_size=args.block_size,
             max_seq_len=min(args.max_seq_len, config.max_position_embeddings),
             num_blocks=args.num_blocks,
-            dtype="float32" if args.dtype == "f32" else "bfloat16",
+            dtype=kv_dtype,
         )
     activations = None
     include_grads = False
@@ -278,6 +287,11 @@ def add_parser(subparsers):
                    help="paged pool blocks (default: full residency)")
     p.add_argument("--no-serve-pool", action="store_true",
                    help="drop the paged KV pool tier (training-only plan)")
+    p.add_argument("--kv-dtype", choices=("auto", "bf16", "f32", "int8", "fp8"),
+                   default="auto",
+                   help="KV pool storage policy (EngineConfig(kv_dtype=...)): "
+                   "int8/fp8 price the quantized payload + f32 amax scale "
+                   "arrays; auto follows --dtype")
     p.add_argument("--swap-gb", type=float, default=None,
                    help="serving KV swap tier (EngineConfig(swap_gb=...)): "
                    "report its host-DRAM footprint alongside the HBM tiers "

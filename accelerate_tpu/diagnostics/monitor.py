@@ -225,6 +225,10 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "preemptions": last_step.get("preemptions"),
             "swapped_out_blocks": last_step.get("swapped_out_blocks"),
             "out_of_blocks_total": last_step.get("out_of_blocks_total"),
+            # kv_dtype policy rows (quantized KV cache)
+            "kv_dtype": last_step.get("kv_dtype"),
+            "kv_bytes_per_token": last_step.get("kv_bytes_per_token"),
+            "kv_slot_capacity": last_step.get("kv_slot_capacity"),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -353,6 +357,12 @@ def render_status(status: dict[str, Any]) -> str:
             f"p99 {_fmt(srv.get('ttft_p99_s'), '{:.2f}')}s)   "
             f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
         )
+        if srv.get("kv_dtype"):
+            lines.append(
+                f"  kv cache: {srv['kv_dtype']}   "
+                f"{_fmt(srv.get('kv_bytes_per_token'), '{:.0f}')} B/token   "
+                f"slot capacity {_fmt(srv.get('kv_slot_capacity'), '{}')}"
+            )
         if srv.get("prefix_hit_ratio") is not None or srv.get("preemptions"):
             lines.append(
                 f"  prefix cache: hit {_fmt(srv.get('prefix_hit_ratio'), '{:.0%}')}   "

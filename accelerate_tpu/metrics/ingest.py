@@ -123,6 +123,13 @@ _PREFIX_HIT_GAUGE = (
     "prefix_hit_ratio", "serving_prefix_hit_ratio",
     "Prompt tokens served from the radix prefix cache (fraction)",
 )
+#: kv_dtype policy gauges — same one-table-two-surfaces rule as above
+_KV_GAUGES = (
+    ("kv_bytes_per_token", "serving_kv_bytes_per_token",
+     "Bytes one cached token holds across layers (K+V payload + scales)"),
+    ("kv_slot_capacity", "serving_kv_slot_capacity",
+     "Max-length requests the paged pool holds concurrently"),
+)
 
 
 def _observe_serving(registry, record: dict) -> None:
@@ -153,6 +160,7 @@ def _observe_serving(registry, record: dict) -> None:
             ("slot_occupancy", "serving_slot_occupancy", "Fraction of decode slots busy"),
             ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
             _PREFIX_HIT_GAUGE,
+            *_KV_GAUGES,
         ):
             if _num(record.get(field)) is not None:
                 registry.gauge(name, help).set(record[field])
@@ -208,9 +216,9 @@ def observe_engine_stats(registry, stats: dict) -> None:
         registry.counter("serving_iterations", "Engine scheduler iterations").set_total(
             stats["iterations"]
         )
-    field, name, help = _PREFIX_HIT_GAUGE
-    if _num(stats.get(field)) is not None:
-        registry.gauge(name, help).set(stats[field])
+    for field, name, help in (_PREFIX_HIT_GAUGE, *_KV_GAUGES):
+        if _num(stats.get(field)) is not None:
+            registry.gauge(name, help).set(stats[field])
     for field, name, help in _SHARING_COUNTERS:
         if _num(stats.get(field)) is not None:
             registry.counter(name, help).set_total(stats[field])

@@ -420,36 +420,49 @@ def _llama_paged_step(
     """One step against the block-paged KV pool: ``s == 1`` token per slot
     (the engine's single compiled decode program) or an ``s``-token prefill
     chunk of one prompt. K/V land in pool blocks through each slot's block
-    table (:func:`ops.layers.write_paged_kv`); attention runs against the
-    gathered logical prefix. The layer loop is a plain scan — the serving
-    engine is a single-host path (no pp stage pipeline)."""
+    table (:func:`ops.layers.write_paged_kv` — quantize-on-scatter when
+    ``paged_kv`` carries ``k_scale``/``v_scale`` arrays, the engine's
+    ``kv_dtype`` policy); attention is the fused block-table walk
+    (:mod:`ops.paged_attention`), never a materialised span gather. The
+    layer loop is a plain scan — the serving engine is a single-host path
+    (no pp stage pipeline)."""
     from ..ops.layers import rope_paged_attention_block
 
     b, s = input_ids.shape
     idx = jnp.asarray(cache_positions, jnp.int32).reshape(b)
     x = params["embed_tokens"][input_ids]
+    quantized = "k_scale" in paged_kv
 
     def body(x, layer_pages):
-        layer, kp_l, vp_l = layer_pages
-        x, kp_l, vp_l = rope_paged_attention_block(
+        if quantized:
+            layer, kp_l, vp_l, ks_l, vs_l = layer_pages
+        else:
+            (layer, kp_l, vp_l), ks_l, vs_l = layer_pages, None, None
+        out = rope_paged_attention_block(
             layer, x, kp_l, vp_l, cos, sin, block_tables, idx,
             c.num_attention_heads, c.num_key_value_heads, c.head_dim,
             c.rms_norm_eps, write_mask=paged_write_mask,
+            k_scale_l=ks_l, v_scale_l=vs_l,
         )
+        x, pages = out[0], out[1:]
         y = rms_norm(x, layer["mlp_norm"], c.rms_norm_eps)
         gated = jax.nn.silu(dense(y, layer["w_gate"])) * dense(y, layer["w_up"])
         x = x + dense(gated, layer["w_down"])
-        return x, (kp_l, vp_l)
+        return x, pages
 
-    x, (kp, vp) = jax.lax.scan(
-        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
-    )
+    xs = (params["layers"], paged_kv["k"], paged_kv["v"])
+    if quantized:
+        xs = xs + (paged_kv["k_scale"], paged_kv["v_scale"])
+    x, pages = jax.lax.scan(body, x, xs)
     x = rms_norm(x, params["norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed_tokens"].T
     logits = dense(x, head)
-    return ModelOutput(logits=logits, paged_kv={"k": kp, "v": vp})
+    out_pages = {"k": pages[0], "v": pages[1]}
+    if quantized:
+        out_pages["k_scale"], out_pages["v_scale"] = pages[2], pages[3]
+    return ModelOutput(logits=logits, paged_kv=out_pages)
 
 
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")

@@ -6,6 +6,7 @@ from .attention import (
     set_attention_context,
 )
 from .flash_attention import blockwise_attention, flash_attention
+from .paged_attention import paged_attention
 from .layers import (
     apply_rope,
     causal_attention,

@@ -126,6 +126,89 @@ def dense(x, w):
     return out.reshape(*lead, w.shape[-1])
 
 
+# ---------------------------------------------------------------------------
+# Quantized KV-cache storage (serving engine's kv_dtype policy)
+#
+# The paged block pools can store K/V in int8 or float8_e4m3fn with one
+# f32 amax scale per written row (per token position × kv head): decode is
+# memory-bandwidth-bound, so halving/quartering the pool's bytes directly
+# halves the bytes each decode step moves AND doubles how many blocks fit a
+# fixed HBM budget. Scales are quantized-at-write (each scatter quantizes
+# only its own rows), so writes are idempotent — no read-modify-write
+# requantization of previously written tokens — and a block's payload+scale
+# rows travel atomically through copy-on-write, swap-out/in, and radix
+# adoption. Dequantize happens in-register inside the fused paged-attention
+# kernel (``ops/paged_attention.py``), never as a materialised f32 pool.
+# ---------------------------------------------------------------------------
+
+INT8_MAX = 127.0
+
+#: engine ``kv_dtype`` policy names -> jnp storage dtype factory. ``auto``
+#: (params dtype) is resolved by the engine, not here.
+KV_STORAGE_DTYPES = ("bf16", "f32", "int8", "fp8")
+KV_QUANTIZED_DTYPES = ("int8", "fp8")
+
+
+def kv_storage_dtype(name: str):
+    """Resolve a ``kv_dtype`` policy name to ``(jnp dtype, quantized)``.
+    Raises on unknown names and on ``fp8`` where the stack can't cast f8
+    (:func:`utils.compat.has_fp8_storage`)."""
+    if name == "bf16":
+        return jnp.bfloat16, False
+    if name == "f32":
+        return jnp.float32, False
+    if name == "int8":
+        return jnp.int8, True
+    if name == "fp8":
+        from ..utils.compat import has_fp8_storage
+
+        if not has_fp8_storage():
+            raise ValueError(
+                "kv_dtype='fp8' needs float8_e4m3fn storage, which this "
+                "jax/jaxlib pair cannot cast — use kv_dtype='int8' (same "
+                "bytes per token) or upgrade jax"
+            )
+        return jnp.float8_e4m3fn, True
+    raise ValueError(
+        f"unknown kv_dtype {name!r}: expected one of "
+        f"{('auto',) + KV_STORAGE_DTYPES}"
+    )
+
+
+def kv_qmax(dtype) -> float:
+    """Largest representable magnitude the amax scale maps onto."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        return INT8_MAX
+    if dtype == jnp.dtype(jnp.float8_e4m3fn):
+        return E4M3_MAX
+    raise ValueError(f"{dtype} is not a quantized KV storage dtype")
+
+
+def quantize_kv_rows(x, dtype):
+    """Per-row amax quantization of a K/V chunk ``[..., hd]`` into
+    ``dtype``: returns ``(q, scale)`` with ``scale = amax/qmax`` over the
+    last axis (shape ``x.shape[:-1]``, f32) and ``q ≈ x / scale``. An
+    all-zero row keeps ``scale = 1`` so dequantization is exact for it."""
+    qmax = kv_qmax(dtype)
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    scaled = x32 / scale[..., None]
+    if jnp.dtype(dtype) == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    else:
+        q = scaled.astype(dtype)  # f8 cast rounds in hardware
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv_rows`: ``q [..., hd]`` × ``scale
+    [...]`` → f32. The fused kernel applies this per gathered block, in
+    registers."""
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
 @dataclass
 class FP8RecipeKwargs:
     """(Reference ``FP8RecipeKwargs`` ``dataclasses.py:283``.) ``margin`` /

@@ -256,21 +256,25 @@ def cached_attention(q, k_cache, v_cache, idx):
     """
     b, s, nh, hd = q.shape
     n_kv = k_cache.shape[2]
-    if n_kv != nh:
-        rep = nh // n_kv
-        k_cache = jnp.repeat(k_cache, rep, axis=2)
-        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    # GQA by grouped-head einsum — q heads reshaped to [n_kv, rep] groups
+    # against the un-expanded KV (head h reads kv head h // rep, matching
+    # the old jnp.repeat layout) so repeated KV is never materialised:
+    # the einsum batches over the kv-head axis instead of moving
+    # rep × the cache bytes through the MXU's operand path
+    rep = nh // n_kv
+    qg = q.astype(jnp.float32).reshape(b, s, n_kv, rep, hd)
     max_cache = k_cache.shape[1]
     q_pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
     valid = jnp.arange(max_cache)[None, None, :] <= q_pos[:, :, None]  # [b, s, max]
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+        "bqnrd,bknd->bnrqk", qg, k_cache.astype(jnp.float32)
     ) / np.sqrt(float(hd))
-    scores = jnp.where(valid[:, None, :, :], scores, jnp.finfo(jnp.float32).min)
+    scores = jnp.where(
+        valid[:, None, None, :, :], scores, jnp.finfo(jnp.float32).min
+    )
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
-    ).astype(q.dtype)
+    out = jnp.einsum("bnrqk,bknd->bqnrd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, s, nh, hd).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -287,7 +291,8 @@ def cached_attention(q, k_cache, v_cache, idx):
 
 
 def write_paged_kv(
-    k_pages_l, v_pages_l, k, v, block_tables, positions, write_mask=None
+    k_pages_l, v_pages_l, k, v, block_tables, positions, write_mask=None,
+    k_scale_l=None, v_scale_l=None,
 ):
     """Scatter a chunk's K/V (``[b, s, n_kv, hd]``) into block-paged caches
     ``[num_blocks, block_size, n_kv, hd]`` at absolute token ``positions``
@@ -301,7 +306,14 @@ def write_paged_kv(
     never clamped into the slot's own final block. Distinct live slots own
     disjoint blocks, so the flattened scatter has no cross-slot
     collisions; only the null block (0) absorbs free-slot writes, and it
-    is never attended."""
+    is never attended.
+
+    **Quantize-on-scatter** (``k_scale_l``/``v_scale_l`` given, shape
+    ``[num_blocks, bs, n_kv]`` f32): K/V are amax-quantized per written
+    row into the pool's storage dtype (int8/fp8 — ``ops/fp8.py``) and each
+    row's scale is scattered through the *same* flat indices, so payload
+    and scale stay atomic under the identical drop/masking rules. Returns
+    4 arrays in that case."""
     nb, bs = k_pages_l.shape[0], k_pages_l.shape[1]
     b, s = k.shape[0], k.shape[1]
     positions = jnp.asarray(positions, jnp.int32)
@@ -312,11 +324,31 @@ def write_paged_kv(
     flat = blk * bs + positions % bs
     if write_mask is not None:
         flat = jnp.where(write_mask, flat, nb * bs)  # out of range → dropped
+    flat = flat.reshape(b * s)
+    if k_scale_l is not None:
+        from .fp8 import quantize_kv_rows
+
+        store = k_pages_l.dtype
+        k, k_sc = quantize_kv_rows(k, store)   # [b,s,n_kv,hd] + [b,s,n_kv]
+        v, v_sc = quantize_kv_rows(v, store)
+        ksf = k_scale_l.reshape(nb * bs, *k_scale_l.shape[2:])
+        vsf = v_scale_l.reshape(nb * bs, *v_scale_l.shape[2:])
+        ksf = ksf.at[flat].set(k_sc.reshape(b * s, *k_sc.shape[2:]), mode="drop")
+        vsf = vsf.at[flat].set(v_sc.reshape(b * s, *v_sc.shape[2:]), mode="drop")
+        k_scale_l = ksf.reshape(nb, bs, *k_scale_l.shape[2:])
+        v_scale_l = vsf.reshape(nb, bs, *v_scale_l.shape[2:])
+    else:
+        k = k.astype(k_pages_l.dtype)  # e.g. bf16 storage under f32 compute
+        v = v.astype(v_pages_l.dtype)
     kf = k_pages_l.reshape(nb * bs, *k_pages_l.shape[2:])
     vf = v_pages_l.reshape(nb * bs, *v_pages_l.shape[2:])
-    flat = flat.reshape(b * s)
     kf = kf.at[flat].set(k.reshape(b * s, *k.shape[2:]), mode="drop")
     vf = vf.at[flat].set(v.reshape(b * s, *v.shape[2:]), mode="drop")
+    if k_scale_l is not None:
+        return (
+            kf.reshape(k_pages_l.shape), vf.reshape(v_pages_l.shape),
+            k_scale_l, v_scale_l,
+        )
     return kf.reshape(k_pages_l.shape), vf.reshape(v_pages_l.shape)
 
 
@@ -340,14 +372,19 @@ def gather_paged_kv(k_pages_l, v_pages_l, block_tables):
 def rope_paged_attention_block(
     layer, x, k_pages_l, v_pages_l, cos, sin, block_tables, idx,
     n_heads: int, n_kv_heads: int, head_dim: int, eps: float,
-    write_mask=None,
+    write_mask=None, k_scale_l=None, v_scale_l=None, attn_impl=None,
 ):
     """Paged twin of :func:`rope_cached_attention_block`: RMSNorm → q/k/v →
-    RoPE at each slot's absolute position → block-table scatter → page
-    gather → :func:`cached_attention` → output projection residual.
-    ``s == 1`` is the engine's decode step; ``s > 1`` a prefill chunk
-    (``write_mask`` drops its padded tail)."""
+    RoPE at each slot's absolute position → block-table scatter
+    (quantize-on-scatter when scale arrays ride along) → **fused paged
+    attention** walking the block table directly
+    (:func:`ops.paged_attention.paged_attention` — the gathered
+    ``[b, max_blocks*bs, ...]`` span is never materialised) → output
+    projection residual. ``s == 1`` is the engine's decode step; ``s > 1``
+    a prefill chunk (``write_mask`` drops its padded tail). Returns the
+    scale arrays too when quantized."""
     from .fp8 import dense
+    from .paged_attention import paged_attention
 
     b, s, _ = x.shape
     idx = jnp.asarray(idx, jnp.int32).reshape(b)
@@ -360,10 +397,20 @@ def rope_paged_attention_block(
         dense(y, layer["wk"]).reshape(b, s, n_kv_heads, head_dim), cos, sin, positions
     )
     v = dense(y, layer["wv"]).reshape(b, s, n_kv_heads, head_dim)
-    k_pages_l, v_pages_l = write_paged_kv(
-        k_pages_l, v_pages_l, k, v, block_tables, positions, write_mask=write_mask
+    quantized = k_scale_l is not None
+    written = write_paged_kv(
+        k_pages_l, v_pages_l, k, v, block_tables, positions,
+        write_mask=write_mask, k_scale_l=k_scale_l, v_scale_l=v_scale_l,
     )
-    k_g, v_g = gather_paged_kv(k_pages_l, v_pages_l, block_tables)
-    attn = cached_attention(q, k_g, v_g, idx)
+    if quantized:
+        k_pages_l, v_pages_l, k_scale_l, v_scale_l = written
+    else:
+        k_pages_l, v_pages_l = written
+    attn = paged_attention(
+        q, k_pages_l, v_pages_l, block_tables, idx,
+        k_scale_l=k_scale_l, v_scale_l=v_scale_l, impl=attn_impl,
+    )
     x = x + dense(attn.reshape(b, s, n_heads * head_dim), layer["wo"])
+    if quantized:
+        return x, k_pages_l, v_pages_l, k_scale_l, v_scale_l
     return x, k_pages_l, v_pages_l

@@ -1,0 +1,281 @@
+"""Fused paged attention: walk the block table, never materialise the span.
+
+The PR 4 paged decode path gathered each slot's **entire** block-table span
+(``gather_paged_kv`` → ``[b, max_blocks*bs, n_kv, hd]``), ``jnp.repeat``-ed
+KV heads for GQA, and only then ran ``cached_attention`` — so the bytes a
+decode step moves scale with the *maximum* context and the GQA expansion,
+not the valid prefix. This module computes attention **block-by-block**
+straight off the block table:
+
+* one pool block ``[bs, n_kv, hd]`` is loaded per table entry, dequantized
+  in registers when the pool is int8/fp8 (``ops/fp8.py`` scales), and
+  consumed by an **online softmax** (running max / sum / accumulator — the
+  flash-attention recurrence), so no ``[b, max_blocks*bs, ...]`` buffer
+  ever exists;
+* GQA uses a **grouped-head einsum** (``[b, s, n_kv, rep, hd]`` against
+  ``[b, bs, n_kv, hd]``) — repeated KV heads are never materialised;
+* positions past each row's valid prefix are masked inside the recurrence
+  (same policy as ``cached_attention``), and the Pallas kernel skips the
+  compute of fully-invalid table entries.
+
+Two implementations behind one dispatcher (routing:
+:func:`utils.compat.default_paged_attention_impl` — Pallas on TPU, the
+pure-lax ``scan``-over-blocks everywhere else; the gather-then-dense
+reference survives as the parity/bench baseline). Both run in f32
+scores/softmax like every attention in this codebase.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fp8 import dequantize_kv
+
+_NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _dequant_block(block, scale_rows):
+    """One gathered pool block → f32, applying per-row scales if present."""
+    if scale_rows is None:
+        return block.astype(jnp.float32)
+    return dequantize_kv(block, scale_rows)
+
+
+def paged_attention(
+    q,                      # [b, s, n_heads, hd]
+    k_pages_l,              # [num_blocks, bs, n_kv, hd] (storage dtype)
+    v_pages_l,              # [num_blocks, bs, n_kv, hd]
+    block_tables,           # [b, max_blocks] int32
+    idx,                    # [b] int32 — first query's cache position
+    k_scale_l=None,         # [num_blocks, bs, n_kv] f32 (quantized pools)
+    v_scale_l=None,
+    impl: str | None = None,
+):
+    """Attention of ``q`` against each row's block-table span. Query ``j``
+    of row ``b`` attends logical cache positions ``<= idx[b]+j`` — the
+    same per-row valid-prefix + intra-chunk causal policy as
+    :func:`ops.layers.cached_attention`, so paged decode keeps matching
+    dense decode. ``impl``: ``None`` routes via
+    :func:`~accelerate_tpu.utils.compat.default_paged_attention_impl`;
+    ``"lax"``/``"pallas"``/``"gather"`` force a path (``"gather"`` is the
+    PR 4 materialise-the-span reference, kept for parity tests and the
+    fused-vs-gather bench ratio)."""
+    if impl is None:
+        from ..utils.compat import default_paged_attention_impl
+
+        impl = default_paged_attention_impl()
+    if impl == "lax":
+        return _paged_attention_lax(
+            q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l
+        )
+    if impl == "pallas":
+        return _paged_attention_pallas(
+            q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l
+        )
+    if impl == "gather":
+        return _paged_attention_gather(
+            q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l
+        )
+    raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+# ---------------------------------------------------------------------------
+# pure-lax fallback: scan over table entries, online softmax
+# ---------------------------------------------------------------------------
+
+
+def _paged_attention_lax(q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l):
+    b, s, nh, hd = q.shape
+    _, bs, n_kv, _ = k_pages_l.shape
+    rep = nh // n_kv
+    mb = block_tables.shape[1]
+    bt = jnp.asarray(block_tables, jnp.int32)
+    idx = jnp.asarray(idx, jnp.int32).reshape(b)
+
+    # scale folded into q once (not per block); grouped heads for GQA
+    qg = (q.astype(jnp.float32) / np.sqrt(float(hd))).reshape(b, s, n_kv, rep, hd)
+    q_pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+
+    def body(carry, j):
+        m, l, acc = carry
+        blk = bt[:, j]                                   # [b]
+        kb = _dequant_block(k_pages_l[blk], None if k_scale_l is None else k_scale_l[blk])
+        vb = _dequant_block(v_pages_l[blk], None if v_scale_l is None else v_scale_l[blk])
+        # [b, n_kv, rep, s, bs]: contraction over hd, batched over kv head
+        sc = jnp.einsum("bsnrd,btnd->bnrst", qg, kb)
+        pos = j * bs + jnp.arange(bs, dtype=jnp.int32)   # logical positions
+        valid = pos[None, None, :] <= q_pos[:, :, None]  # [b, s, bs]
+        vmask = valid[:, None, None, :, :]
+        sc = jnp.where(vmask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1))
+        # while every position so far is masked, m_new == _NEG_INF and
+        # sc - m_new == 0 — the explicit mask keeps those lanes at p = 0
+        p = jnp.where(vmask, jnp.exp(sc - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bnrst,btnd->bnrsd", p, vb)
+        return (m_new, l, acc), None
+
+    init = (
+        jnp.full((b, n_kv, rep, s), _NEG_INF, jnp.float32),
+        jnp.zeros((b, n_kv, rep, s), jnp.float32),
+        jnp.zeros((b, n_kv, rep, s, hd), jnp.float32),
+    )
+    (_, l, acc), _ = jax.lax.scan(body, init, jnp.arange(mb, dtype=jnp.int32))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]         # [b, n_kv, rep, s, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nh, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# gather reference (the PR 4 path, kept for parity tests + bench baseline)
+# ---------------------------------------------------------------------------
+
+
+def _paged_attention_gather(q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l):
+    from .layers import cached_attention, gather_paged_kv
+
+    if k_scale_l is not None:
+        bt = jnp.asarray(block_tables, jnp.int32)
+        b, mb = bt.shape
+        bs = k_pages_l.shape[1]
+        k_g = dequantize_kv(k_pages_l[bt], k_scale_l[bt])
+        v_g = dequantize_kv(v_pages_l[bt], v_scale_l[bt])
+        k_g = k_g.reshape(b, mb * bs, *k_g.shape[3:])
+        v_g = v_g.reshape(b, mb * bs, *v_g.shape[3:])
+    else:
+        k_g, v_g = gather_paged_kv(k_pages_l, v_pages_l, block_tables)
+    return cached_attention(q, k_g, v_g, jnp.asarray(idx, jnp.int32).reshape(q.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel: block-table-indexed BlockSpecs via scalar prefetch
+# ---------------------------------------------------------------------------
+
+
+def _pallas_kernel(bt_ref, idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   out_ref, m_ref, l_ref, acc_ref, *, bs, rep, quantized):
+    """Grid ``(b, max_blocks)``: step ``(i, j)`` consumes row ``i``'s
+    ``j``-th table entry — the BlockSpec index maps already steered the
+    right pool block into VMEM via the prefetched block table. Online
+    softmax state lives in VMEM scratch across the ``j`` steps (the last
+    grid axis iterates fastest); entries wholly past the row's valid
+    prefix skip their compute."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    mb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                  # [s, nh, hd]
+    s, nh, hd = q.shape
+    n_kv = nh // rep
+    q_pos = idx_ref[i] + jax.lax.broadcasted_iota(jnp.int32, (s,), 0)
+
+    @pl.when(j * bs <= idx_ref[i] + s - 1)        # any position valid?
+    def _step():
+        kb = k_ref[...].astype(jnp.float32)       # [bs, n_kv, hd]
+        vb = v_ref[...].astype(jnp.float32)
+        if quantized:
+            kb = kb * ks_ref[...].astype(jnp.float32)[..., None]
+            vb = vb * vs_ref[...].astype(jnp.float32)[..., None]
+        qg = (q.astype(jnp.float32) / np.sqrt(float(hd))).reshape(s, n_kv, rep, hd)
+        sc = jnp.einsum("snrd,tnd->nrst", qg, kb)  # [n_kv, rep, s, bs]
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs,), 0)
+        vmask = (pos[None, :] <= q_pos[:, None])[None, None, :, :]
+        sc = jnp.where(vmask, sc, _NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[...], l_ref[...], acc_ref[...]
+        m_new = jnp.maximum(m_prev, sc.max(axis=-1))
+        p = jnp.where(vmask, jnp.exp(sc - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_prev * alpha[..., None] + jnp.einsum("nrst,tnd->nrsd", p, vb)
+
+    @pl.when(j == mb - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
+        out_ref[0] = (
+            out.transpose(2, 0, 1, 3).reshape(s, nh, hd).astype(out_ref.dtype)
+        )
+
+
+def _paged_attention_pallas(q, k_pages_l, v_pages_l, block_tables, idx, k_scale_l, v_scale_l):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, nh, hd = q.shape
+    nb, bs, n_kv, _ = k_pages_l.shape
+    rep = nh // n_kv
+    mb = block_tables.shape[1]
+    quantized = k_scale_l is not None
+    if not quantized:
+        # uniform arity: 1-wide placeholders the kernel never reads
+        k_scale_l = jnp.zeros((nb, bs, 1), jnp.float32)
+        v_scale_l = jnp.zeros((nb, bs, 1), jnp.float32)
+    sdim = k_scale_l.shape[-1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables + idx steer the index maps
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, s, nh, hd), lambda i, j, bt, ix: (i, 0, 0, 0)),
+            pl.BlockSpec((1, bs, n_kv, hd), lambda i, j, bt, ix: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, n_kv, hd), lambda i, j, bt, ix: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, sdim), lambda i, j, bt, ix: (bt[i, j], 0, 0)),
+            pl.BlockSpec((1, bs, sdim), lambda i, j, bt, ix: (bt[i, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, s, nh, hd), lambda i, j, bt, ix: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, rep, s), jnp.float32),
+            pltpu.VMEM((n_kv, rep, s), jnp.float32),
+            pltpu.VMEM((n_kv, rep, s, hd), jnp.float32),
+        ],
+    )
+
+    def _squeeze_kernel(bt_ref, idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                        out_ref, m_ref, l_ref, acc_ref):
+        _pallas_kernel(
+            bt_ref, idx_ref, q_ref,
+            k_ref.at[0], v_ref.at[0], ks_ref.at[0], vs_ref.at[0],
+            out_ref, m_ref, l_ref, acc_ref,
+            bs=bs, rep=rep, quantized=quantized,
+        )
+
+    call = pl.pallas_call(
+        _squeeze_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, nh, hd), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )
+    return call(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(idx, jnp.int32).reshape(b),
+        q, k_pages_l, v_pages_l, k_scale_l, v_scale_l,
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_paged_attention_available() -> bool:
+    """Probe: does the Pallas kernel build on this stack? (Interpret mode
+    off-TPU — used by tests and the bench to decide whether the kernel leg
+    runs at all.)"""
+    try:
+        q = jnp.zeros((1, 1, 2, 4))
+        kp = jnp.zeros((3, 2, 1, 4))
+        out = _paged_attention_pallas(
+            q, kp, kp, jnp.zeros((1, 2), jnp.int32), jnp.zeros((1,), jnp.int32),
+            None, None,
+        )
+        return bool(np.isfinite(np.asarray(out)).all())
+    except Exception:
+        return False

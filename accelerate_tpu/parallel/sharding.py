@@ -226,6 +226,18 @@ def paged_kv_sharding(mesh: Mesh, num_kv_heads: int) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def paged_kv_scale_sharding(mesh: Mesh, num_kv_heads: int) -> NamedSharding:
+    """Sharding for the quantized pool's amax scale arrays
+    (``[layers, num_blocks, block_size, n_kv]``): the kv-head dim follows
+    :func:`paged_kv_sharding` exactly — a scale row must live with the
+    payload rows it dequantizes, or every fused-attention block read
+    becomes a collective."""
+    tp = mesh.shape["tp"]
+    if tp > 1 and num_kv_heads % tp == 0:
+        return NamedSharding(mesh, P(None, None, None, "tp"))
+    return NamedSharding(mesh, P())
+
+
 def opt_state_sharding_like(tx, params, param_shardings, mesh: Mesh):
     """Sharding tree for ``tx.init(params)``'s state: param-shaped leaves
     inherit the param's sharding (matched via optax's param-tree mirroring),

@@ -88,6 +88,18 @@ class EngineConfig:
     #: of its class; ``finish_reason="out_of_blocks"`` truncation becomes
     #: the last resort for when even swap capacity is gone.
     swap_gb: float = 0.0
+    #: KV pool storage policy — decode is memory-bandwidth-bound, so the
+    #: pool's dtype is the direct lever on both bytes-per-decode-step and
+    #: how many blocks (slots) an HBM budget holds. ``"auto"`` stores in
+    #: the params' compute dtype (the PR 4 behaviour); ``"bf16"``/``"f32"``
+    #: force a float width; ``"int8"``/``"fp8"`` quantize on scatter with
+    #: per-row amax scales riding beside the pool (``ops/fp8.py``) and
+    #: dequantize in-register inside the fused paged-attention kernel.
+    #: Scale arrays follow every pool edit — copy-on-write, swap-out/in,
+    #: radix adoption — and the one-compiled-decode-executable contract
+    #: holds at every setting (scales are just two more donated pool
+    #: operands of the same single executable).
+    kv_dtype: str = "auto"
 
     @property
     def blocks_per_slot(self) -> int:
@@ -141,14 +153,38 @@ class InferenceEngine:
             else cfg.num_slots * self._mb + 1
         )
 
-        # device state: per-layer page pools in the params' compute dtype
+        # device state: per-layer page pools in the kv_dtype policy's
+        # storage dtype ("auto" = the params' compute dtype, the PR 4
+        # behaviour; int8/fp8 add per-row amax scale arrays beside them)
         n_kv = getattr(mcfg, "num_key_value_heads", None) or mcfg.num_attention_heads
         embed = jax.tree.leaves(self._params)[0]
         dtype = embed.dtype if jnp.issubdtype(embed.dtype, jnp.floating) else jnp.float32
+        if cfg.kv_dtype in (None, "auto"):
+            store_dtype, quantized = dtype, False
+        else:
+            from ..ops.fp8 import kv_storage_dtype
+
+            store_dtype, quantized = kv_storage_dtype(cfg.kv_dtype)
+        self._quantized = quantized
+        self.kv_dtype = str(np.dtype(store_dtype))
         shape = (mcfg.num_hidden_layers, num_blocks, cfg.block_size, n_kv, mcfg.head_dim)
+        scale_shape = (mcfg.num_hidden_layers, num_blocks, cfg.block_size, n_kv)
+        #: bytes one cached token costs across all layers (K + V payload
+        #: plus the f32 scales when quantized) — the decode-bandwidth and
+        #: slot-capacity headline number
+        self.kv_bytes_per_token = (
+            2
+            * mcfg.num_hidden_layers
+            * n_kv
+            * (mcfg.head_dim * np.dtype(store_dtype).itemsize + (4 if quantized else 0))
+        )
+        #: max-length requests the pool holds concurrently (num_blocks is
+        #: fixed for the engine's lifetime — computed once, reported by
+        #: stats() and every telemetry step row)
+        self.kv_slot_capacity = (num_blocks - 1) // cfg.blocks_per_slot
         self.hbm_preflight: dict | None = None
         if cfg.hbm_budget_gb is not None:
-            self._hbm_preflight(inner, shape, dtype, mesh)
+            self._hbm_preflight(inner, shape, store_dtype, mesh)
 
         self.allocator = BlockAllocator(num_blocks)
         self.radix = (
@@ -158,7 +194,8 @@ class InferenceEngine:
             SwapPool(
                 num_layers=shape[0], block_size=cfg.block_size,
                 num_kv_heads=n_kv, head_dim=mcfg.head_dim,
-                dtype=dtype, capacity_gb=cfg.swap_gb,
+                dtype=store_dtype, capacity_gb=cfg.swap_gb,
+                quantized=quantized,
             )
             if cfg.swap_gb and cfg.swap_gb > 0
             else None
@@ -167,8 +204,11 @@ class InferenceEngine:
             cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len,
             radix=self.radix,
         )
-        self._kp = jnp.zeros(shape, dtype)
-        self._vp = jnp.zeros(shape, dtype)
+        self._kp = jnp.zeros(shape, store_dtype)
+        self._vp = jnp.zeros(shape, store_dtype)
+        # all-ones init: a never-written row dequantizes to exactly 0
+        self._ks = jnp.ones(scale_shape, jnp.float32) if quantized else None
+        self._vs = jnp.ones(scale_shape, jnp.float32) if quantized else None
         self._key = jax.random.PRNGKey(cfg.seed)
         self._temp = jnp.float32(cfg.temperature)
         self.mesh = mesh
@@ -252,6 +292,12 @@ class InferenceEngine:
         pool_sharding = paged_kv_sharding(mesh, self._kp.shape[3])
         self._kp = jax.device_put(self._kp, pool_sharding)
         self._vp = jax.device_put(self._vp, pool_sharding)
+        if self._ks is not None:
+            from ..parallel.sharding import paged_kv_scale_sharding
+
+            scale_sharding = paged_kv_scale_sharding(mesh, self._ks.shape[3])
+            self._ks = jax.device_put(self._ks, scale_sharding)
+            self._vs = jax.device_put(self._vs, scale_sharding)
         # scheduler-adjacent scalars must live on the SAME device set as the
         # sharded params — a single-device-committed leaf among mesh-committed
         # ones is an incompatible-devices error at dispatch
@@ -290,18 +336,25 @@ class InferenceEngine:
 
     # -- compiled programs ---------------------------------------------------
 
+    def _paged_kv_dict(self, kp, vp, ks, vs) -> dict:
+        pages = {"k": kp, "v": vp}
+        if self._quantized:
+            pages["k_scale"], pages["v_scale"] = ks, vs
+        return pages
+
     def _build_decode_fn(self):
         apply_fn, cfg = self._apply_fn, self.config
+        quantized = self._quantized
 
-        def decode(params, kp, vp, block_tables, pos0, toks, active, key, temp):
+        def decode(params, kp, vp, ks, vs, block_tables, pos0, toks, active, key, temp):
             self._decode_traces += 1  # traced-body side effect: cache misses only
 
             def one_step(carry, _):
-                kp, vp, toks, pos, key = carry
+                kp, vp, ks, vs, toks, pos, key = carry
                 out = apply_fn(
                     params,
                     input_ids=toks,
-                    paged_kv={"k": kp, "v": vp},
+                    paged_kv=self._paged_kv_dict(kp, vp, ks, vs),
                     block_tables=block_tables,
                     cache_positions=pos,
                     paged_write_mask=active,  # PREFILL/free lanes must not scribble
@@ -312,24 +365,45 @@ class InferenceEngine:
                     temp, cfg.do_sample, has_eos=False,  # eos is host-side state
                 )
                 pages = out["paged_kv"]
-                return (pages["k"], pages["v"], tok[:, None], pos + 1, key), tok
+                ks2 = pages.get("k_scale", ks)
+                vs2 = pages.get("v_scale", vs)
+                return (
+                    pages["k"], pages["v"], ks2, vs2, tok[:, None], pos + 1, key
+                ), tok
 
-            (kp, vp, _, _, key), toks_out = jax.lax.scan(
-                one_step, (kp, vp, toks, pos0, key), None, length=cfg.decode_burst
+            (kp, vp, ks, vs, _, _, key), toks_out = jax.lax.scan(
+                one_step, (kp, vp, ks, vs, toks, pos0, key), None,
+                length=cfg.decode_burst,
             )
-            return kp, vp, toks_out, key  # toks_out: [decode_burst, num_slots]
+            return kp, vp, ks, vs, toks_out, key  # toks_out: [burst, num_slots]
 
-        return jax.jit(decode, donate_argnums=(1, 2))
+        # scale arrays are donated pool operands exactly like the pools —
+        # at kv_dtype="auto"/"bf16"/"f32" they are None-free placeholders
+        # that never reach the jit (see _decode_once)
+        donate = (1, 2, 3, 4) if quantized else (1, 2)
+        if quantized:
+            return jax.jit(decode, donate_argnums=donate)
+
+        def decode_plain(params, kp, vp, block_tables, pos0, toks, active, key, temp):
+            kp, vp, _, _, toks_out, key = decode(
+                params, kp, vp, None, None, block_tables, pos0, toks, active,
+                key, temp,
+            )
+            return kp, vp, toks_out, key
+
+        return jax.jit(decode_plain, donate_argnums=donate)
 
     def _build_prefill_fn(self):
         apply_fn, cfg = self._apply_fn, self.config
+        quantized = self._quantized
 
-        def prefill(params, kp, vp, block_table, start, chunk, valid, last_idx, key, temp):
+        def prefill(params, kp, vp, ks, vs, block_table, start, chunk, valid,
+                    last_idx, key, temp):
             self._prefill_traces += 1
             out = apply_fn(
                 params,
                 input_ids=chunk,  # [1, prefill_chunk]
-                paged_kv={"k": kp, "v": vp},
+                paged_kv=self._paged_kv_dict(kp, vp, ks, vs),
                 block_tables=block_table,  # [1, mb]
                 cache_positions=start,  # [1]
                 paged_write_mask=valid,  # drops the padded tail
@@ -342,9 +416,20 @@ class InferenceEngine:
                 temp, cfg.do_sample, has_eos=False,
             )
             pages = out["paged_kv"]
-            return pages["k"], pages["v"], tok[0], logits[0], key
+            ks2 = pages.get("k_scale", ks)
+            vs2 = pages.get("v_scale", vs)
+            return pages["k"], pages["v"], ks2, vs2, tok[0], logits[0], key
 
-        return jax.jit(prefill, donate_argnums=(1, 2))
+        if quantized:
+            return jax.jit(prefill, donate_argnums=(1, 2, 3, 4))
+
+        def prefill_plain(params, kp, vp, block_table, start, chunk, valid,
+                          last_idx, key, temp):
+            out = prefill(params, kp, vp, None, None, block_table, start, chunk,
+                          valid, last_idx, key, temp)
+            return out[0], out[1], out[4], out[5], out[6]
+
+        return jax.jit(prefill_plain, donate_argnums=(1, 2))
 
     # -- public API ----------------------------------------------------------
 
@@ -468,6 +553,14 @@ class InferenceEngine:
             "tokens_emitted": self._tokens_emitted,
             "decode_compiles": self._decode_traces,
             "prefill_compiles": self._prefill_traces,
+            # kv_dtype policy: bytes one cached token moves/holds (K+V
+            # payload + scales across layers) and how many max-length
+            # requests the pool can hold concurrently — the capacity rows
+            # `serve --auto-blocks` and `bench.py kv` report ratios of
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_token": self.kv_bytes_per_token,
+            "kv_bytes_per_block": self.kv_bytes_per_token * self.config.block_size,
+            "kv_slot_capacity": self.kv_slot_capacity,
             "free_blocks": self.allocator.free_count,
             # blocks live requests hold (shared prefix blocks included);
             # blocks held ONLY by the radix cache are reported separately —
@@ -562,13 +655,25 @@ class InferenceEngine:
             ids = np.full((m,), NULL_BLOCK, np.int32)
             k_rows = np.zeros((layers, m, bs, kv, hd), dtype)
             v_rows = np.zeros_like(k_rows)
+            ks_rows = vs_rows = None
+            if self._quantized:
+                ks_rows = np.ones((layers, m, bs, kv), np.float32)
+                vs_rows = np.ones_like(ks_rows)
             for j, (idx, handle) in enumerate(req.swap_plan):
                 ids[j] = req.blocks[idx]
-                k, v = self._swap.load(handle)
+                k, v, ksc, vsc = self._swap.load(handle)
                 k_rows[:, j] = k
                 v_rows[:, j] = v
+                if self._quantized:
+                    ks_rows[:, j] = ksc
+                    vs_rows[:, j] = vsc
             self._kp = self._write_blocks_fn(self._kp, ids, k_rows)
             self._vp = self._write_blocks_fn(self._vp, ids, v_rows)
+            if self._quantized:
+                # scale rows ride the same batched restore — a quantized
+                # block without its scales is garbage, so they move as one
+                self._ks = self._write_blocks_fn(self._ks, ids, ks_rows)
+                self._vs = self._write_blocks_fn(self._vs, ids, vs_rows)
             for _, handle in req.swap_plan:
                 self._swap.release(handle)
             self._swapped_in_blocks += n
@@ -581,6 +686,11 @@ class InferenceEngine:
             src, dst = req.cow
             self._kp = self._copy_block_fn(self._kp, np.int32(src), np.int32(dst))
             self._vp = self._copy_block_fn(self._vp, np.int32(src), np.int32(dst))
+            if self._quantized:
+                # the CoW copy is byte-exact for payload AND scales: the
+                # private copy dequantizes identically to the cached block
+                self._ks = self._copy_block_fn(self._ks, np.int32(src), np.int32(dst))
+                self._vs = self._copy_block_fn(self._vs, np.int32(src), np.int32(dst))
             self.allocator.decref([src])  # drop the eviction pin
             req.cow = None
 
@@ -618,8 +728,19 @@ class InferenceEngine:
             idx[:n] = released
             k_rows = jax.device_get(self._kp[:, idx])  # [layers, m, bs, kv, hd]
             v_rows = jax.device_get(self._vp[:, idx])
+            ks_rows = vs_rows = None
+            if self._quantized:
+                ks_rows = jax.device_get(self._ks[:, idx])  # [layers, m, bs, kv]
+                vs_rows = jax.device_get(self._vs[:, idx])
             for j, i in enumerate(swappable):
-                plan.append((i, self._swap.store(k_rows[:, j], v_rows[:, j])))
+                plan.append((
+                    i,
+                    self._swap.store(
+                        k_rows[:, j], v_rows[:, j],
+                        None if ks_rows is None else ks_rows[:, j],
+                        None if vs_rows is None else vs_rows[:, j],
+                    ),
+                ))
         # refcount-1 blocks return to the freelist; cache-shared ones stay
         # allocated under the cache's own (now sole, evictable) reference
         self.allocator.decref(released)
@@ -660,12 +781,21 @@ class InferenceEngine:
         is_final = end == req.prompt_len
         last_idx = np.int32((req.prompt_len - 1) - start if is_final else 0)
 
-        self._kp, self._vp, tok, _logits, self._key = self._prefill_fn(
-            self._params, self._kp, self._vp,
-            self._block_tables[req.slot : req.slot + 1],
-            np.asarray([start], np.int32), chunk, valid, last_idx,
-            self._key, self._temp,
-        )
+        if self._quantized:
+            (self._kp, self._vp, self._ks, self._vs, tok, _logits,
+             self._key) = self._prefill_fn(
+                self._params, self._kp, self._vp, self._ks, self._vs,
+                self._block_tables[req.slot : req.slot + 1],
+                np.asarray([start], np.int32), chunk, valid, last_idx,
+                self._key, self._temp,
+            )
+        else:
+            self._kp, self._vp, tok, _logits, self._key = self._prefill_fn(
+                self._params, self._kp, self._vp,
+                self._block_tables[req.slot : req.slot + 1],
+                np.asarray([start], np.int32), chunk, valid, last_idx,
+                self._key, self._temp,
+            )
         req.prefill_pos = end
         if is_final:
             if self.radix is not None:
@@ -758,19 +888,29 @@ class InferenceEngine:
         # check below stays unconditional — it is just two int compares
         decode_sig = None
         if _get_sanitizer() or get_active_recorder():
+            args = [
+                ("kp", self._kp), ("vp", self._vp),
+                ("block_tables", self._block_tables), ("pos0", pos0),
+                ("toks", toks), ("active", active), ("key", self._key),
+                ("temp", self._temp),
+            ]
+            if self._quantized:
+                args[2:2] = [("ks", self._ks), ("vs", self._vs)]
             decode_sig = tuple(
                 (name, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
-                for name, v in (
-                    ("kp", self._kp), ("vp", self._vp),
-                    ("block_tables", self._block_tables), ("pos0", pos0),
-                    ("toks", toks), ("active", active), ("key", self._key),
-                    ("temp", self._temp),
-                )
+                for name, v in args
             )
-        self._kp, self._vp, next_toks, self._key = self._decode_fn(
-            self._params, self._kp, self._vp, self._block_tables, pos0, toks,
-            active, self._key, self._temp,
-        )
+        if self._quantized:
+            (self._kp, self._vp, self._ks, self._vs, next_toks,
+             self._key) = self._decode_fn(
+                self._params, self._kp, self._vp, self._ks, self._vs,
+                self._block_tables, pos0, toks, active, self._key, self._temp,
+            )
+        else:
+            self._kp, self._vp, next_toks, self._key = self._decode_fn(
+                self._params, self._kp, self._vp, self._block_tables, pos0, toks,
+                active, self._key, self._temp,
+            )
         self._check_one_executable(decode_sig)
         next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
         for req in live:
@@ -869,6 +1009,9 @@ class InferenceEngine:
                 active_slots=len(sched.active()),
                 slot_occupancy=sched.occupancy,
                 free_blocks=self.allocator.free_count,
+                kv_dtype=self.kv_dtype,
+                kv_bytes_per_token=self.kv_bytes_per_token,
+                kv_slot_capacity=self.kv_slot_capacity,
                 decode_compiles=self._decode_traces,
                 # cumulative totals: the monitor reads a bounded JSONL tail,
                 # so run-total counts must ride every row, not be re-counted

@@ -260,14 +260,25 @@ class SwapPool:
         head_dim: int,
         dtype,
         capacity_gb: float,
+        quantized: bool = False,
     ):
         self.block_shape = (int(num_layers), int(block_size), int(num_kv_heads), int(head_dim))
         self.dtype = np.dtype(dtype)
+        self.quantized = bool(quantized)
         per_block = 2 * self.dtype.itemsize * int(np.prod(self.block_shape))  # K + V
+        if self.quantized:
+            # f32 amax scale rows ([layers, bs, n_kv] for K and V) park
+            # beside the payload: a quantized block is meaningless without
+            # them, and they must survive the round trip byte-exact
+            self.scale_shape = self.block_shape[:-1]
+            per_block += 2 * 4 * int(np.prod(self.scale_shape))
         self.bytes_per_block = per_block
         self.capacity_blocks = max(0, int(capacity_gb * (1 << 30)) // per_block)
         self._k = np.zeros((self.capacity_blocks, *self.block_shape), self.dtype)
         self._v = np.zeros_like(self._k)
+        if self.quantized:
+            self._ks = np.zeros((self.capacity_blocks, *self.scale_shape), np.float32)
+            self._vs = np.zeros_like(self._ks)
         self._free = list(range(self.capacity_blocks - 1, -1, -1))
         self._held: set[int] = set()
 
@@ -282,23 +293,33 @@ class SwapPool:
     def can_hold(self, n_blocks: int) -> bool:
         return n_blocks <= len(self._free)
 
-    def store(self, k_rows, v_rows) -> int:
-        """Park one block's K/V rows; returns the swap handle."""
+    def store(self, k_rows, v_rows, k_scale_rows=None, v_scale_rows=None) -> int:
+        """Park one block's K/V rows (+ scale rows when quantized);
+        returns the swap handle."""
         if not self._free:
             raise RuntimeError(
                 f"swap pool exhausted ({self.capacity_blocks} blocks, "
                 f"{self.bytes_per_block} B each): raise swap_gb"
             )
+        if self.quantized and (k_scale_rows is None or v_scale_rows is None):
+            raise ValueError("quantized swap pool needs scale rows on store()")
         slot = self._free.pop()
         self._k[slot] = np.asarray(k_rows, self.dtype)
         self._v[slot] = np.asarray(v_rows, self.dtype)
+        if self.quantized:
+            self._ks[slot] = np.asarray(k_scale_rows, np.float32)
+            self._vs[slot] = np.asarray(v_scale_rows, np.float32)
         self._held.add(slot)
         return slot
 
-    def load(self, handle: int) -> tuple[np.ndarray, np.ndarray]:
+    def load(self, handle: int):
+        """``(k, v, k_scale, v_scale)`` — the scale pair is ``None`` for
+        non-quantized pools."""
         if handle not in self._held:
             raise ValueError(f"swap handle {handle} is not held")
-        return self._k[handle], self._v[handle]
+        if self.quantized:
+            return self._k[handle], self._v[handle], self._ks[handle], self._vs[handle]
+        return self._k[handle], self._v[handle], None, None
 
     def release(self, handle: int) -> None:
         if handle not in self._held:

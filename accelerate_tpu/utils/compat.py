@@ -33,6 +33,44 @@ else:
         return jax.lax.psum(1, axis_name)
 
 
+def has_pallas() -> bool:
+    """Whether ``jax.experimental.pallas`` (+ the TPU dialect) imports on
+    this jax generation. Import failure — not backend identity — is the
+    compat question; backend routing lives in
+    :func:`default_paged_attention_impl`."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+def has_fp8_storage() -> bool:
+    """Whether ``jnp.float8_e4m3fn`` exists AND round-trips through a cast
+    on this jax/jaxlib pair (older stacks expose the dtype but fail to
+    lower the convert on some backends)."""
+    import jax.numpy as jnp
+
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return False
+    try:
+        jnp.zeros((2,), jnp.float32).astype(jnp.float8_e4m3fn).astype(jnp.float32)
+    except Exception:
+        return False
+    return True
+
+
+def default_paged_attention_impl() -> str:
+    """Kernel routing for :func:`ops.paged_attention.paged_attention`:
+    the Pallas block-table kernel on TPU backends where pallas imports,
+    the pure-lax scan-over-blocks fallback everywhere else (CPU/GPU, and
+    jax generations without a working pallas TPU dialect)."""
+    if jax.default_backend() == "tpu" and has_pallas():
+        return "pallas"
+    return "lax"
+
+
 if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map
 else:

@@ -256,9 +256,10 @@ def test_swap_pool_round_trip_and_capacity():
     v = rng.standard_normal((2, 4, 2, 8)).astype(np.float32)
     h = pool.store(k, v)
     assert pool.used_blocks == 1 and pool.can_hold(2) and not pool.can_hold(3)
-    k2, v2 = pool.load(h)
+    k2, v2, ks2, vs2 = pool.load(h)
     np.testing.assert_array_equal(k, k2)
     np.testing.assert_array_equal(v, v2)
+    assert ks2 is None and vs2 is None  # non-quantized pool carries no scales
     pool.release(h)
     assert pool.used_blocks == 0
     with pytest.raises(ValueError, match="double release"):

@@ -423,3 +423,253 @@ def test_serving_telemetry_rows_and_monitor(tiny_model, tmp_path):
     assert status["serving"]["completed"] == 3
     assert status["serving"]["decode_compiles"] == 1
     assert "serving:" in render_status(status)
+
+
+# ---------------------------------------------------------------------------
+# quantized KV cache: the kv_dtype parity matrix
+# {bf16, int8, fp8} x {dense-equivalence, prefix-hit/CoW, swap round-trip,
+# sharded mesh}. Tolerances here are THE documented numbers
+# (docs/source/usage_guides/serving.md); within one engine a kv_dtype is
+# deterministic, so the sharing/swap/mesh legs assert token-identity.
+# ---------------------------------------------------------------------------
+
+#: |paged last-token logits - dense decode logits| ceiling per kv_dtype on
+#: the tiny f32 model (storage rounding only — same attention math)
+KV_LOGIT_ATOL = {"bf16": 0.06, "int8": 0.12, "fp8": 0.35}
+
+KV_DTYPES = ("bf16", "int8", "fp8")
+
+
+def _skip_without_fp8(kv_dtype: str) -> None:
+    """fp8 is a documented graceful-degradation path (the engine raises a
+    guidance error where f8 casts don't lower) — skip its legs there."""
+    if kv_dtype == "fp8":
+        from accelerate_tpu.utils.compat import has_fp8_storage
+
+        if not has_fp8_storage():
+            pytest.skip("float8_e4m3fn storage unsupported on this jax stack")
+
+
+def test_engine_kv_stats_and_capacity_math(tiny_model):
+    """stats() carries the kv_dtype policy rows, and the byte math is the
+    documented formula: 2 pools x layers x n_kv x (hd x itemsize + 4-byte
+    scale when quantized)."""
+    cfg = tiny_model.config
+    expect = {
+        "auto": 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * cfg.head_dim * 4,
+        "bf16": 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * cfg.head_dim * 2,
+        "int8": 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * (cfg.head_dim + 4),
+    }
+    for kv_dtype, bytes_per_token in expect.items():
+        eng = InferenceEngine(
+            tiny_model,
+            EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                         kv_dtype=kv_dtype),
+        )
+        st = eng.stats()
+        assert st["kv_bytes_per_token"] == bytes_per_token
+        assert st["kv_bytes_per_block"] == bytes_per_token * 8
+        assert st["kv_slot_capacity"] == 2  # full residency: both slots fit
+        has_scales = eng._ks is not None
+        assert has_scales == (kv_dtype == "int8")
+    with pytest.raises(ValueError, match="unknown kv_dtype"):
+        InferenceEngine(
+            tiny_model, EngineConfig(num_slots=2, max_seq_len=64, kv_dtype="int4")
+        )
+
+
+def test_swap_pool_quantized_scales_byte_exact():
+    """A quantized SwapPool round-trips payload AND f32 scale rows
+    byte-exactly (a quantized block without its exact scales is garbage),
+    and prices both into bytes_per_block."""
+    from accelerate_tpu.serving import SwapPool
+
+    shape = (2, 4, 2, 8)  # layers, bs, n_kv, hd
+    per_block = 2 * int(np.prod(shape)) + 2 * 4 * int(np.prod(shape[:-1]))
+    pool = SwapPool(num_layers=2, block_size=4, num_kv_heads=2, head_dim=8,
+                    dtype=np.int8, capacity_gb=2 * per_block / (1 << 30),
+                    quantized=True)
+    assert pool.bytes_per_block == per_block
+    assert pool.capacity_blocks == 2
+    rng = np.random.default_rng(0)
+    k = rng.integers(-127, 128, size=shape).astype(np.int8)
+    v = rng.integers(-127, 128, size=shape).astype(np.int8)
+    ks = rng.random(shape[:-1]).astype(np.float32)
+    vs = rng.random(shape[:-1]).astype(np.float32)
+    h = pool.store(k, v, ks, vs)
+    k2, v2, ks2, vs2 = pool.load(h)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    np.testing.assert_array_equal(ks, ks2)  # byte-exact, not allclose
+    np.testing.assert_array_equal(vs, vs2)
+    with pytest.raises(ValueError, match="needs scale rows"):
+        pool.store(k, v)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_kv_dtype_paged_logits_match_dense(tiny_model, kv_dtype):
+    """Dense-equivalence leg: chunk-prefilling through a quantized pool
+    yields last-token logits within the documented tolerance of the dense
+    one-shot prefill (the acceptance bar's logit contract)."""
+    _skip_without_fp8(kv_dtype)
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.fp8 import kv_storage_dtype
+
+    model = tiny_model
+    cfg = model.config
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 64, size=(1, 13)).astype(np.int32)
+    dense = model.apply_fn(model.params, input_ids=ids, use_cache=True, max_cache_len=16)
+    ref = np.asarray(dense["logits"][:, -1, :], np.float32)
+
+    store_dtype, quantized = kv_storage_dtype(kv_dtype)
+    bs, nb, mb = 8, 6, 4
+    shape = (cfg.num_hidden_layers, nb, bs, cfg.num_key_value_heads, cfg.head_dim)
+    pages = {"k": jnp.zeros(shape, store_dtype), "v": jnp.zeros(shape, store_dtype)}
+    if quantized:
+        pages["k_scale"] = jnp.ones(shape[:-1], jnp.float32)
+        pages["v_scale"] = jnp.ones(shape[:-1], jnp.float32)
+    bt = np.zeros((1, mb), np.int32)
+    bt[0, :2] = [1, 2]
+    got = None
+    for start in range(0, 16, 8):
+        end = min(start + 8, 13)
+        if start >= 13:
+            break
+        chunk = np.zeros((1, 8), np.int32)
+        chunk[0, : end - start] = ids[0, start:end]
+        mask = np.zeros((1, 8), bool)
+        mask[0, : end - start] = True
+        out = model.apply_fn(
+            model.params, input_ids=chunk, paged_kv=pages, block_tables=bt,
+            cache_positions=np.asarray([start], np.int32), paged_write_mask=mask,
+        )
+        pages = out["paged_kv"]
+        if quantized:
+            assert "k_scale" in pages and "v_scale" in pages
+        if end == 13:
+            got = np.asarray(out["logits"][0, (13 - 1) - start, :], np.float32)[None]
+    assert np.abs(got - ref).max() < KV_LOGIT_ATOL[kv_dtype]
+
+
+@pytest.mark.slow
+def test_kv_bf16_greedy_token_identical_to_generate():
+    """At kv_dtype="bf16" on a bf16 model the engine's greedy output stays
+    token-identical to generate(use_cache=True) — bf16 storage is a cast,
+    not a quantization, so the PR 4 parity contract survives the fused
+    kernel unchanged."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4, seq=96)
+    model = LlamaForCausalLM.from_config(config, seed=0, dtype=jnp.bfloat16)
+    engine = InferenceEngine(
+        model,
+        EngineConfig(num_slots=3, block_size=8, max_seq_len=64, prefill_chunk=8,
+                     kv_dtype="bf16"),
+    )
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32) for n in (5, 11, 17)]
+    reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+    engine.run_until_idle(max_iterations=5000)
+    for p, r in zip(prompts, reqs):
+        ref = np.asarray(
+            generate(model, p[None, :], max_new_tokens=8, use_cache=True)
+        )[0]
+        np.testing.assert_array_equal(
+            np.concatenate([p, np.asarray(r.output_tokens, np.int32)]), ref
+        )
+    assert engine.stats()["decode_compiles"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_kv_dtype_prefix_hit_and_cow_parity(tiny_model, kv_dtype):
+    """Prefix-hit/CoW leg: a warm engine serving a shared-prefix prompt
+    (full-block hit + partial-block CoW divergence) emits the same tokens
+    as a cold engine at the same kv_dtype — adopted quantized blocks and
+    CoW copies reuse the exact stored bytes + scales, so within one
+    kv_dtype the cache is invisible."""
+    _skip_without_fp8(kv_dtype)
+    def run(warm):
+        eng = InferenceEngine(
+            tiny_model,
+            EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                         prefill_chunk=8, kv_dtype=kv_dtype, prefix_cache=warm),
+        )
+        base = np.arange(20, dtype=np.int32) % 60
+        r1 = eng.add_request(base, 6)
+        eng.run_until_idle(max_iterations=5000)
+        # full-block hit (same 16-token prefix) + mid-block divergence
+        shared = np.concatenate([base[:19], np.asarray([61], np.int32)])
+        r2 = eng.add_request(shared, 6)
+        eng.run_until_idle(max_iterations=5000)
+        return eng, r1.output_tokens, r2.output_tokens
+
+    warm_eng, w1, w2 = run(True)
+    _, c1, c2 = run(False)
+    assert (w1, w2) == (c1, c2)
+    st = warm_eng.stats()
+    assert st["prefix_hit_tokens"] > 0  # the warm leg really hit the cache
+    assert st["decode_compiles"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_kv_dtype_swap_round_trip_parity(tiny_model, kv_dtype):
+    """Swap leg: under pool pressure with the host swap tier on, both
+    requests complete un-truncated and token-identical to a
+    full-residency run at the same kv_dtype — quantized payload + scale
+    rows survived swap-out -> swap-in exactly."""
+    _skip_without_fp8(kv_dtype)
+    geom = dict(num_slots=2, block_size=8, max_seq_len=64, prefill_chunk=8,
+                prefix_cache=False, kv_dtype=kv_dtype)
+    prompts = [np.arange(8, dtype=np.int32), np.arange(8, dtype=np.int32) + 1]
+
+    def run(num_blocks=None, swap_gb=0.0):
+        eng = InferenceEngine(
+            tiny_model, EngineConfig(num_blocks=num_blocks, swap_gb=swap_gb, **geom)
+        )
+        reqs = [eng.add_request(p, max_new_tokens=30) for p in prompts]
+        eng.run_until_idle(max_iterations=5000)
+        return eng.stats(), reqs
+
+    swap_stats, swapped = run(num_blocks=6, swap_gb=0.01)
+    assert [r.finish_reason for r in swapped] == ["length", "length"]
+    assert swap_stats["preemptions"] >= 1
+    assert swap_stats["swapped_out_blocks"] == swap_stats["swapped_in_blocks"] > 0
+    assert swap_stats["decode_compiles"] == 1
+    _, full = run()
+    for s, f in zip(swapped, full):
+        assert s.output_tokens == f.output_tokens
+
+
+@pytest.mark.slow
+def test_kv_int8_sharded_mesh_parity(tiny_model):
+    """Sharded-mesh leg: the int8 engine over fsdp=2 x tp=2 is
+    token-identical to the single-device int8 engine, the scale arrays
+    shard their kv-head dim alongside the pools, and the
+    one-decode-executable contract holds."""
+    mesh = _mesh4()
+    geometry = dict(num_slots=3, block_size=8, max_seq_len=64, prefill_chunk=8,
+                    decode_burst=2, kv_dtype="int8")
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32) for n in (5, 12, 9)]
+
+    def run(mesh_arg):
+        engine = InferenceEngine(tiny_model, EngineConfig(**geometry), mesh=mesh_arg)
+        reqs = [engine.add_request(p, b) for p, b in zip(prompts, (4, 7, 5))]
+        engine.run_until_idle(max_iterations=5000)
+        return engine, [list(r.output_tokens) for r in reqs]
+
+    _, single_tokens = run(None)
+    sharded, sharded_tokens = run(mesh)
+    assert sharded_tokens == single_tokens
+    assert sharded.stats()["decode_compiles"] == 1
+    full = sharded._ks.shape
+    shard_shapes = {s.data.shape for s in sharded._ks.addressable_shards}
+    assert shard_shapes == {(*full[:3], full[3] // 2)}

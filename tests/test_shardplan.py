@@ -611,3 +611,123 @@ class TestShardCheckCLI:
         assert proc.returncode == 0
         for rid in ("SP001", "SP002", "SP003", "SP004", "SP005", "SP006"):
             assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# quantized KV pool planning (kv_dtype policy)
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedKvPlan:
+    def test_plan_kv_pool_int8_adds_scale_leaves(self):
+        """int8/fp8 dtypes emit the two f32 amax scale leaves beside the
+        payload, kv-head dim sharded over tp like the pools."""
+        from accelerate_tpu.analysis.shardplan import plan_kv_pool
+
+        kw = dict(num_layers=2, num_kv_heads=4, head_dim=8, num_slots=2,
+                  block_size=8, max_seq_len=64, mesh_sizes=MESH_SIZES)
+        plans = plan_kv_pool(dtype="int8", **kw)
+        assert [p.path for p in plans] == [
+            "kv_pool.k", "kv_pool.v", "kv_pool.k_scale", "kv_pool.v_scale"
+        ]
+        k = next(p for p in plans if p.path == "kv_pool.k")
+        ks = next(p for p in plans if p.path == "kv_pool.k_scale")
+        nb = 2 * 8 + 1
+        assert k.bytes_global == 2 * nb * 8 * 4 * 8 * 1          # int8 payload
+        assert ks.bytes_global == 2 * nb * 8 * 4 * 4             # f32 scales
+        assert k.bytes_per_device == k.bytes_global // 2         # tp=2
+        assert ks.bytes_per_device == ks.bytes_global // 2
+        assert "'tp'" in ks.spec
+        # fp8 spelling aliases float8_e4m3fn at the same byte cost
+        fp8 = plan_kv_pool(dtype="fp8", **kw)
+        assert [p.bytes_global for p in fp8] == [p.bytes_global for p in plans]
+        assert fp8[0].dtype == "float8_e4m3fn"
+        # float dtypes stay two scale-free leaves (the PR 8 behaviour)
+        assert len(plan_kv_pool(dtype="bfloat16", **kw)) == 2
+
+    def test_plan_swap_pool_quantized_matches_live_swap_pool(self):
+        """plan_swap_pool's per-block bytes at int8 equal the live
+        SwapPool's (payload + scale mirrors)."""
+        from accelerate_tpu.analysis.shardplan import plan_swap_pool
+        from accelerate_tpu.serving import SwapPool
+
+        geom = dict(num_layers=2, num_kv_heads=4, head_dim=8, block_size=8)
+        plan = plan_swap_pool(swap_gb=0.001, dtype="int8", **geom)
+        live = SwapPool(dtype=np.int8, capacity_gb=0.001, quantized=True, **geom)
+        assert plan["bytes_per_block"] == live.bytes_per_block
+        assert plan["swap_blocks"] == live.capacity_blocks
+
+    def test_int8_predicted_pool_bytes_match_live_engine_exactly(self, tiny_paged_model):
+        """The acceptance invariant at kv_dtype="int8": predicted kv-pool
+        tier bytes (payload + scales) == the live sharded engine's
+        _kp/_vp/_ks/_vs shard bytes, per device, exactly."""
+        from accelerate_tpu.analysis.shardplan import mesh_sizes_of, plan_kv_pool
+        from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+        mesh = _mesh4()
+        cfg = tiny_paged_model.config
+        geometry = dict(num_slots=2, block_size=8, max_seq_len=64)
+        engine = InferenceEngine(
+            tiny_paged_model, EngineConfig(kv_dtype="int8", **geometry), mesh=mesh
+        )
+        plans = plan_kv_pool(
+            num_layers=cfg.num_hidden_layers,
+            num_kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.head_dim,
+            mesh_sizes=mesh_sizes_of(mesh),
+            dtype="int8",
+            **geometry,
+        )
+        dev0 = engine._kp.addressable_shards[0].device
+        live = sum(
+            int(s.data.nbytes)
+            for arr in (engine._kp, engine._vp, engine._ks, engine._vs)
+            for s in arr.addressable_shards
+            if s.device == dev0
+        )
+        assert live == sum(p.bytes_per_device for p in plans)
+
+    def test_auto_blocks_capacity_ratio_int8_vs_bf16(self):
+        """At equal HBM budget the int8 pool holds ~2x the blocks of the
+        bf16 pool (2*hd / (hd+4) — 1.94x at the flagship's hd=128): the
+        auto_num_blocks sizing this CLI flag and bench ratio both use."""
+        from accelerate_tpu.analysis.shardplan import auto_num_blocks, plan_kv_pool
+
+        sizes = {ax: 1 for ax in MESH_SIZES}
+        per_block = {}
+        for dtype in ("bfloat16", "int8"):
+            per_block[dtype] = sum(
+                p.bytes_per_device
+                for p in plan_kv_pool(
+                    num_layers=16, num_kv_heads=12, head_dim=128, num_slots=1,
+                    block_size=16, max_seq_len=512, num_blocks=1,
+                    mesh_sizes=sizes, dtype=dtype,
+                )
+            )
+        budget, params = 8 << 30, 2 << 30
+        blocks = {
+            d: auto_num_blocks(budget, params, pb, full_residency_blocks=10**9,
+                               min_blocks=2)[0]
+            for d, pb in per_block.items()
+        }
+        ratio = blocks["int8"] / blocks["bfloat16"]
+        assert ratio >= 1.8
+        assert abs(ratio - 2 * 128 / (128 + 4)) < 0.01
+
+    def test_shard_check_cli_kv_dtype_json(self):
+        """--kv-dtype int8 flows through the real CLI: the JSON report's
+        kv_pool tier carries the scale leaves."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "shard-check", "--preset", "tiny", "--virtual", "dp=1,fsdp=1,tp=1",
+             "--kv-dtype", "int8", "--json", "--leaves"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout)
+        paths = [l["path"] for l in report["leaves"] if l["tier"] == "kv_pool"]
+        assert "kv_pool.k_scale" in paths and "kv_pool.v_scale" in paths
+        assert next(
+            l for l in report["leaves"] if l["path"] == "kv_pool.k"
+        )["dtype"] == "int8"
