@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -49,3 +49,6 @@ radix-smoke:      ## shared-prefix trace hits the radix cache (>0 ratio, one dec
 
 kvq-smoke:        ## quantized KV cache: int8 holds ~2x the blocks of bf16 at equal budget and completes the pressure trace un-truncated; fused == gather on the same bytes
 	python benchmarks/kvq_smoke.py
+
+chaos-smoke:      ## seeded kill -9 / 503 / delay schedule vs a supervised fleet: exactly-once delivery, zero orphans, respawn-with-backoff recovery to target count
+	python benchmarks/chaos_smoke.py

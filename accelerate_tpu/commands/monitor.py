@@ -29,9 +29,16 @@ def monitor_command(args) -> int:
       replica is dead or its router rows went stale mid-run, or the
       per-host collective-sequence digests diverge (a pre-deadlock
       condition: the sanitizer writes one digest file per host, and
-      disagreement means a cross-host collective will never match up)
+      disagreement means a cross-host collective will never match up).
+      A supervised replica waiting out its respawn backoff still counts
+      as dead — the condition clears itself once the respawned process
+      writes a fresh ``ready`` row (newest row per replica wins)
     * ``3`` — an ``ACCELERATE_SLO_*`` alert rule is firing (``ALERTS.json``
       written next to the run's artifacts; wedged/hang wins when both hold)
+
+    Precedence is fixed: ``1`` (usage) > ``2`` (wedged/dead/divergence) >
+    ``3`` (SLO) > ``0`` — a wedged fleet must not be masked by a mere SLO
+    breach, and scripts can rely on the ordering.
     """
     from ..diagnostics.monitor import collect_status, render_status
     from ..metrics.alerts import EXIT_SLO_VIOLATION, evaluate_alerts, write_alerts

@@ -34,7 +34,7 @@ _ENGINE_FLAGS = (
     ("--prefill-chunk", "prefill_chunk"), ("--decode-burst", "decode_burst"),
     ("--max-new-tokens", "max_new_tokens"), ("--eos-token-id", "eos_token_id"),
     ("--temperature", "temperature"), ("--seed", "seed"),
-    ("--kv-dtype", "kv_dtype"),
+    ("--kv-dtype", "kv_dtype"), ("--chaos-spec", "chaos_spec"),
 )
 
 
@@ -57,19 +57,69 @@ def route_command(args) -> int:
     if args.logging_dir:
         os.makedirs(args.logging_dir, exist_ok=True)
 
+    def spawn_fn(replica_id: int):
+        """One replica's spawn recipe — shared by bring-up and the
+        supervisor's respawn/scale-up paths, so a respawned replica is
+        byte-identical in configuration to the one it replaces."""
+        serve_tail = _serve_args(args)
+        if args.logging_dir:
+            # one telemetry trail per replica — two processes appending
+            # the same telemetry.jsonl would interleave torn rows
+            serve_tail += ["--logging-dir",
+                           os.path.join(args.logging_dir, f"replica_{replica_id}")]
+        return spawn_replica(replica_id, serve_tail, stderr=sys.stderr)
+
     replicas = []
     if args.attach:
         for i, url in enumerate(x for x in args.attach.split(",") if x):
             replicas.append(ReplicaHandle(i, url))
     else:
-        for i in range(args.replicas):
-            serve_tail = _serve_args(args)
-            if args.logging_dir:
-                # one telemetry trail per replica — two processes appending
-                # the same telemetry.jsonl would interleave torn rows
-                serve_tail += ["--logging-dir",
-                               os.path.join(args.logging_dir, f"replica_{i}")]
-            replicas.append(spawn_replica(i, serve_tail, stderr=sys.stderr))
+        try:
+            for i in range(args.replicas):
+                replicas.append(spawn_fn(i))
+        except Exception:
+            # a failed spawn mid-loop must not strand the earlier spawns:
+            # kill + reap everything before the exception surfaces
+            for r in replicas:
+                r.kill()
+            for r in replicas:
+                r.wait(timeout=10.0)
+            raise
+
+    supervisor = None
+    wants_supervision = (
+        args.respawn
+        or args.max_replicas is not None
+        or args.min_replicas is not None
+    )
+    if wants_supervision:
+        if args.attach:
+            print(
+                "route: --respawn/--min-replicas/--max-replicas need spawned "
+                "replicas (they respawn via the serve spawn recipe) — "
+                "ignoring for an --attach fleet", file=sys.stderr,
+            )
+        else:
+            from ..serving.supervisor import ReplicaSupervisor, SupervisorConfig
+
+            # explicit is-None tests: --min-replicas 0 (scale-to-zero floor)
+            # must not be rewritten to --replicas
+            min_replicas = (
+                args.replicas if args.min_replicas is None else args.min_replicas
+            )
+            max_replicas = (
+                args.replicas if args.max_replicas is None else args.max_replicas
+            )
+            supervisor = ReplicaSupervisor(
+                spawn_fn,
+                SupervisorConfig(
+                    min_replicas=min_replicas,
+                    max_replicas=max(max_replicas, min_replicas, 1),
+                    respawn=bool(args.respawn),
+                    ready_timeout=args.ready_timeout,
+                    seed=args.seed,
+                ),
+            )
     print(
         f"route: waiting for {len(replicas)} replica(s) to report ready...",
         file=sys.stderr,
@@ -79,10 +129,15 @@ def route_command(args) -> int:
         logging_dir=args.logging_dir,
         health_interval=args.health_interval,
         request_timeout=args.request_timeout,
+        supervisor=supervisor,
+        max_queue_depth=args.max_queue_depth,
     )
     try:
         wait_until_ready(replicas, timeout=args.ready_timeout)
     except Exception as e:
+        # no orphans on failed bring-up: close() kills AND reaps every
+        # spawned replica (and stops the supervisor first, so a respawn
+        # never races the teardown)
         print(f"route: bring-up failed: {e}", file=sys.stderr)
         router.close()
         return 1
@@ -166,10 +221,17 @@ def route_command(args) -> int:
     while not inbox.empty():
         router.submit(inbox.get_nowait(), callback=emit)
     stats = router.stats()
+    sup = stats.get("supervisor") or {}
+    sup_text = (
+        f", {sup['respawns']} respawn(s), {sup['scale_ups']} scale-up(s), "
+        f"{sup['scale_downs']} scale-down(s)" if sup else ""
+    )
     print(
         f"route: delivered {stats['delivered']} "
         f"({stats['tokens']} tokens, {stats['requeues']} requeues, "
-        f"{stats['rejected']} rejected, {stats['dead']} dead replica(s))",
+        f"{stats['rejected']} rejected, {stats['shed']} shed, "
+        f"{stats['deadline_expired']} deadline-expired, "
+        f"{stats['dead']} dead replica(s){sup_text})",
         file=sys.stderr,
     )
     return 0 if clean else 1
@@ -194,7 +256,30 @@ def add_parser(subparsers):
     p.add_argument("--drain-timeout", type=float, default=300.0,
                    help="seconds to wait for in-flight requests + replica exits")
     p.add_argument("--request-timeout", type=float, default=None,
-                   help="per-dispatch HTTP timeout (default: wait forever)")
+                   help="per-dispatch HTTP timeout (default: wait forever); "
+                   "expiry on a slow-but-alive replica requeues the request "
+                   "without marking the replica dead")
+    # self-healing fleet (serving/supervisor.py)
+    p.add_argument("--respawn", action="store_true",
+                   help="supervise the fleet: respawn dead replicas with "
+                   "exponential crash-loop backoff, quarantine flapping ones "
+                   "(half-open probation rejoin), and restore --min-replicas "
+                   "(default off: a dead replica stays dead, the PR 7 "
+                   "fixed-fleet behaviour)")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="fleet floor the supervisor restores after deaths / "
+                   "scale-down (default: --replicas; implies supervision — "
+                   "pair with --respawn for death recovery)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscale ceiling: sustained router queue depth "
+                   "spawns up to this many replicas; an idle fleet drains "
+                   "back to --min-replicas (default: --replicas, i.e. no "
+                   "scaling; implies supervision)")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="bounded-queue admission: over this many queued "
+                   "requests the router sheds batch-class before interactive "
+                   "with explicit over-capacity error rows (default: "
+                   "unbounded)")
     # engine shape passthrough (matches `serve`)
     p.add_argument("--preset", choices=("tiny", "flagship"), default="tiny")
     p.add_argument("--dtype", choices=("f32", "bf16"), default="f32")
@@ -215,5 +300,9 @@ def add_parser(subparsers):
     p.add_argument("--mesh", action="store_true",
                    help="each replica shards its engine over the attached mesh "
                    "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")
+    p.add_argument("--chaos-spec", default=None,
+                   help="forwarded to every replica's serve --chaos-spec "
+                   "(entries scoped rN: fire only on replica N) — the "
+                   "fault-injection harness benchmarks/chaos_smoke.py drives")
     p.set_defaults(func=route_command)
     return p

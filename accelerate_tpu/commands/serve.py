@@ -3,11 +3,15 @@ JSONL on stdin or a local HTTP endpoint.
 
 Request protocol (one JSON object per line / per POST body):
 ``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>,
-"priority": "interactive"|"batch"?}``;
+"priority": "interactive"|"batch"?, "deadline_ms": <number?>}``;
 each completion is written back as
 ``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``. ``priority``
 defaults to ``interactive``; under pool pressure the scheduler swaps
 ``batch`` victims to host DRAM before ever touching interactive ones.
+``deadline_ms`` is a relative budget: once it elapses the scheduler
+finishes the request with ``finish_reason="deadline_exceeded"`` (partial
+tokens kept, KV blocks freed the same iteration); a malformed value is
+answered with an error row, like an unknown ``priority``.
 Prompts are raw token ids — tokenization is deliberately out of scope (the
 engine is model-zoo-generic and this box ships no tokenizer assets).
 
@@ -240,7 +244,8 @@ def _result_dict(req, req_id) -> dict:
     }
 
 
-def _engine_loop(engine, inbox, emit, stop, health=None, handler=None):
+def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
+                 max_queue=None):
     """Drain inbox → step → deliver completion dicts; idle-sleep when empty
     so a quiet server doesn't spin a core. A malformed or over-budget
     request is answered with an ``{"error": ...}`` result — it must never
@@ -269,13 +274,29 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None):
         try:
             while True:
                 payload, cb = inbox.get_nowait()
+                req_id = payload.get("id") if isinstance(payload, dict) else None
+                if (
+                    max_queue is not None
+                    and engine.scheduler.queue_depth >= max_queue
+                ):
+                    # bounded admission: an explicit over-capacity answer
+                    # beats letting the waiting queue grow without limit
+                    # (the router's shed path does class-aware shedding;
+                    # the single-engine bound is a hard backstop)
+                    deliver({
+                        "id": req_id,
+                        "error": f"over capacity: engine queue depth "
+                        f"{engine.scheduler.queue_depth} at --max-queue "
+                        f"{max_queue} — request shed",
+                    }, cb)
+                    continue
                 try:
                     req = engine.add_request(
                         payload["prompt"], payload.get("max_new_tokens"),
                         priority=payload.get("priority", "interactive"),
+                        deadline_ms=payload.get("deadline_ms"),
                     )
                 except Exception as e:  # noqa: BLE001 — reported, not fatal
-                    req_id = payload.get("id") if isinstance(payload, dict) else None
                     deliver({"id": req_id, "error": str(e)}, cb)
                     continue
                 pending[req.request_id] = (payload.get("id"), cb)
@@ -327,6 +348,29 @@ def serve_command(args) -> int:
         with out_lock:
             print(json.dumps(result), flush=True)
 
+    # fault injection (serving/chaos.py): a parse error is a bring-up
+    # refusal — a typo'd spec silently running a clean "chaos" test would
+    # certify nothing
+    from ..serving.chaos import ChaosInjector, ChaosSpecError
+
+    try:
+        chaos = ChaosInjector.from_spec(args.chaos_spec, replica_id=args.replica_id)
+    except ChaosSpecError as e:
+        emit({"error": str(e)})
+        print(f"serve: refusing to start: {e}", file=sys.stderr)
+        handler.uninstall()
+        return 2
+    if chaos is not None:
+        print(
+            f"serve: chaos injection armed (replica {args.replica_id})",
+            file=sys.stderr,
+        )
+        if not args.http:
+            print(
+                "serve: chaos faults fire at the HTTP replica boundary — "
+                "stdin mode ignores the spec", file=sys.stderr,
+            )
+
     try:
         if args.http:
             # factory form: the server binds FIRST (so /healthz answers
@@ -342,7 +386,8 @@ def serve_command(args) -> int:
 
             try:
                 return _serve_http(build_engine, inbox, stop,
-                                   args.http, health=health, handler=handler)
+                                   args.http, health=health, handler=handler,
+                                   chaos=chaos, max_queue=args.max_queue)
             except _PreflightRefusal as e:
                 # SP004 pre-flight refusal (or invalid geometry): an error
                 # row + exit 2, the same contract as shard-check
@@ -381,7 +426,8 @@ def serve_command(args) -> int:
 
         threading.Thread(target=read_stdin, daemon=True).start()
         try:
-            _engine_loop(engine, inbox, emit, stop, health=health, handler=handler)
+            _engine_loop(engine, inbox, emit, stop, health=health,
+                         handler=handler, max_queue=args.max_queue)
         except KeyboardInterrupt:
             pass
         stats = engine.stats()
@@ -398,13 +444,20 @@ def serve_command(args) -> int:
         handler.uninstall()
 
 
-def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
+def _serve_http(engine, inbox, stop, port, health=None, handler=None,
+                chaos=None, max_queue=None) -> int:
     """Minimal local HTTP front end: POST /generate blocks until the
     request completes (400 on a rejected one, 503 while starting or
     draining); GET /healthz answers the lifecycle state machine +
     queue/slot gauges; GET /stats returns engine health JSON; GET /metrics
     answers OpenMetrics text from the active registry (refreshed from
     ``engine.stats()`` on each scrape).
+
+    ``chaos`` (a :class:`~accelerate_tpu.serving.chaos.ChaosInjector`)
+    injects scheduled faults at this boundary: ``kill``/``stop``/``delay``
+    and 503 bursts fire per received ``/generate`` request, health-check
+    blackouts tear ``/healthz`` connections. Disabled = one falsy check
+    per request, like the telemetry null object.
 
     ``engine`` may be a ready instance or a zero-arg factory — with a
     factory the server binds and answers ``/healthz`` as ``starting``
@@ -451,6 +504,11 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
             if path == "/metrics":
                 self._send_metrics()
             elif path == "/healthz":
+                if chaos is not None and chaos.healthz_blackout():
+                    # injected health blackout: tear the connection — the
+                    # prober sees exactly what a starved /healthz looks like
+                    self.close_connection = True
+                    return
                 self._send(200, health.payload(box["engine"]))
             elif path in ("", "/stats", "/health"):
                 eng = box["engine"]
@@ -463,6 +521,13 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
             if self.path.rstrip("/") != "/generate":
                 self._send(404, {"error": "unknown path"})
                 return
+            if chaos is not None:
+                # kill/stop never return; delay sleeps in this handler
+                # thread (the request is mid-flight, exactly like a slow
+                # engine); a 503 burst answers before admission
+                if chaos.on_generate() == "err503":
+                    self._send(503, {"error": "chaos: injected 503 burst"})
+                    return
             if not health.ready:
                 # starting or draining: an explicit answer, so the router
                 # (or any client) fails fast instead of queueing into a
@@ -506,7 +571,7 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None) -> int:
             box["engine"] = engine()  # /healthz says `starting` during this build
         health.mark_ready()
         _engine_loop(box["engine"], inbox, lambda *a: None, stop,
-                     health=health, handler=handler)
+                     health=health, handler=handler, max_queue=max_queue)
     except KeyboardInterrupt:
         pass
     finally:
@@ -546,6 +611,17 @@ def add_parser(subparsers):
                    "the chosen count + predicted headroom")
     p.add_argument("--max-new-tokens", type=int, default=64,
                    help="default output budget when a request omits it")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="bounded admission: shed (error row) any request "
+                   "arriving while this many are already waiting for a slot "
+                   "(default: unbounded, the pre-robustness behaviour)")
+    p.add_argument("--chaos-spec", default=None,
+                   help="fault-injection schedule for chaos testing (env "
+                   "ACCELERATE_CHAOS_SPEC; seed via ACCELERATE_CHAOS_SEED): "
+                   "e.g. 'r0:kill@5;r1:delay@3:0.2;err503@2:3;blackout@6:1.5' "
+                   "— kill -9 / SIGSTOP / delay / 503 burst / healthz "
+                   "blackout keyed on the replica's /generate request "
+                   "ordinal; deterministic per (spec, seed). HTTP mode only")
     # prefix sharing + swap preemption knobs (env defaults let a fleet
     # flip them without touching every replica's command line). Parsed
     # defensively: add_parser runs while building EVERY subcommand's

@@ -138,6 +138,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "collective_divergence": [],
         "fleet": [],
         "fleet_dead": [],
+        "router": None,
     }
 
     # -- telemetry tail ------------------------------------------------------
@@ -290,6 +291,9 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             rid = row.get("replica_id")
             if rid is not None:
                 latest[rid] = row  # rows are append-ordered: newest wins
+            elif row.get("kind") == "router":
+                # aggregate supervisor/admission totals, one row per tick
+                status["router"] = row
         for rid in sorted(latest):
             row = dict(latest[rid])
             row["row_age_s"] = (
@@ -379,13 +383,46 @@ def render_status(status: dict[str, Any]) -> str:
                 if r.get("num_slots") else _fmt(r.get("active_slots"), "{}")
             )
             mark = "  [DEAD]" if r.get("dead") else ""
+            # supervisor state: restart count always when supervised, plus
+            # backoff/quarantine while a respawn is pending or armed
+            sup = ""
+            if r.get("restarts"):
+                sup += f"  restarts {r['restarts']}"
+            if r.get("quarantined"):
+                sup += "  QUARANTINED"
+            if r.get("probation"):
+                sup += "  probation"
+            if r.get("respawn_in_s") is not None:
+                sup += (
+                    f"  respawn in {_fmt(r.get('respawn_in_s'), '{:.1f}')}s "
+                    f"(backoff {_fmt(r.get('backoff_s'), '{:.1f}')}s)"
+                )
             lines.append(
                 f"    replica {r.get('replica_id')}: {r.get('state')}  "
                 f"queue {_fmt(r.get('queue_depth'), '{}')}  "
                 f"slots {slots}  in-flight {_fmt(r.get('in_flight'), '{}')}  "
                 f"heartbeat {_fmt(r.get('heartbeat_age_s'), '{:.1f}')}s  "
-                f"last row {_fmt(r.get('row_age_s'), '{:.0f}')}s ago{mark}"
+                f"last row {_fmt(r.get('row_age_s'), '{:.0f}')}s ago{mark}{sup}"
             )
+        router = status.get("router")
+        if router:
+            parts = [
+                f"queue {_fmt(router.get('queue_depth'), '{}')}",
+                f"delivered {_fmt(router.get('delivered'), '{}')}",
+                f"requeues {_fmt(router.get('requeues'), '{}')}",
+                f"shed {_fmt(router.get('shed'), '{}')}",
+                f"deadline-expired {_fmt(router.get('deadline_expired'), '{}')}",
+            ]
+            if router.get("respawns") is not None:
+                parts.append(
+                    f"respawns {router['respawns']} "
+                    f"(quarantined {_fmt(router.get('quarantined'), '{}')}, "
+                    f"scale +{_fmt(router.get('scale_ups'), '{}')}"
+                    f"/-{_fmt(router.get('scale_downs'), '{}')}, "
+                    f"fleet {_fmt(router.get('min_replicas'), '{}')}-"
+                    f"{_fmt(router.get('max_replicas'), '{}')})"
+                )
+            lines.append("  router: " + "  ".join(parts))
     goodput = status.get("goodput")
     if goodput:
         lost = goodput["lost_s_by_cause"]

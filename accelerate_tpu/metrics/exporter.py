@@ -29,7 +29,7 @@ from collections import deque
 from ..logging import get_logger
 from .alerts import evaluate_alerts, write_alerts
 from .goodput import BUCKETS, ledger_from_dir_throttled
-from .ingest import observe_record
+from .ingest import observe_record, observe_router_row
 from .openmetrics import CONTENT_TYPE, render_openmetrics
 from .registry import MetricsRegistry
 
@@ -116,7 +116,10 @@ class LoggingDirExporter:
             if isinstance(row.get("ttft_s"), (int, float)):
                 self._ttfts.append(float(row["ttft_s"]))
 
-    def _tail_segment(self, path: str) -> None:
+    def _tail_jsonl(self, path: str, on_row) -> None:
+        """Rotation-proof incremental tail shared by every trail this
+        exporter consumes: fingerprint-keyed offsets, torn final line left
+        for the next refresh, each complete new row handed to ``on_row``."""
         try:
             with open(path, "rb") as f:
                 fp = _fingerprint_fd(f)
@@ -145,9 +148,28 @@ class LoggingDirExporter:
                 continue
             if isinstance(row, dict):
                 try:
-                    self._consume_row(row)
+                    on_row(row)
                 except Exception:
                     logger.warning("metrics ingest failed on a row", exc_info=True)
+
+    def _tail_segment(self, path: str) -> None:
+        self._tail_jsonl(path, self._consume_row)
+
+    # -- router fleet trail --------------------------------------------------
+
+    def _tail_router_trail(self) -> None:
+        """Tail ``router/replicas.jsonl`` (the fleet supervisor's trail)
+        through the same fingerprint-offset machinery as the telemetry
+        segments, replaying each new row through
+        :func:`~.ingest.observe_router_row` — this is how the
+        ``serving_router_{respawns,shed,deadline_expired}_total`` counters
+        reach a scrape without the router embedding an HTTP server."""
+        path = os.path.join(self.logging_dir, "router", "replicas.jsonl")
+        if not os.path.exists(path):
+            return
+        self._tail_jsonl(
+            path, lambda row: observe_router_row(self.registry, row)
+        )
 
     # -- heartbeats / goodput / alerts ---------------------------------------
 
@@ -225,6 +247,7 @@ class LoggingDirExporter:
         now = time.time() if now is None else now
         for path in self._segments():
             self._tail_segment(path)
+        self._tail_router_trail()
         self._observe_heartbeats(now)
         self._observe_goodput()
         if self._skipped_schema:

@@ -20,7 +20,13 @@ from __future__ import annotations
 
 from .registry import DEFAULT_BUCKETS
 
-__all__ = ["observe_record", "observe_span", "observe_engine_stats", "observe_hang"]
+__all__ = [
+    "observe_record",
+    "observe_span",
+    "observe_engine_stats",
+    "observe_hang",
+    "observe_router_row",
+]
 
 #: tighter buckets for per-token latencies (TTFT/TPOT)
 _LATENCY_BUCKETS = tuple(b for b in DEFAULT_BUCKETS if b <= 60.0)
@@ -118,6 +124,8 @@ _SHARING_COUNTERS = (
      "KV blocks restored from the host pool on re-admission"),
     ("out_of_blocks_total", "serving_out_of_blocks",
      "Requests truncated with finish_reason=out_of_blocks (last resort)"),
+    ("deadline_expired_total", "serving_deadline_expired",
+     "Requests finished with finish_reason=deadline_exceeded by the engine"),
 )
 _PREFIX_HIT_GAUGE = (
     "prefix_hit_ratio", "serving_prefix_hit_ratio",
@@ -172,6 +180,61 @@ def _observe_serving(registry, record: dict) -> None:
         ):
             if _num(record.get(field)) is not None:
                 registry.counter(name, help).set_total(record[field])
+
+
+#: router-level robustness counters — fed from the fleet trail's aggregate
+#: ``kind: "router"`` rows (written once per health tick) by the sidecar
+#: exporter, the same one-table-two-surfaces rule as the engine counters.
+#: Counter names render with the OpenMetrics ``_total`` suffix, giving the
+#: documented ``serving_router_{respawns,shed,deadline_expired}_total``.
+_ROUTER_COUNTERS = (
+    ("respawns", "serving_router_respawns",
+     "Dead replicas respawned by the fleet supervisor"),
+    ("shed", "serving_router_shed",
+     "Requests shed by bounded-queue admission control"),
+    ("deadline_expired", "serving_router_deadline_expired",
+     "Requests answered with a deadline-exceeded error row by the router"),
+    ("requeues", "serving_router_requeues",
+     "Dispatches requeued after a replica failure or timeout"),
+    ("rejected", "serving_router_rejected",
+     "Submissions answered with an admission error row"),
+    ("delivered", "serving_router_delivered",
+     "Requests delivered exactly once by the router"),
+    ("scale_ups", "serving_router_scale_ups",
+     "Replicas spawned by queue-pressure autoscaling"),
+    ("scale_downs", "serving_router_scale_downs",
+     "Replicas drained by idle-fleet autoscaling"),
+)
+_ROUTER_GAUGES = (
+    ("queue_depth", "serving_router_queue_depth",
+     "Requests waiting in the router queue"),
+    ("outstanding", "serving_router_outstanding",
+     "Requests submitted but not yet delivered"),
+    ("quarantined", "serving_router_quarantined",
+     "Replicas currently under crash-loop quarantine"),
+    ("pending_respawns", "serving_router_pending_respawns",
+     "Dead replicas waiting out their respawn backoff"),
+)
+
+
+def observe_router_row(registry, row: dict) -> None:
+    """One fleet-trail row → registry updates. Aggregate ``kind="router"``
+    rows ratchet the router counters; per-replica rows refresh a restart
+    gauge. Never raises on malformed rows (the exporter tails files other
+    processes wrote)."""
+    if row.get("kind") == "router":
+        for field, name, help in _ROUTER_COUNTERS:
+            if _num(row.get(field)) is not None:
+                registry.counter(name, help).set_total(row[field])
+        for field, name, help in _ROUTER_GAUGES:
+            if _num(row.get(field)) is not None:
+                registry.gauge(name, help).set(row[field])
+        return
+    rid = row.get("replica_id")
+    if rid is not None and _num(row.get("restarts")) is not None:
+        registry.gauge(
+            "serving_replica_restarts", "Respawn count per replica identity"
+        ).set(row["restarts"], replica=str(rid))
 
 
 def observe_span(registry, name: str, seconds: float) -> None:

@@ -1,12 +1,15 @@
 """Continuous-batching serving engine (Orca-style slot scheduling over a
 vLLM-style block-paged KV cache) — see :mod:`.engine` for the design —
 plus the pod-scale layer: mesh-sharded decode (``InferenceEngine(...,
-mesh=)``) and the multi-replica router (:mod:`.router` / :mod:`.replica`).
+mesh=)``), the multi-replica router (:mod:`.router` / :mod:`.replica`),
+and the self-healing layer — the replica supervisor with crash-loop
+backoff and min/max autoscale (:mod:`.supervisor`) and the seeded
+fault-injection harness (:mod:`.chaos`).
 
-The router side is jax-free on purpose: importing ``Router`` or
-``ReplicaHandle`` must work on a machine with no accelerator, so those
-names are NOT imported here eagerly — use
-``from accelerate_tpu.serving.router import Router``.
+The router side (router/replica/supervisor/chaos) is jax-free on purpose:
+importing ``Router`` or ``ReplicaSupervisor`` must work on a machine with
+no accelerator, so those names are NOT imported here eagerly — use
+``from accelerate_tpu.serving.router import Router`` etc.
 """
 
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed

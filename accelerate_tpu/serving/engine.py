@@ -244,6 +244,7 @@ class InferenceEngine:
         self._swapped_out_blocks = 0
         self._swapped_in_blocks = 0
         self._out_of_blocks_total = 0
+        self._deadline_expired = 0
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fn = self._build_prefill_fn()
@@ -439,7 +440,14 @@ class InferenceEngine:
         max_new_tokens: int | None = None,
         arrival_time: float | None = None,
         priority: str = "interactive",
+        deadline_ms: float | None = None,
     ) -> Request:
+        """Enqueue one request. ``deadline_ms`` is a *relative* budget from
+        now: once it elapses the scheduler finishes the request with
+        ``finish_reason="deadline_exceeded"`` (partial output kept, blocks
+        freed the same iteration). A malformed value raises ValueError —
+        the serve front end answers that as an error row, mirroring the
+        unknown-``priority`` handling."""
         req = Request(
             prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
             max_new_tokens=int(
@@ -449,6 +457,17 @@ class InferenceEngine:
         )
         if arrival_time is not None:
             req.arrival_time = arrival_time
+        if deadline_ms is not None:
+            try:
+                budget_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                budget_ms = float("nan")
+            if not budget_ms > 0:  # also rejects NaN
+                raise ValueError(
+                    f"malformed deadline_ms {deadline_ms!r}: want a positive "
+                    "number of milliseconds"
+                )
+            req.deadline = time.perf_counter() + budget_ms / 1000.0
         return self.scheduler.submit(req)
 
     def step(self) -> list[Request]:
@@ -461,6 +480,12 @@ class InferenceEngine:
         finished: list[Request] = []
 
         with trace_span("serve/schedule"):
+            if sched.deadline_live:  # guarded: deadline-free = one int check
+                for req in sched.expire_deadlines():
+                    if req.slot is None:
+                        self._release_expired_queued(req)
+                    self._deadline_expired += 1
+                    finished.append(req)
             sched.evict_finished()
             self._admit_and_place()
 
@@ -526,6 +551,7 @@ class InferenceEngine:
         self._swapped_out_blocks = 0
         self._swapped_in_blocks = 0
         self._out_of_blocks_total = 0
+        self._deadline_expired = 0
         # hit accounting restarts with the measurement window; the trie and
         # its cached blocks deliberately stay warm (steady-state behaviour
         # is what a warmed bench leg measures)
@@ -580,6 +606,7 @@ class InferenceEngine:
             "swapped_out_blocks": self._swapped_out_blocks,
             "swapped_in_blocks": self._swapped_in_blocks,
             "out_of_blocks_total": self._out_of_blocks_total,
+            "deadline_expired_total": self._deadline_expired,
         }
         if self.radix is not None:
             out["radix_inserted_blocks"] = self.radix.inserted_blocks
@@ -749,6 +776,21 @@ class InferenceEngine:
         self._preemptions += 1
         self._swapped_out_blocks += len(plan)
         return True
+
+    def _release_expired_queued(self, req: Request) -> None:
+        """A request that expired while *queued* holds no slot, but a
+        preempted one still owns swap handles (host DRAM) and references on
+        blocks it shares with live requests — release both. Pure block-table
+        and refcount edits; the compiled executables never run for it."""
+        if req.swap_plan:
+            for _, handle in req.swap_plan:
+                self._swap.release(handle)
+            swapped = {idx for idx, _ in req.swap_plan}
+            retained = [b for i, b in enumerate(req.blocks) if i not in swapped]
+            if retained:
+                self.allocator.decref(retained)
+            req.swap_plan = []
+        req.blocks = []
 
     def _force_finish_out_of_blocks(
         self, req: Request, finished: list[Request]
@@ -1027,4 +1069,5 @@ class InferenceEngine:
                 swapped_out_blocks=self._swapped_out_blocks,
                 swapped_in_blocks=self._swapped_in_blocks,
                 out_of_blocks_total=self._out_of_blocks_total,
+                deadline_expired_total=self._deadline_expired,
             )

@@ -36,6 +36,13 @@ class ReplicaError(Exception):
     distinct from an application error (HTTP 400), which is a final answer."""
 
 
+class ReplicaTimeout(ReplicaError):
+    """The router's ``request_timeout`` expired with the socket still open.
+    A dead replica resets the connection instantly, so a timeout is
+    evidence the replica is *slow-but-alive* — the router requeues the
+    ticket but must NOT walk the failure path that marks replicas dead."""
+
+
 class ReplicaHandle:
     """One engine replica as the router sees it.
 
@@ -59,6 +66,12 @@ class ReplicaHandle:
         self.consecutive_failures = 0
         self.dispatched = 0
         self.completed = 0
+        # supervisor state: how many times this identity has been respawned,
+        # and whether the current incarnation is a half-open probation probe
+        # (the router routes it one request at a time until it proves itself)
+        self.restarts = 0
+        self.probation = False
+        self.probation_successes = 0
         # leading-block hashes of recently dispatched prompts: the router's
         # prefix-affinity signal (this replica's radix cache is likely warm
         # for these) — see Router._pick_replica
@@ -138,7 +151,21 @@ class ReplicaHandle:
                 return json.loads(e.read())
             except Exception:
                 raise ReplicaError(f"replica {self.replica_id}: torn HTTP error body") from e
+        except TimeoutError as e:
+            raise ReplicaTimeout(
+                f"replica {self.replica_id}: request_timeout after {timeout}s "
+                "(replica slow but alive)"
+            ) from e
         except Exception as e:
+            # urllib wraps socket timeouts in URLError("timed out") — the
+            # distinction matters: a timeout means slow-but-alive, never a
+            # death verdict (see ReplicaTimeout)
+            reason = getattr(e, "reason", None)
+            if isinstance(e, TimeoutError) or isinstance(reason, TimeoutError):
+                raise ReplicaTimeout(
+                    f"replica {self.replica_id}: request_timeout after {timeout}s "
+                    "(replica slow but alive)"
+                ) from e
             raise ReplicaError(f"replica {self.replica_id}: {e}") from e
 
     # -- lifecycle -----------------------------------------------------------

@@ -30,7 +30,19 @@ placement, affinity, retry, and drain:
   exactly once, so a kill -9 loses and duplicates nothing;
 * **drain** — stop admission, let in-flight requests finish, then SIGTERM
   every spawned replica (the serve front end's PreemptionHandler drain)
-  and wait for clean exits.
+  and wait for clean exits;
+* **self-healing** (``supervisor=``, :mod:`.supervisor`) — a dead replica
+  is respawned with exponential crash-loop backoff, flapping replicas are
+  quarantined and rejoin half-open (one probe request at a time), and the
+  fleet scales between min/max replicas off its own queue-depth signal;
+* **request lifecycle** — a payload ``deadline_ms`` rides the ticket: the
+  router answers expired tickets with a deadline-exceeded error row
+  instead of dispatching or retrying them, and forwards the *remaining*
+  budget so the engine evicts the slot when it runs out; a bounded queue
+  (``max_queue_depth=``) sheds ``batch``-class submissions before
+  ``interactive`` with explicit over-capacity error rows. All of it is
+  guarded: deadline-free, unbounded, unsupervised routing pays a few
+  None-checks per dispatch (the telemetry null-path rule).
 
 Per-replica health is appended to ``<logging_dir>/router/replicas.jsonl``
 (one row per replica per health tick) — the fleet panel in
@@ -48,7 +60,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..logging import get_logger
-from .replica import ReplicaError, ReplicaHandle
+from .replica import ReplicaError, ReplicaHandle, ReplicaTimeout
 
 logger = get_logger(__name__)
 
@@ -86,11 +98,24 @@ class Ticket:
     result: dict | None = None
     replica_id: int | None = None
     delivered: bool = False
+    #: absolute ``time.monotonic`` expiry, set at submit from the payload's
+    #: ``deadline_ms`` (None = no deadline — the zero-cost default path)
+    deadline: float | None = None
     done: threading.Event = field(default_factory=threading.Event)
 
     @property
     def session_id(self):
         return self.payload.get("session_id") if isinstance(self.payload, dict) else None
+
+    @property
+    def priority(self) -> str:
+        p = self.payload.get("priority") if isinstance(self.payload, dict) else None
+        return p if isinstance(p, str) else "interactive"
+
+    @property
+    def req_id(self):
+        """The caller's request id, echoed on every answer row."""
+        return self.payload.get("id") if isinstance(self.payload, dict) else None
 
 
 class Router:
@@ -105,9 +130,19 @@ class Router:
             with an error (default: one try per replica + 1 retry).
         request_timeout: per-dispatch HTTP timeout (None = wait forever;
             a killed replica resets the connection immediately either way).
+            Expiry on a slow-but-alive replica requeues the ticket WITHOUT
+            marking the replica dead (:class:`~.replica.ReplicaTimeout`).
         affinity_load_slack: how many requests busier than the fleet's
             least-loaded replica a prefix-warm replica may be before
             affinity yields to load balance (~one slot set's worth).
+        supervisor: a :class:`~.supervisor.ReplicaSupervisor` that respawns
+            dead replicas with crash-loop backoff and scales the fleet;
+            None (default) preserves the fixed-fleet PR 7 behaviour.
+        max_queue_depth: bounded-queue admission control — when the queue
+            holds this many tickets, a new ``interactive`` submission sheds
+            the newest queued ``batch``-class ticket (answered with an
+            over-capacity error row), and a ``batch`` submission is itself
+            rejected; None (default) keeps the queue unbounded.
     """
 
     def __init__(
@@ -118,6 +153,8 @@ class Router:
         max_attempts: int | None = None,
         request_timeout: float | None = None,
         affinity_load_slack: int = 8,
+        supervisor=None,
+        max_queue_depth: int | None = None,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -127,6 +164,8 @@ class Router:
         self.max_attempts = max_attempts or len(replicas) + 2
         self.request_timeout = request_timeout
         self.affinity_load_slack = int(affinity_load_slack)
+        self.supervisor = supervisor
+        self.max_queue_depth = max_queue_depth
         self._queue: deque[Ticket] = deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -142,7 +181,15 @@ class Router:
         self._delivered = 0
         self._requeues = 0
         self._rejected = 0
+        self._shed = 0
+        self._deadline_expired = 0
         self._tokens = 0
+        # earliest deadline among queued tickets (None = no deadlines):
+        # the dispatch loop runs the expiry sweep only once this instant
+        # passes, so deadline-free traffic pays one None-check per
+        # iteration (the telemetry null-path rule) and deadline-heavy
+        # backlog pays one clock read, not an O(queue) scan
+        self._next_deadline: float | None = None
         self._trail = None
         if logging_dir:
             os.makedirs(os.path.join(logging_dir, ROUTER_SUBDIR), exist_ok=True)
@@ -155,26 +202,86 @@ class Router:
         ]
         for t in self._threads:
             t.start()
+        if supervisor is not None:
+            supervisor.bind(self)
 
     # -- admission -----------------------------------------------------------
 
     def submit(self, payload: dict, callback=None) -> Ticket:
         """Enqueue one request; returns its ticket. While draining, the
         request is answered immediately with an error instead of being
-        silently dropped (the caller always gets exactly one answer)."""
+        silently dropped (the caller always gets exactly one answer). A
+        malformed ``deadline_ms`` is likewise an error *answer*, never a
+        crash; a full bounded queue sheds ``batch`` before ``interactive``
+        with explicit over-capacity error rows."""
         ticket = Ticket(payload=payload, callback=callback)
+        req_id = ticket.req_id
         rejected = None
-        with self._lock:
-            if self._draining or self._stopped.is_set():
-                self._rejected += 1
+        shed_victim = None
+        raw_deadline = (
+            payload.get("deadline_ms") if isinstance(payload, dict) else None
+        )
+        if raw_deadline is not None:
+            try:
+                deadline_ms = float(raw_deadline)
+                if not deadline_ms > 0:  # also rejects NaN
+                    raise ValueError
+            except (TypeError, ValueError):
                 rejected = {
-                    "id": payload.get("id") if isinstance(payload, dict) else None,
-                    "error": "router is draining: admission stopped",
+                    "id": req_id,
+                    "error": f"malformed deadline_ms {raw_deadline!r}: "
+                    "want a positive number of milliseconds",
                 }
             else:
+                ticket.deadline = time.monotonic() + deadline_ms / 1000.0
+        with self._lock:
+            if rejected is not None:
+                self._rejected += 1
+            elif self._draining or self._stopped.is_set():
+                self._rejected += 1
+                rejected = {
+                    "id": req_id,
+                    "error": "router is draining: admission stopped",
+                }
+            elif (
+                self.max_queue_depth is not None
+                and len(self._queue) >= self.max_queue_depth
+            ):
+                # load shed, batch-class first: an interactive arrival may
+                # displace the NEWEST queued batch ticket (it has waited the
+                # least); a batch arrival — or an interactive one with no
+                # batch ticket to displace — is itself shed
+                if ticket.priority == "interactive":
+                    for t in reversed(self._queue):
+                        if t.priority == "batch":
+                            shed_victim = t
+                            break
+                self._shed += 1
+                if shed_victim is not None:
+                    self._queue.remove(shed_victim)
+                    self._outstanding += 1
+                    self._arm_deadline(ticket.deadline)
+                    self._queue.append(ticket)
+                    self._work.notify()
+                else:
+                    self._rejected += 1
+                    rejected = {
+                        "id": req_id,
+                        "error": f"over capacity: queue depth "
+                        f"{len(self._queue)} at max_queue_depth "
+                        f"{self.max_queue_depth} — request shed",
+                    }
+            else:
                 self._outstanding += 1
+                self._arm_deadline(ticket.deadline)
                 self._queue.append(ticket)
                 self._work.notify()
+        if shed_victim is not None:  # answered outside the lock
+            self._finish(shed_victim, {
+                "id": shed_victim.req_id,
+                "error": "over capacity: shed from the queue to admit "
+                "interactive traffic (batch class sheds first)",
+            })
         if rejected is not None:  # deliver outside the lock
             self._finish(ticket, rejected, count_delivered=False)
         return ticket
@@ -185,8 +292,13 @@ class Router:
         """Session affinity first, then prefix affinity (the replica whose
         recent requests share this prompt's leading block hash — its radix
         cache is warm for the prefix), least-loaded ready replica
-        otherwise. Caller holds the lock."""
-        candidates = [r for r in self.replicas if r.is_dispatchable()]
+        otherwise. Caller holds the lock. A ``probation`` (half-open)
+        replica is a candidate only while it holds no in-flight request —
+        one probe at a time until it proves itself."""
+        candidates = [
+            r for r in self.replicas
+            if r.is_dispatchable() and (not r.probation or r.in_flight == 0)
+        ]
         if not candidates:
             return None
         sid = ticket.session_id
@@ -226,47 +338,99 @@ class Router:
             chosen.sessions.add(sid)
         return chosen
 
+    def _arm_deadline(self, deadline: float | None) -> None:
+        """Fold one ticket's deadline into the earliest-deadline watermark
+        (caller holds the lock). The dispatch loop sweeps only once the
+        watermark passes — never per-iteration scans."""
+        if deadline is not None and (
+            self._next_deadline is None or deadline < self._next_deadline
+        ):
+            self._next_deadline = deadline
+
+    def _expire_queued(self) -> list[Ticket]:
+        """Pull every past-deadline ticket out of the queue and recompute
+        the earliest-deadline watermark (caller holds the lock; caller
+        answers the expired tickets outside it)."""
+        now = time.monotonic()
+        expired = [
+            t for t in self._queue
+            if t.deadline is not None and now > t.deadline and not t.delivered
+        ]
+        if expired:
+            gone = set(map(id, expired))
+            self._queue = deque(t for t in self._queue if id(t) not in gone)
+            self._deadline_expired += len(expired)
+        self._next_deadline = min(
+            (t.deadline for t in self._queue if t.deadline is not None),
+            default=None,
+        )
+        return expired
+
+    def _deadline_error(self, ticket: Ticket, where: str) -> dict:
+        return {"id": ticket.req_id, "error": f"deadline_exceeded: {where}"}
+
     def _dispatch_loop(self):
         while not self._stopped.is_set():
             failed: list[Ticket] = []
+            expired: list[Ticket] = []
+            ticket = replica = None
             with self._lock:
                 while not self._queue and not self._stopped.is_set():
                     self._work.wait(timeout=0.2)
                 if self._stopped.is_set():
                     return
-                ticket = self._queue[0]
-                if ticket.delivered:
+                if (
+                    self._next_deadline is not None
+                    and time.monotonic() >= self._next_deadline
+                ):
+                    expired = self._expire_queued()
+                if self._queue:
+                    ticket = self._queue[0]
+                if ticket is not None and ticket.delivered:
                     # a rescued ticket whose wedged dispatch answered late:
                     # already delivered, nothing left to do
                     self._queue.popleft()
-                    continue
-                replica = self._pick_replica(ticket)
-                if replica is None:
-                    # A spawned replica's death is permanent; if the whole
-                    # fleet is spawned-and-gone, waiting would hang drain()
-                    # for its full timeout with the tickets never answered.
-                    # Attached replicas can come back, so a fleet with any
-                    # attached member keeps waiting.
-                    if all(
-                        r.process is not None and r.state in ("dead", "terminated")
-                        for r in self.replicas
-                    ):
-                        failed = list(self._queue)
-                        self._queue.clear()
-                else:
-                    self._queue.popleft()
-                    replica.in_flight += 1
-                    replica.dispatched += 1
-                    ticket.replica_id = replica.replica_id
-                    ticket.attempts += 1
-                    self._inflight.setdefault(replica.replica_id, set()).add(ticket)
+                    ticket = None
+                if ticket is not None:
+                    replica = self._pick_replica(ticket)
+                    if replica is None:
+                        # A spawned replica's death is permanent; if the
+                        # whole fleet is spawned-and-gone, waiting would
+                        # hang drain() for its full timeout with the
+                        # tickets never answered. Attached replicas can
+                        # come back, so a fleet with any attached member
+                        # keeps waiting — and so does one whose supervisor
+                        # will respawn the dead (tickets with deadlines
+                        # still expire while they wait).
+                        if all(
+                            r.process is not None
+                            and r.state in ("dead", "terminated")
+                            for r in self.replicas
+                        ) and not (
+                            self.supervisor is not None
+                            and self.supervisor.will_respawn()
+                        ):
+                            failed = list(self._queue)
+                            self._queue.clear()
+                    else:
+                        self._queue.popleft()
+                        replica.in_flight += 1
+                        replica.dispatched += 1
+                        ticket.replica_id = replica.replica_id
+                        ticket.attempts += 1
+                        self._inflight.setdefault(replica.replica_id, set()).add(ticket)
+            for t in expired:
+                self._finish(t, self._deadline_error(
+                    t, "expired in the router queue before dispatch"
+                ))
             if replica is None:
                 for t in failed:
                     self._finish(t, {
-                        "id": t.payload.get("id") if isinstance(t.payload, dict) else None,
+                        "id": t.req_id,
                         "error": "every replica is dead: request cannot be served",
                     })
-                time.sleep(0.05)
+                if ticket is not None:
+                    time.sleep(0.05)
                 continue
             threading.Thread(
                 target=self._dispatch_one, args=(ticket, replica),
@@ -274,12 +438,26 @@ class Router:
             ).start()
 
     def _dispatch_one(self, ticket: Ticket, replica: ReplicaHandle):
+        payload = ticket.payload
+        if ticket.deadline is not None:
+            # thread the REMAINING budget to the replica: queue wait already
+            # spent part of it, and the engine enforces its share (evicting
+            # the slot the moment the deadline passes)
+            remaining_ms = (ticket.deadline - time.monotonic()) * 1000.0
+            payload = dict(payload, deadline_ms=max(remaining_ms, 1.0))
         try:
-            result = replica.generate(ticket.payload, timeout=self.request_timeout)
+            result = replica.generate(payload, timeout=self.request_timeout)
         except ReplicaError as e:
+            # A request_timeout expiry means slow-but-alive: the ticket is
+            # requeued, but neither the failure counter nor the death probe
+            # runs — a dead replica resets the connection instantly, so a
+            # timeout is never death evidence (the slow replica keeps its
+            # `ready` state and its other in-flight work).
+            timed_out = isinstance(e, ReplicaTimeout)
             with self._lock:
                 replica.in_flight -= 1
-                replica.consecutive_failures += 1
+                if not timed_out:
+                    replica.consecutive_failures += 1
                 # if _mark_dead already requeued this ticket (wedged-replica
                 # rescue), this dispatch's failure is old news — a second
                 # requeue would dispatch the request twice concurrently
@@ -288,19 +466,30 @@ class Router:
                 if not rescued:
                     self._requeues += 1
                 stopped = self._stopped.is_set()
-            self._note_failure(replica)
+            if not timed_out:
+                self._note_failure(replica)
             if rescued:
                 return
-            if ticket.attempts >= self.max_attempts:
+            expired = ticket.deadline is not None and time.monotonic() > ticket.deadline
+            if expired:
+                # never retry an expired ticket: the caller stopped caring
+                # at the deadline, and a retry would burn a replica slot on
+                # an answer nobody reads
+                with self._lock:
+                    self._deadline_expired += 1
+                self._finish(ticket, self._deadline_error(
+                    ticket, f"expired after {ticket.attempts} dispatch attempt(s)"
+                ))
+            elif ticket.attempts >= self.max_attempts:
                 self._finish(ticket, {
-                    "id": ticket.payload.get("id") if isinstance(ticket.payload, dict) else None,
+                    "id": ticket.req_id,
                     "error": f"gave up after {ticket.attempts} dispatch attempts: {e}",
                 })
             elif stopped:
                 # the dispatch loop is gone — a requeue would be silence;
                 # an error row is still exactly one answer
                 self._finish(ticket, {
-                    "id": ticket.payload.get("id") if isinstance(ticket.payload, dict) else None,
+                    "id": ticket.req_id,
                     "error": f"router stopped before the request could be retried: {e}",
                 })
             else:
@@ -308,12 +497,27 @@ class Router:
                     # front of the queue: a victim of a replica crash has
                     # already waited its turn once
                     self._queue.appendleft(ticket)
+                    self._arm_deadline(ticket.deadline)
                     self._work.notify()
             return
+        cleared_probation = False
         with self._lock:
             replica.in_flight -= 1
             replica.completed += 1
             self._inflight.get(replica.replica_id, set()).discard(ticket)
+            if replica.probation:
+                # half-open probe served: count it, and promote the replica
+                # back to full membership once it has proven itself
+                replica.probation_successes += 1
+                needed = (
+                    self.supervisor.cfg.probation_successes
+                    if self.supervisor is not None else 1
+                )
+                if replica.probation_successes >= needed:
+                    replica.probation = False
+                    cleared_probation = True
+        if cleared_probation and self.supervisor is not None:
+            self.supervisor.notify_recovery(replica)
         self._finish(ticket, result)
 
     def _finish(self, ticket: Ticket, result: dict, count_delivered: bool = True):
@@ -371,6 +575,9 @@ class Router:
             for t in stranded:
                 self._queue.appendleft(t)
                 self._requeues += 1
+                # re-arm the expiry watermark: a rescued deadline ticket
+                # must be answered, never re-dispatched past its budget
+                self._arm_deadline(t.deadline)
             stranded.clear()
             if rescued:
                 self._work.notify()
@@ -378,7 +585,19 @@ class Router:
             "replica %d (pid %s) is dead — %d in-flight request(s) requeued, "
             "sessions released", replica.replica_id, replica.pid, rescued,
         )
+        # a spawned replica that is dead to the router is dead for real: a
+        # wedged-but-alive process (SIGSTOP, engine deadlock) abandoned here
+        # would leak forever — and hold its HBM — since drain() skips dead
+        # replicas. SIGKILL works on stopped processes.
+        if replica.process is not None and replica.process.poll() is None:
+            logger.warning(
+                "replica %d (pid %s) process still alive after death verdict "
+                "(wedged) — killing", replica.replica_id, replica.pid,
+            )
+            replica.kill()
         self._write_fleet_rows()
+        if self.supervisor is not None:
+            self.supervisor.notify_death(replica)
 
     def _probe_one(self, replica: ReplicaHandle):
         """One replica's health-tick logic (runs on its own probe thread —
@@ -390,6 +609,12 @@ class Router:
         if r.state in ("dead", "terminated"):
             if r.process is None and r.check_health() is not None:
                 logger.info("attached replica %d is back", r.replica_id)
+            return
+        if r.state == "draining":
+            # supervisor scale-down: the exit is intentional — record it as
+            # `terminated`, never `dead` (which would trigger a respawn)
+            if r.process_exited():
+                r.state = "terminated"
             return
         if r.process_exited():
             if not self._health_paused:
@@ -456,6 +681,8 @@ class Router:
                     "dispatched": r.dispatched,
                     "completed": r.completed,
                     "sessions": len(r.sessions),
+                    "restarts": r.restarts,
+                    "probation": r.probation,
                     "heartbeat_age_s": (
                         round(now - r.last_heartbeat, 3)
                         if r.last_heartbeat is not None else None
@@ -463,6 +690,32 @@ class Router:
                 }
                 for r in self.replicas
             ]
+            totals = {
+                "schema": ROUTER_SCHEMA,
+                "kind": "router",  # router-wide totals, one per tick
+                "ts": now,
+                # explicit Nones: readers that index per-replica keys on
+                # every row (state checks, pid maps) stay correct without
+                # knowing about aggregate rows
+                "replica_id": None,
+                "state": None,
+                "pid": None,
+                "queue_depth": len(self._queue),
+                "outstanding": self._outstanding,
+                "delivered": self._delivered,
+                "requeues": self._requeues,
+                "rejected": self._rejected,
+                "shed": self._shed,
+                "deadline_expired": self._deadline_expired,
+            }
+        if self.supervisor is not None:
+            sup = self.supervisor
+            for row in rows:
+                row.update(sup.row_fields(row["replica_id"]))
+            totals.update(sup.stats())
+        # totals lead the tick: readers tailing "the newest replica row"
+        # (monitor, tests) keep seeing a replica row last
+        rows.insert(0, totals)
         try:
             for row in rows:
                 trail.write(json.dumps(row) + "\n")
@@ -496,8 +749,12 @@ class Router:
         with self._lock:
             self._draining = True
         drained = self.wait_idle(timeout=timeout)
-        # From here the replicas' exits are intentional: freeze the health
-        # loop so a SIGTERM'd replica is recorded as `terminated`, not `dead`.
+        # From here the replicas' exits are intentional: stop the supervisor
+        # FIRST (a respawn racing the kill loop would leak a process), then
+        # freeze the health loop so a SIGTERM'd replica is recorded as
+        # `terminated`, not `dead`.
+        if self.supervisor is not None:
+            self.supervisor.stop()
         self._health_paused = True
         for r in self.replicas:
             if r.state not in ("dead", "terminated"):
@@ -543,26 +800,33 @@ class Router:
 
     def close(self):
         """Abrupt teardown (tests, error paths): kill what we spawned."""
+        if self.supervisor is not None:
+            self.supervisor.stop()  # no respawns behind the kill loop
         self._stopped.set()
         with self._lock:
             self._work.notify_all()
         for r in self.replicas:
             r.kill()
+        for r in self.replicas:
+            r.wait(timeout=10.0)  # reap: a killed child must not linger
         self._shutdown()
 
     # -- observability -------------------------------------------------------
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "replicas": len(self.replicas),
                 "ready": sum(r.state == "ready" for r in self.replicas),
                 "dead": sum(r.state == "dead" for r in self.replicas),
+                "probation": sum(r.probation for r in self.replicas),
                 "queue_depth": len(self._queue),
                 "outstanding": self._outstanding,
                 "delivered": self._delivered,
                 "requeues": self._requeues,
                 "rejected": self._rejected,
+                "shed": self._shed,
+                "deadline_expired": self._deadline_expired,
                 "tokens": self._tokens,
                 "sessions": len(self._sessions),
                 "per_replica": {
@@ -571,7 +835,12 @@ class Router:
                         "dispatched": r.dispatched,
                         "completed": r.completed,
                         "in_flight": r.in_flight,
+                        "restarts": r.restarts,
+                        "probation": r.probation,
                     }
                     for r in self.replicas
                 },
             }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.stats()
+        return out

@@ -79,9 +79,15 @@ class Request:
     priority: str = "interactive"  # see PRIORITY_CLASSES
     request_id: int = field(default_factory=lambda: next(_request_ids))
     arrival_time: float = field(default_factory=time.perf_counter)
+    #: absolute ``time.perf_counter`` expiry (None = no deadline): the
+    #: scheduler finishes the request with ``finish_reason=
+    #: "deadline_exceeded"`` the first iteration after this passes, queued
+    #: or running — freed blocks are admittable the same iteration
+    deadline: float | None = None
     state: RequestState = RequestState.QUEUED
     output_tokens: list[int] = field(default_factory=list)
-    finish_reason: str | None = None  # "eos" | "length" | "out_of_blocks"
+    finish_reason: str | None = None
+    # "eos" | "length" | "out_of_blocks" | "deadline_exceeded"
     slot: int | None = None
     blocks: list[int] = field(default_factory=list)
     prefill_pos: int = 0  # prompt tokens whose K/V are already cached
@@ -133,6 +139,10 @@ class SlotScheduler:
         #: denominator of the prefix hit ratio
         self.prompt_tokens_admitted = 0
         self.prefix_hit_tokens = 0
+        #: live requests carrying a deadline — the expiry sweep is guarded
+        #: on this, so deadline-free serving pays one integer check per
+        #: iteration (the telemetry/sanitizer null-path rule)
+        self.deadline_live = 0
 
     # -- queries -------------------------------------------------------------
 
@@ -189,6 +199,8 @@ class SlotScheduler:
                 f"prompt needs {admit_need} KV blocks to admit but the pool "
                 f"only has {usable}: raise num_blocks or shrink the prompt"
             )
+        if request.deadline is not None:
+            self.deadline_live += 1
         request.state = RequestState.QUEUED
         self.waiting[request.priority].append(request)
         return request
@@ -215,8 +227,53 @@ class SlotScheduler:
                 req.blocks = []
                 req.slot = None
                 self.slots[i] = None
+                if req.deadline is not None:
+                    self.deadline_live -= 1
                 evicted.append(req)
         return evicted
+
+    def expire_deadlines(self, now: float | None = None) -> list[Request]:
+        """Finish every queued or running request whose deadline has
+        passed (``finish_reason="deadline_exceeded"``). Running requests
+        keep their partial output; their blocks are freed by the
+        ``evict_finished`` sweep the engine runs right after — same
+        iteration, so the capacity a missed deadline was holding is
+        admittable immediately (block tables only: the compiled decode
+        executable never sees any of this). Queued requests leave the
+        waiting deques directly (they hold no blocks; a *preempted* queued
+        request's swap handles are the engine's to release — see
+        ``InferenceEngine.step``). The caller only invokes this while
+        ``deadline_live > 0``."""
+        now = time.perf_counter() if now is None else now
+        expired: list[Request] = []
+        for priority in PRIORITY_CLASSES:
+            q = self.waiting[priority]
+            if any(r.deadline is not None and now > r.deadline for r in q):
+                keep: deque[Request] = deque()
+                for r in q:
+                    if r.deadline is not None and now > r.deadline:
+                        r.finish_reason = "deadline_exceeded"
+                        r.finish_time = now
+                        r.state = RequestState.FINISHED
+                        self.deadline_live -= 1
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                self.waiting[priority] = keep
+        for req in self.slots:
+            if (
+                req is not None
+                and req.state is not RequestState.FINISHED
+                and req.deadline is not None
+                and now > req.deadline
+            ):
+                req.finish_reason = "deadline_exceeded"
+                req.finish_time = now
+                req.state = RequestState.FINISHED
+                # deadline_live drops at evict_finished, which releases the
+                # slot+blocks this iteration
+                expired.append(req)
+        return expired
 
     def _ensure_free(self, need: int) -> bool:
         """Freelist coverage for ``need`` blocks, LRU-evicting refcount-1

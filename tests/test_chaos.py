@@ -1,0 +1,747 @@
+"""Self-healing fleet: supervisor (``serving/supervisor.py``), chaos
+harness (``serving/chaos.py``), and request-lifecycle robustness
+(deadlines + load shed) across router/scheduler/engine.
+
+Policy (backoff, quarantine, probation, autoscale, deadline accounting)
+runs against in-process stubs — tier-1 cheap, no jax, no subprocess.
+Durability — seeded kill -9 / SIGSTOP-wedge schedules through the real
+CLI, respawn-with-backoff observed in the fleet trail, zero orphans — is
+proven against REAL serve processes, the PR 7 way. Engine-level deadline
+eviction (freelist invariant) rides the slow lane with the other
+compile-heavy engine tests.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu.serving.chaos import (
+    ChaosInjector,
+    ChaosSpecError,
+    parse_chaos_spec,
+)
+from accelerate_tpu.serving.replica import ReplicaHandle
+from accelerate_tpu.serving.router import Router
+from accelerate_tpu.serving.supervisor import ReplicaSupervisor, SupervisorConfig
+
+# ---------------------------------------------------------------------------
+# chaos spec parsing + injector (tier-1: pure host)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_spec_parses_scopes_and_kinds():
+    seed, faults = parse_chaos_spec(
+        "seed=7; r0:kill@5; r1:delay@4:0.25; err503@2:3; blackout@0:4; r0:stop@3:2.5"
+    )
+    assert seed == 7
+    by_kind = {f.kind: f for f in faults}
+    assert by_kind["kill"].replica == 0 and by_kind["kill"].at_request == 5
+    assert by_kind["delay"].replica == 1 and by_kind["delay"].arg == 0.25
+    assert by_kind["err503"].replica is None and by_kind["err503"].arg == 3.0
+    assert by_kind["blackout"].at_request == 0
+    assert by_kind["stop"].arg == 2.5
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "explode@3",          # unknown kind
+        "kill@-1",            # negative ordinal
+        "kill@x",             # non-numeric ordinal
+        "delay@3",            # missing required argument
+        "kill@0",             # ordinal 0 only valid for blackout
+        "delay@3:0.5..0.1",   # inverted range
+        "kill@3:1:2",         # too many arguments
+        "seed=abc",           # malformed seed
+    ],
+)
+def test_chaos_spec_malformed_raises(bad):
+    with pytest.raises(ChaosSpecError):
+        parse_chaos_spec(bad)
+
+
+def test_injector_scoping_and_503_burst():
+    _, faults = parse_chaos_spec("r0:kill@1; err503@2:2")
+    inj = ChaosInjector(faults, replica_id=1)  # r0's kill is not ours
+    assert inj.on_generate() is None          # request 1
+    assert inj.on_generate() == "err503"      # request 2
+    assert inj.on_generate() == "err503"      # request 3
+    assert inj.on_generate() is None          # request 4: burst over
+    assert inj.injected["err503"] == 2 and inj.injected["kill"] == 0
+
+
+def test_injector_blackout_window_and_startup():
+    _, faults = parse_chaos_spec("blackout@0:0.15; blackout@1:0.15")
+    inj = ChaosInjector(faults, replica_id=0)
+    assert inj.healthz_blackout()  # startup blackout active immediately
+    time.sleep(0.2)
+    assert not inj.healthz_blackout()
+    inj.on_generate()  # request 1 re-arms it
+    assert inj.healthz_blackout()
+
+
+def test_injector_seeded_delays_deterministic(monkeypatch):
+    """The same (spec, seed, replica) draws the same jittered delays —
+    chaos runs replay, they don't dice-roll."""
+    import accelerate_tpu.serving.chaos as chaos_mod
+
+    def draws(seed):
+        slept = []
+        monkeypatch.setattr(chaos_mod.time, "sleep", slept.append)
+        _, faults = parse_chaos_spec("delay@1:0.1..0.5; delay@2:0.1..0.5")
+        inj = ChaosInjector(faults, seed=seed, replica_id=0)
+        inj.on_generate()
+        inj.on_generate()
+        return slept
+
+    a, b, c = draws(3), draws(3), draws(4)
+    assert a == b, "same seed must draw the same delays"
+    assert a != c, "different seeds must draw different delays"
+    assert all(0.1 <= s < 0.5 for s in a)
+
+
+def test_injector_env_fallback(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_CHAOS_SPEC", "r2:kill@9")
+    inj = ChaosInjector.from_spec(None, replica_id=2)
+    assert inj is not None and inj._kills == {9}
+    monkeypatch.setenv("ACCELERATE_CHAOS_SPEC", "")
+    assert ChaosInjector.from_spec(None, replica_id=2) is None
+    assert ChaosInjector.from_spec("kill@3", replica_id=0)._kills == {3}
+    # a malformed env seed refuses like a malformed spec entry (error row
+    # + exit 2 at the serve front end), never a bare traceback
+    monkeypatch.setenv("ACCELERATE_CHAOS_SEED", "abc")
+    with pytest.raises(ChaosSpecError, match="ACCELERATE_CHAOS_SEED"):
+        ChaosInjector.from_spec("kill@3", replica_id=0)
+
+
+# ---------------------------------------------------------------------------
+# supervisor policy against stub replicas (tier-1: no jax, no processes)
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """Just enough of subprocess.Popen for the router/supervisor: poll/
+    kill/wait/send_signal. SIGTERM 'exits' it (the serve drain contract)."""
+
+    _pids = iter(range(100000, 200000))
+
+    def __init__(self):
+        self.pid = next(FakeProc._pids)
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        self.returncode = -9
+
+    def send_signal(self, sig):
+        self.returncode = 0  # drain: clean exit
+
+
+class SupStub(ReplicaHandle):
+    """Spawned-replica stub: fake process + instant generate."""
+
+    def __init__(self, replica_id):
+        super().__init__(replica_id, f"http://stub/{replica_id}", process=FakeProc())
+        self.state = "ready"
+        self.handled = []
+        self._hlock = threading.Lock()
+
+    def check_health(self, timeout=2.0):
+        if self.process.poll() is not None:
+            return None
+        self.last_heartbeat = time.time()
+        return {"state": self.state, "queue_depth": 0, "active_slots": 0}
+
+    def generate(self, payload, timeout=None):
+        from accelerate_tpu.serving.replica import ReplicaError
+
+        if self.process.poll() is not None:
+            raise ReplicaError(f"stub {self.replica_id} is down")
+        with self._hlock:
+            self.handled.append(payload)
+        return {"id": payload.get("id"), "tokens": [1], "finish_reason": "length"}
+
+
+def _supervised_router(tmp_path, n=1, **cfg_kw):
+    spawned = []
+
+    def spawn_fn(replica_id):
+        handle = SupStub(replica_id)
+        spawned.append(handle)
+        return handle
+
+    cfg_kw.setdefault("min_replicas", n)
+    cfg_kw.setdefault("max_replicas", n)
+    cfg_kw.setdefault("backoff_base_s", 0.05)
+    cfg_kw.setdefault("backoff_max_s", 0.5)
+    cfg_kw.setdefault("jitter", 0.0)
+    sup = ReplicaSupervisor(spawn_fn, SupervisorConfig(**cfg_kw))
+    replicas = [spawn_fn(i) for i in range(n)]
+    router = Router(
+        replicas, logging_dir=str(tmp_path), health_interval=0.05, supervisor=sup
+    )
+    return router, sup, spawned
+
+
+def _wait_until(cond, timeout=20.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return cond()
+
+
+def test_supervisor_respawns_dead_replica(tmp_path):
+    """A dead replica comes back: requests submitted during the outage are
+    served by the respawned incarnation (no dead-fleet fail-fast while the
+    supervisor will respawn), and the fleet trail records the restart."""
+    router, sup, spawned = _supervised_router(tmp_path, n=1)
+    try:
+        first = router.submit({"id": "a", "prompt": [1]})
+        assert first.done.wait(timeout=20) and "tokens" in first.result
+        spawned[0].process.kill()  # the only replica dies
+        assert _wait_until(lambda: router.stats()["dead"] == 1 or len(spawned) > 1)
+        # submitted while dead — must NOT be answered with a dead-fleet error
+        during = router.submit({"id": "b", "prompt": [1]})
+        assert during.done.wait(timeout=20)
+        assert during.result.get("tokens") == [1], during.result
+        assert len(spawned) == 2 and spawned[1].restarts == 1
+        assert not spawned[1].probation  # a single death is no quarantine
+        stats = router.stats()
+        assert stats["supervisor"]["respawns"] == 1
+        assert stats["per_replica"][0]["restarts"] == 1
+
+        # the fleet trail records the restart + the aggregate respawn count
+        # (written on health ticks — wait for one to land before closing)
+        def trail_has_restart():
+            rows = [
+                json.loads(line)
+                for line in (
+                    tmp_path / "router" / "replicas.jsonl"
+                ).read_text().splitlines()
+            ]
+            return any(
+                r.get("restarts") == 1 for r in rows if r.get("replica_id") == 0
+            ) and any(
+                r.get("kind") == "router" and r.get("respawns") == 1 for r in rows
+            )
+
+        assert _wait_until(trail_has_restart), "restart never reached the trail"
+    finally:
+        router.close()
+
+
+def test_supervisor_backoff_grows_and_quarantine_probation(tmp_path):
+    """Consecutive rapid deaths double the backoff; at quarantine_after the
+    next incarnation rejoins half-open (probation) and one served request
+    promotes it back to full membership, resetting the death count."""
+    router, sup, spawned = _supervised_router(
+        tmp_path, n=1, quarantine_after=2, probation_successes=1,
+        rapid_death_s=60.0,
+    )
+    try:
+        spawned[0].process.kill()
+        assert _wait_until(lambda: len(spawned) == 2)
+        first_backoff = sup._meta[0]["backoff_s"]
+        assert not spawned[1].probation
+        spawned[1].process.kill()  # rapid second death -> quarantine
+        assert _wait_until(lambda: len(spawned) == 3)
+        assert sup._meta[0]["backoff_s"] > first_backoff
+        assert spawned[2].probation, "post-quarantine rejoin must be half-open"
+        assert router.stats()["probation"] == 1
+        # one successful probe request clears probation + resets the count
+        probe = router.submit({"id": "p", "prompt": [1]})
+        assert probe.done.wait(timeout=20) and probe.result["tokens"] == [1]
+        assert _wait_until(lambda: not spawned[2].probation)
+        assert sup._meta[0]["deaths"] == 0 and not sup._meta[0]["quarantined"]
+    finally:
+        router.close()
+
+
+def test_supervisor_scales_up_and_down(tmp_path):
+    """Queue pressure spawns a replica up to max_replicas; a sustained idle
+    fleet drains back to min_replicas (SIGTERM -> `terminated`, never
+    `dead` — a scale-down must not look like a crash or trigger respawn)."""
+    router, sup, spawned = _supervised_router(
+        tmp_path, n=1, min_replicas=1, max_replicas=2,
+        scale_interval_s=0.05, scale_up_queue_per_replica=2,
+        scale_down_idle_ticks=3,
+    )
+    try:
+        spawned[0].state = "starting"  # hold dispatch so the queue builds
+        tickets = [router.submit({"id": i, "prompt": [1]}) for i in range(6)]
+        assert _wait_until(lambda: len(spawned) == 2), "never scaled up"
+        assert spawned[1].replica_id == 1
+        spawned[0].state = "ready"
+        for t in tickets:
+            assert t.done.wait(timeout=20)
+        # idle now: the supervisor drains the highest-numbered replica
+        assert _wait_until(lambda: spawned[1].state == "terminated")
+        stats = router.stats()
+        assert stats["supervisor"]["scale_ups"] == 1
+        assert stats["supervisor"]["scale_downs"] == 1
+        assert stats["dead"] == 0, "scale-down must not read as a death"
+        assert stats["supervisor"]["respawns"] == 0
+    finally:
+        router.close()
+
+
+def test_monitor_renders_supervisor_state(tmp_path):
+    """The fleet panel shows per-replica restart/backoff/quarantine state
+    and the aggregate router totals line (respawns/shed/deadline-expired)."""
+    from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+
+    now = time.time()
+    d = tmp_path / "router"
+    d.mkdir()
+    rows = [
+        {"schema": 1, "ts": now, "kind": "router", "replica_id": None,
+         "state": None, "pid": None, "queue_depth": 4, "delivered": 20,
+         "requeues": 3, "shed": 2, "deadline_expired": 5, "respawns": 1,
+         "quarantined": 1, "scale_ups": 0, "scale_downs": 0,
+         "min_replicas": 2, "max_replicas": 4},
+        {"schema": 1, "ts": now, "replica_id": 0, "state": "ready",
+         "queue_depth": 1, "active_slots": 1, "num_slots": 4, "in_flight": 1,
+         "heartbeat_age_s": 0.1, "restarts": 2, "probation": True},
+        {"schema": 1, "ts": now, "replica_id": 1, "state": "dead",
+         "queue_depth": 0, "active_slots": 0, "num_slots": 4, "in_flight": 0,
+         "heartbeat_age_s": 9.0, "restarts": 1, "quarantined": True,
+         "backoff_s": 2.0, "respawn_in_s": 1.5},
+    ]
+    with open(d / "replicas.jsonl", "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    status = collect_status(str(tmp_path), now=now)
+    assert status["router"]["respawns"] == 1
+    assert [r["replica_id"] for r in status["fleet"]] == [0, 1]
+    text = render_status(status)
+    assert "restarts 2" in text and "probation" in text
+    assert "QUARANTINED" in text and "respawn in" in text
+    assert "respawns 1" in text and "shed 2" in text
+    assert "deadline-expired 5" in text
+
+
+def test_exporter_tails_router_trail_into_counters(tmp_path):
+    """The sidecar exporter replays fleet-trail rows through
+    ingest.observe_router_row: the serving_router_*_total counters and the
+    per-replica restart gauge reach a scrape without the router embedding
+    an HTTP server."""
+    from accelerate_tpu.metrics.exporter import LoggingDirExporter
+
+    d = tmp_path / "router"
+    d.mkdir()
+    with open(d / "replicas.jsonl", "w") as f:
+        f.write(json.dumps({
+            "schema": 1, "kind": "router", "ts": time.time(),
+            "respawns": 2, "shed": 3, "deadline_expired": 4,
+            "queue_depth": 1, "delivered": 9, "requeues": 5,
+        }) + "\n")
+        f.write(json.dumps({
+            "schema": 1, "ts": time.time(), "replica_id": 0,
+            "state": "ready", "restarts": 2,
+        }) + "\n")
+    exporter = LoggingDirExporter(str(tmp_path))
+    exporter.refresh()
+    text = exporter.render()
+    assert "serving_router_respawns_total 2" in text
+    assert "serving_router_shed_total 3" in text
+    assert "serving_router_deadline_expired_total 4" in text
+    assert 'serving_replica_restarts{replica="0"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# scheduler deadline accounting (tier-1: pure host)
+# ---------------------------------------------------------------------------
+
+
+def _sched(num_slots=2, num_blocks=9, block_size=8, max_seq=32):
+    from accelerate_tpu.serving import BlockAllocator, SlotScheduler
+
+    return SlotScheduler(num_slots, BlockAllocator(num_blocks), block_size, max_seq)
+
+
+def test_scheduler_expires_queued_and_running_deadlines():
+    from accelerate_tpu.serving import Request, RequestState
+
+    sched = _sched()
+    now = time.perf_counter()
+    running = sched.submit(Request(prompt=[1] * 4, max_new_tokens=8, deadline=now + 60))
+    fine = sched.submit(Request(prompt=[3] * 4, max_new_tokens=8))
+    queued = sched.submit(Request(prompt=[2] * 4, max_new_tokens=8, deadline=now + 60))
+    assert sched.deadline_live == 2
+    admitted = sched.admit()  # 2 slots: running + fine; queued waits
+    assert running in admitted and fine in admitted
+    assert queued.slot is None
+    free_before = sched.allocator.free_count
+
+    # nothing expired yet: the sweep is a no-op
+    assert sched.expire_deadlines(now=now) == []
+
+    running.deadline = queued.deadline = now - 1.0
+    expired = sched.expire_deadlines(now=now)
+    assert {r.request_id for r in expired} == {running.request_id, queued.request_id}
+    assert all(r.finish_reason == "deadline_exceeded" for r in expired)
+    # the queued one left the waiting deque without ever holding blocks
+    assert sched.deadline_live == 1  # running's slot not yet evicted
+    # the running one frees its blocks on the same-iteration evict sweep
+    sched.evict_finished()
+    assert sched.deadline_live == 0
+    assert sched.allocator.free_count > free_before
+    assert fine.state in (RequestState.PREFILL, RequestState.QUEUED)
+    # full accounting: every block owned by live requests only
+    for req in (r for r in sched.slots if r is not None):
+        assert req.finish_reason is None
+
+
+# ---------------------------------------------------------------------------
+# engine deadline eviction (slow lane: compiles the tiny model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4, seq=96)
+    return LlamaForCausalLM.from_config(config, seed=0)
+
+
+@pytest.mark.slow
+def test_engine_deadline_eviction_frees_blocks(tiny_model):
+    """Deadline expiry mid-decode keeps the partial output, finishes with
+    `deadline_exceeded`, and frees the slot + blocks the same iteration
+    (freelist invariant holds; block-table edits only — the one compiled
+    decode executable survives)."""
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine, RequestState
+
+    engine = InferenceEngine(
+        tiny_model,
+        EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                     prefill_chunk=8, decode_burst=2),
+    )
+    # queued expiry: a microscopic budget is gone before the first step
+    doomed = engine.add_request([5, 6, 7], max_new_tokens=8, deadline_ms=0.001)
+    victim = engine.add_request([1, 2, 3], max_new_tokens=40, deadline_ms=1e9)
+    bystander = engine.add_request([4, 5, 6], max_new_tokens=4)
+    while len(victim.output_tokens) < 2:
+        engine.step()
+    assert doomed.finish_reason == "deadline_exceeded" and not doomed.output_tokens
+    victim.deadline = time.perf_counter() - 1.0  # expire it mid-decode
+    engine.step()
+    assert victim.state is RequestState.FINISHED
+    assert victim.finish_reason == "deadline_exceeded"
+    assert len(victim.output_tokens) >= 2, "partial output must survive"
+    assert victim.blocks == [] and victim.slot is None
+    done = engine.run_until_idle(max_iterations=2000)
+    assert bystander in done or bystander.finish_reason == "length"
+    stats = engine.stats()
+    assert stats["deadline_expired_total"] == 2
+    assert stats["decode_compiles"] == 1, "deadline eviction must not retrace"
+    assert stats["allocated_blocks"] == 0
+    assert (
+        stats["free_blocks"] + stats["cached_blocks"]
+        == engine.allocator.num_blocks - 1
+    ), "freelist invariant broken by deadline eviction"
+
+
+@pytest.mark.slow
+def test_engine_malformed_deadline_raises(tiny_model):
+    """Mirrors the unknown-`priority` contract: a malformed deadline_ms is
+    a ValueError at add_request, which the serve front end answers as an
+    error row instead of dying."""
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+    engine = InferenceEngine(
+        tiny_model,
+        EngineConfig(num_slots=2, block_size=8, max_seq_len=64, prefill_chunk=8),
+    )
+    for bad in ("soon", -5, 0, float("nan")):
+        with pytest.raises(ValueError, match="deadline_ms"):
+            engine.add_request([1, 2, 3], max_new_tokens=4, deadline_ms=bad)
+    assert engine.scheduler.queue_depth == 0
+
+
+# ---------------------------------------------------------------------------
+# real-process chaos schedules through the CLI (the acceptance bars)
+# ---------------------------------------------------------------------------
+
+_TINY_ARGS = [
+    "--preset", "tiny", "--num-slots", "2", "--block-size", "8",
+    "--max-seq-len", "64", "--prefill-chunk", "8", "--decode-burst", "2",
+]
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("ACCELERATE_TELEMETRY", None)
+    env.pop("ACCELERATE_CHAOS_SPEC", None)
+    return env
+
+
+def _read_lines(stream, sink):
+    for line in stream:
+        line = line.strip()
+        if line:
+            sink.append(line)
+
+
+def _start_reader(proc, sink):
+    t = threading.Thread(target=_read_lines, args=(proc.stdout, sink), daemon=True)
+    t.start()
+    return t
+
+
+def _wait_results(sink, n, timeout, proc=None):
+    deadline = time.monotonic() + timeout
+    while len(sink) < n and time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    return [json.loads(line) for line in sink]
+
+
+def _req(i, session=None, n_new=4):
+    payload = {"id": i, "prompt": [1 + (i % 5), 7, 3], "max_new_tokens": n_new}
+    if session is not None:
+        payload["session_id"] = session
+    return json.dumps(payload) + "\n"
+
+
+def _trail_rows(logdir):
+    path = os.path.join(logdir, "router", "replicas.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _trail_pids(rows):
+    return {r["pid"] for r in rows if r.get("pid") and r.get("replica_id") is not None}
+
+
+def _assert_all_dead(pids, timeout=10.0):
+    """Every pid must be gone. A just-reaped child can linger as a zombie
+    for an instant after the parent exits — poll briefly before declaring
+    an orphan (os.kill(pid, 0) succeeds on zombies)."""
+
+    def alive():
+        out = []
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+                out.append(pid)
+            except OSError:
+                continue
+        return out
+
+    deadline = time.monotonic() + timeout
+    while alive() and time.monotonic() < deadline:
+        time.sleep(0.25)
+    leftovers = alive()
+    assert not leftovers, f"orphaned process(es) survived the run: {leftovers}"
+
+
+def _route(tmp_path, *extra, replicas=2):
+    return subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "route", "--replicas", str(replicas), "--logging-dir", str(tmp_path),
+         "--health-interval", "0.2", *extra, *_TINY_ARGS],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+
+
+def test_chaos_cli_kill_respawn_exactly_once(tmp_path):
+    """Acceptance: under a seeded kill -9 schedule, every submitted request
+    is answered exactly once, the supervisor respawns the victim (restart
+    visible in the fleet trail), the fleet recovers to --min-replicas
+    ready, and zero processes are orphaned."""
+    proc = _route(
+        tmp_path, "--respawn", "--min-replicas", "2",
+        "--chaos-spec", "seed=1;r0:kill@3;r1:delay@2:0.05..0.2",
+    )
+    results = []
+    _start_reader(proc, results)
+    try:
+        # warmup pins sessions: chat-0 -> replica 0, chat-1 -> replica 1
+        for i in range(4):
+            proc.stdin.write(_req(i, session=f"chat-{i % 2}"))
+        proc.stdin.flush()
+        assert len(_wait_results(results, 4, timeout=240, proc=proc)) == 4, (
+            f"fleet never answered warmup; rc={proc.poll()}"
+        )
+        pids_before = _trail_pids(_trail_rows(tmp_path))
+        assert len(pids_before) == 2
+        # the wave lands replica 0's 3rd request -> chaos kill -9 with
+        # requests in flight on it
+        for i in range(4, 12):
+            proc.stdin.write(_req(i, session=f"chat-{i % 2}", n_new=8))
+        proc.stdin.flush()
+        parsed = _wait_results(results, 12, timeout=240, proc=proc)
+        assert len(parsed) == 12, f"rc={proc.poll()} results={len(parsed)}"
+
+        # fleet recovers: replica 0 re-reports ready with restarts >= 1
+        def recovered():
+            rows = _trail_rows(tmp_path)
+            latest = {}
+            for r in rows:
+                if r.get("replica_id") is not None:
+                    latest[r["replica_id"]] = r
+            return (
+                len(latest) >= 2
+                and latest.get(0, {}).get("state") == "ready"
+                and latest.get(0, {}).get("restarts", 0) >= 1
+            )
+
+        deadline = time.monotonic() + 120
+        while not recovered() and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert recovered(), "fleet never recovered to 2 ready replicas"
+        proc.stdin.close()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    parsed = [json.loads(line) for line in results]
+    ids = sorted(r.get("id") for r in parsed)
+    assert ids == list(range(12)), f"lost/duplicated: {ids}"
+    errors = [r for r in parsed if "error" in r]
+    assert not errors, f"kill lost requests: {errors}"
+    rows = _trail_rows(tmp_path)
+    assert any(r.get("state") == "dead" for r in rows), "death never recorded"
+    assert any(
+        r.get("kind") == "router" and r.get("respawns", 0) >= 1 for r in rows
+    ), "supervisor respawn never reached the trail"
+    # crash-loop backoff was armed for the death (visible in the trail)
+    assert any(
+        r.get("replica_id") == 0 and r.get("backoff_s", 0) > 0 for r in rows
+    )
+    _assert_all_dead(_trail_pids(rows))
+
+
+def test_chaos_cli_sigstop_wedge_rescued_and_not_orphaned(tmp_path):
+    """A SIGSTOP'd replica (wedged: socket open, /healthz starved) is
+    marked dead, its stranded request is rescued to the survivor, the
+    frozen process is KILLED (not abandoned — the no-orphans invariant),
+    and the supervisor respawns the identity."""
+    proc = _route(
+        tmp_path, "--respawn", "--min-replicas", "2",
+        "--health-interval", "0.1", "--chaos-spec", "r0:stop@2",
+    )
+    results = []
+    _start_reader(proc, results)
+    try:
+        for i in range(2):
+            proc.stdin.write(_req(i, session=f"chat-{i % 2}"))
+        proc.stdin.flush()
+        assert len(_wait_results(results, 2, timeout=240, proc=proc)) == 2
+        wedged_pids = _trail_pids(_trail_rows(tmp_path))
+        # replica 0's 2nd request freezes it with the POST in flight
+        for i in range(2, 6):
+            proc.stdin.write(_req(i, session="chat-0", n_new=8))
+        proc.stdin.flush()
+        parsed = _wait_results(results, 6, timeout=240, proc=proc)
+        assert len(parsed) == 6, (
+            f"wedged request never rescued; rc={proc.poll()}"
+        )
+        proc.stdin.close()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    parsed = [json.loads(line) for line in results]
+    ids = sorted(r.get("id") for r in parsed)
+    assert ids == list(range(6)), f"lost/duplicated: {ids}"
+    assert not [r for r in parsed if "error" in r]
+    rows = _trail_rows(tmp_path)
+    assert any(r.get("state") == "dead" for r in rows)
+    # the frozen process must be gone: killed on the death verdict, and
+    # every other pid reaped by drain
+    _assert_all_dead(wedged_pids | _trail_pids(rows))
+
+
+def test_chaos_cli_dead_fleet_without_respawn_regression(tmp_path):
+    """Regression pin: WITHOUT --respawn the same kill schedule degrades to
+    PR 7's dead-fleet behaviour — queued requests are answered with the
+    every-replica-is-dead error row, and nothing respawns."""
+    proc = _route(tmp_path, "--chaos-spec", "r0:kill@2", replicas=1)
+    results = []
+    _start_reader(proc, results)
+    try:
+        proc.stdin.write(_req(0))
+        proc.stdin.flush()
+        assert len(_wait_results(results, 1, timeout=240, proc=proc)) == 1
+        for i in range(1, 4):
+            proc.stdin.write(_req(i, n_new=8))
+        proc.stdin.flush()
+        parsed = _wait_results(results, 4, timeout=240, proc=proc)
+        proc.stdin.close()
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    parsed = [json.loads(line) for line in results]
+    assert sorted(r.get("id") for r in parsed) == [0, 1, 2, 3]
+    dead_rows = [r for r in parsed if "error" in r]
+    assert dead_rows, "dead fleet must answer error rows, not hang"
+    assert any("every replica is dead" in r["error"] for r in dead_rows)
+    rows = _trail_rows(tmp_path)
+    assert not any(r.get("restarts") for r in rows if r.get("replica_id") == 0)
+    _assert_all_dead(_trail_pids(rows))
+
+
+def test_route_bringup_timeout_kills_spawned_replicas(tmp_path):
+    """Satellite: when wait_until_ready times out (here: one replica's
+    /healthz blacked out from startup), route kills every already-spawned
+    replica before exiting 1 — no orphans on failed bring-up."""
+    proc = _route(
+        tmp_path, "--ready-timeout", "10",
+        "--chaos-spec", "r1:blackout@0:9999",
+    )
+    try:
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == 1
+    rows = _trail_rows(tmp_path)
+    pids = _trail_pids(rows)
+    assert pids, "health loop never recorded the spawned pids"
+    # give the kernel a beat to reap, then assert both replicas are gone
+    time.sleep(0.5)
+    _assert_all_dead(pids)
+
+
+def test_serve_cli_malformed_chaos_spec_refuses(tmp_path):
+    """A typo'd spec must refuse bring-up (exit 2, error row) — silently
+    running a clean 'chaos' test would certify nothing."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "serve", "--chaos-spec", "explode@oops", *_TINY_ARGS],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 2
+    assert "unknown chaos fault" in out

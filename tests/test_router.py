@@ -187,6 +187,125 @@ def test_wedged_replica_inflight_rescued():
         router.close()
 
 
+def test_request_timeout_requeues_without_marking_dead():
+    """A request_timeout expiry on a slow-but-alive replica requeues the
+    ticket WITHOUT walking the death path: the replica keeps its `ready`
+    state and a clean failure counter (a dead replica resets the
+    connection instantly — a timeout is never death evidence)."""
+    from accelerate_tpu.serving.replica import ReplicaTimeout
+
+    class SlowStub(StubReplica):
+        def generate(self, payload, timeout=None):
+            if timeout is not None and self.latency > timeout:
+                time.sleep(timeout)
+                raise ReplicaTimeout(f"stub {self.replica_id}: request_timeout")
+            return super().generate(payload, timeout)
+
+    fast, slow = StubReplica(0, latency=0.05), SlowStub(1, latency=10.0)
+    router = _router([fast, slow], request_timeout=0.1)
+    try:
+        # skew the fast replica so least-loaded sends the probe to slow r1;
+        # un-skew it mid-timeout so the requeued attempt balances to r0
+        fast.queue_depth = 2
+        threading.Timer(0.12, lambda: setattr(fast, "queue_depth", 0)).start()
+        ticket = router.submit({"id": "t0", "prompt": [1]})
+        assert ticket.done.wait(timeout=30)
+        assert ticket.result["tokens"] == [1, 2, 3]
+        assert any(p["id"] == "t0" for p in fast.handled)  # requeued over
+        assert slow.state == "ready", "timeout must not mark the replica dead"
+        assert slow.consecutive_failures == 0
+        stats = router.stats()
+        assert stats["dead"] == 0 and stats["requeues"] >= 1
+    finally:
+        router.close()
+
+
+def test_deadline_expires_in_queue_and_on_retry():
+    """A ticket whose deadline passes while queued is answered with a
+    deadline-exceeded error row instead of ever being dispatched; the
+    remaining budget is forwarded to the replica on dispatch."""
+    seen = []
+
+    class Recording(StubReplica):
+        def generate(self, payload, timeout=None):
+            seen.append(dict(payload))
+            return super().generate(payload, timeout)
+
+    r0 = Recording(0)
+    r0.state = "starting"  # hold dispatch: tickets really wait in the queue
+    router = _router([r0])
+    try:
+        first = router.submit({"id": "slow", "prompt": [1], "deadline_ms": 60_000})
+        doomed = router.submit({"id": "doomed", "prompt": [1], "deadline_ms": 20})
+        # the queue sweep answers the expired ticket even with no replica
+        # dispatchable — a caller's deadline must not wait for capacity
+        assert doomed.done.wait(timeout=30)
+        assert "deadline_exceeded" in doomed.result["error"]
+        r0.state = "ready"
+        assert first.done.wait(timeout=30)
+        assert first.result["tokens"] == [1, 2, 3]
+        # the dispatched ticket carried its REMAINING budget, not the original
+        sent = [p for p in seen if p.get("id") == "slow"]
+        assert sent and 0 < sent[0]["deadline_ms"] < 60_000
+        assert not any(p.get("id") == "doomed" for p in seen)
+        stats = router.stats()
+        assert stats["deadline_expired"] == 1 and stats["delivered"] == 2
+    finally:
+        router.close()
+
+
+def test_malformed_deadline_answers_error_row():
+    r0 = StubReplica(0)
+    router = _router([r0])
+    try:
+        ticket = router.submit({"id": "bad", "prompt": [1], "deadline_ms": "soon"})
+        assert ticket.done.wait(timeout=10)
+        assert "malformed deadline_ms" in ticket.result["error"]
+        assert not r0.handled
+        assert router.stats()["rejected"] == 1
+    finally:
+        router.close()
+
+
+def test_bounded_queue_sheds_batch_before_interactive():
+    """Load-shed admission: at max_queue_depth an interactive arrival
+    displaces the newest queued batch ticket (explicit over-capacity error
+    row); with no batch ticket left, the arrival itself is shed. Nothing
+    is ever silently dropped."""
+    r0 = StubReplica(0)
+    r0.state = "starting"  # not dispatchable yet: the queue really builds
+    router = _router([r0], max_queue_depth=2)
+    try:
+        b1 = router.submit({"id": "b1", "prompt": [1], "priority": "batch"})
+        b2 = router.submit({"id": "b2", "prompt": [1], "priority": "batch"})
+        # interactive arrival over a full queue sheds the NEWEST batch
+        # ticket (b2 — it has waited the least)
+        i1 = router.submit({"id": "i1", "prompt": [1]})
+        assert b2.done.wait(timeout=10)
+        assert "over capacity" in b2.result["error"]
+        # the next interactive arrival displaces the remaining batch ticket
+        i2 = router.submit({"id": "i2", "prompt": [1]})
+        assert b1.done.wait(timeout=10)
+        assert "over capacity" in b1.result["error"]
+        # with only interactive queued, an interactive arrival is itself
+        # shed (never displaces its own class)...
+        i3 = router.submit({"id": "i3", "prompt": [1]})
+        assert i3.done.wait(timeout=10)
+        assert "over capacity" in i3.result["error"]
+        # ...as is a batch arrival (batch never displaces anything)
+        b3 = router.submit({"id": "b3", "prompt": [1], "priority": "batch"})
+        assert b3.done.wait(timeout=10)
+        assert "over capacity" in b3.result["error"]
+        r0.state = "ready"  # open the floodgate; survivors drain
+        assert router.wait_idle(timeout=30)
+        assert i1.result["tokens"] == [1, 2, 3]
+        assert i2.result["tokens"] == [1, 2, 3]
+        stats = router.stats()
+        assert stats["shed"] == 4 and stats["delivered"] == 4
+    finally:
+        router.close()
+
+
 def test_stop_admission_answers_instead_of_dropping():
     r0 = StubReplica(0)
     router = _router([r0])
