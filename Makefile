@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -32,8 +32,9 @@ trace-smoke:      ## 20-step loop with diagnostics on; asserts the merged trace 
 metrics-smoke:    ## records a logging_dir fixture, scrapes the sidecar exporter (in-process + HTTP), checks SLO exit codes
 	python benchmarks/metrics_smoke.py
 
-lint:             ## self-application gate: examples/ + benchmarks/ must lint clean (exit 2 on error-severity findings)
+lint:             ## self-application gates: examples/ + benchmarks/ lint clean; the threaded tree race-checks clean (exit 2 on error findings)
 	python -m accelerate_tpu.commands.accelerate_cli lint examples benchmarks
+	python -m accelerate_tpu.commands.accelerate_cli race-check accelerate_tpu/serving accelerate_tpu/metrics accelerate_tpu/diagnostics accelerate_tpu/commands accelerate_tpu/analysis
 
 lint-smoke:       ## seeded-bad script trips the CLI (exit 2), clean tree passes, ACCELERATE_SANITIZE=1 names a retraced argument
 	python benchmarks/lint_smoke.py
@@ -52,3 +53,6 @@ kvq-smoke:        ## quantized KV cache: int8 holds ~2x the blocks of bf16 at eq
 
 chaos-smoke:      ## seeded kill -9 / 503 / delay schedule vs a supervised fleet: exactly-once delivery, zero orphans, respawn-with-backoff recovery to target count
 	python benchmarks/chaos_smoke.py
+
+race-smoke:       ## concurrency gate: clean tree race-checks 0/0, seeded lock inversion exits 2 naming RC002, chaos fleet runs with LockWatch armed -> zero order violations
+	python benchmarks/race_smoke.py

@@ -10,9 +10,29 @@
 * :mod:`.shardplan` — the static sharding-plan analyzer behind
   ``accelerate-tpu shard-check``: per-device HBM tiers and SP001-SP006
   findings computed from abstract shapes before anything allocates.
+* :mod:`.concurrency` — the static concurrency pass behind
+  ``accelerate-tpu race-check``: guarded-by inference, lock-order
+  cycles, blocking-under-lock, RC001-RC006 (stdlib-only; no jax).
+* :mod:`.lockwatch` — the runtime lock-order sanitizer: instrumented
+  lock wrappers, per-thread acquisition stacks, ``RACE_REPORT`` dumps
+  (armed via ``ACCELERATE_SANITIZE=1``; stdlib-only; no jax).
 """
 
+from .concurrency import (
+    RC_RULES,
+    race_check_paths,
+    race_check_source,
+    race_check_sources,
+)
 from .engine import lint_file, lint_paths, lint_source, normalize_rule_ids
+from .lockwatch import (
+    NULL_LOCKWATCH,
+    LockWatch,
+    WatchedLock,
+    get_active_lockwatch,
+    maybe_watch,
+    set_active_lockwatch,
+)
 from .rules import RULES, Finding
 
 
@@ -70,11 +90,21 @@ def __getattr__(name):
 
 __all__ = [
     "RULES",
+    "RC_RULES",
     "Finding",
     "lint_file",
     "lint_paths",
     "lint_source",
     "normalize_rule_ids",
+    "race_check_paths",
+    "race_check_source",
+    "race_check_sources",
+    "LockWatch",
+    "WatchedLock",
+    "NULL_LOCKWATCH",
+    "get_active_lockwatch",
+    "set_active_lockwatch",
+    "maybe_watch",
     "Sanitizer",
     "NULL_SANITIZER",
     "get_active_sanitizer",

@@ -131,7 +131,8 @@ class RecompileFingerprinter:
         return fp, diff
 
     def compiles_of(self, label: str) -> int:
-        return self._counts.get(label, 0)
+        with self._lock:  # note() mutates _counts concurrently (race-check)
+            return self._counts.get(label, 0)
 
     def clear(self):
         with self._lock:

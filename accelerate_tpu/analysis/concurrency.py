@@ -1,0 +1,1232 @@
+"""Static concurrency rules (RC001…RC006) — the catalogue behind
+``accelerate-tpu race-check``.
+
+The serving fleet is a genuinely concurrent system: router dispatch and
+health threads, the supervisor respawn loop, chaos injectors, the
+exporter refresh lock and the watchdog all share state across dozens of
+lock/thread sites, and "reviewer vigilance" is not a concurrency model.
+This pass makes the common failure modes a CI failure instead of a
+production incident, the same way ``lint`` (TPU rules) does for traced
+code and ``shard-check`` (SP rules) does for sharding plans.
+
+Pure stdlib (``ast``) — like the lint engine, checking the tree must
+never require jax to import.
+
+What the analysis knows (and admits it does not):
+
+* **guarded-by inference** (RC001) — per class, an attribute ``self._x``
+  mutated inside ``with self._lock:`` in *any* method is inferred
+  lock-guarded; every other access must hold that lock too. "Holding"
+  is lexical ``with`` nesting **plus cross-method call edges**: a helper
+  only ever called with the lock held (the repo's "caller holds the
+  lock" idiom) inherits the held set at entry. Unlocked *writes* are
+  errors; unlocked *reads* report as warnings (a single aligned read is
+  atomic under the GIL, but it still reads torn compound state — the
+  clang ``-Wthread-safety`` convention). ``__init__`` is exempt:
+  construction happens-before publication.
+* **cross-class unification** — a receiver name that matches a
+  lock-owning class (``router._lock`` in ``supervisor.py`` →
+  ``Router._lock``) joins that class's analysis, so the supervisor
+  mutating ``router.replicas`` under the router's lock counts as a
+  guarded write *for the router's own accesses too*.
+* **lock-order graph** (RC002) — nested ``with`` statements and call
+  edges build a global acquisition-order graph across every analyzed
+  file; a cycle (lock A before B on one path, B before A on another) is
+  a deadlock waiting for the right interleaving.
+* Only ``with``-statement acquisition is modeled. Bare
+  ``.acquire()``/``.release()`` pairs are invisible to this pass — the
+  runtime half (:mod:`.lockwatch`, armed via ``ACCELERATE_SANITIZE=1``)
+  sees every acquisition including those.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .engine import filter_findings, iter_python_files
+from .rules import Finding, Rule
+
+#: the concurrency rule catalogue — IDs are append-only, like TPU/SP rules
+RC_RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "RC001",
+            "error",
+            "lock-guarded attribute accessed without the lock (guarded-by "
+            "inference; unlocked reads report as warnings)",
+            "take the guarding lock around this access, or — if the access is "
+            "deliberately lock-free — suppress with a reason",
+        ),
+        Rule(
+            "RC002",
+            "error",
+            "lock-order inversion: two locks acquired in opposite orders on "
+            "different paths (deadlock under the right interleaving)",
+            "pick one global order for the two locks and restructure the "
+            "out-of-order path (release the first lock before taking the second)",
+        ),
+        Rule(
+            "RC003",
+            "error",
+            "blocking call (HTTP, subprocess, sleep, thread join, event wait, "
+            "file write) while holding a lock",
+            "move the blocking call outside the lock: snapshot the shared state "
+            "under the lock, then block with the lock released",
+        ),
+        Rule(
+            "RC004",
+            "error",
+            "Condition discipline: wait() outside a while-predicate loop, or "
+            "notify()/wait() without holding the condition's lock",
+            "re-check the predicate in a while loop around wait() (spurious "
+            "wakeups are legal), and only wait/notify with the lock held",
+        ),
+        Rule(
+            "RC005",
+            "warning",
+            "thread lifecycle: non-daemon thread never joined, or a thread "
+            "started in __init__ before the object's state is fully built",
+            "pass daemon=True (or join the thread on shutdown), and start "
+            "worker threads as the LAST step of __init__",
+        ),
+        Rule(
+            "RC006",
+            "error",
+            "user callback invoked while holding a lock (re-entrancy deadlock "
+            "seed: the callback may call back into the lock's owner)",
+            "collect the callbacks under the lock, release it, then invoke them",
+        ),
+    )
+}
+
+# -- classification tables ---------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock"}
+#: method names that mutate their receiver (counted as writes for RC001)
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "sort",
+    "write", "flush", "writelines",
+}
+#: callables that block: dotted name -> short description (any ``urlopen``
+#: tail is caught generically at the call site)
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+}
+_BLOCKING_SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen"}
+#: call-name tails treated as user callbacks for RC006
+_CALLBACK_NAMES = {"callback", "cb"}
+_CALLBACK_SUFFIXES = ("_callback", "_cb", "_hook")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _attr_chain(node: ast.AST) -> list[str] | None:
+    """``router._work.notify_all`` → ``["router", "_work", "notify_all"]``;
+    None when the chain is not rooted at a plain name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _is_threading_ctor(node: ast.AST, names: set[str]) -> bool:
+    """True for ``threading.X(...)`` / bare ``X(...)`` with X in names."""
+    if not isinstance(node, ast.Call):
+        return False
+    tail = _dotted(node.func).rsplit(".", 1)[-1]
+    return tail in names
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+@dataclass
+class _Access:
+    """One recorded attribute access (``self._x`` or a unified
+    ``router._x``) with the lock set held at the site.
+
+    ``held`` is the *guaranteed* set (lexical + intersection over call
+    sites — what every path holds); ``held_any`` adds the union over call
+    sites (what some path holds). Guard inference is optimistic
+    (``held_any``: one locked write path marks the attribute guarded);
+    violation checking is pessimistic (``held``: one unlocked path to the
+    access is the bug)."""
+
+    cls: str
+    attr: str
+    write: bool
+    held: frozenset
+    held_any: frozenset
+    path: str
+    line: int
+    col: int
+    method: str  # "Class.method" of the accessing code, "" at module level
+    in_init: bool  # access happens in the OWNING class's own __init__
+
+
+@dataclass
+class _Edge:
+    """Lock-acquisition order fact: ``held`` was held when ``new`` was
+    acquired."""
+
+    held: str
+    new: str
+    path: str
+    line: int
+    col: int
+    where: str
+
+
+@dataclass
+class ClassConc:
+    """Per-class concurrency surface discovered in pass 1."""
+
+    name: str
+    path: str
+    locks: dict[str, str] = field(default_factory=dict)  # attr -> lock node id
+    conditions: dict[str, str] = field(default_factory=dict)  # attr -> lock node id
+    events: set[str] = field(default_factory=set)
+    files: set[str] = field(default_factory=set)
+    threads: set[str] = field(default_factory=set)
+
+    @property
+    def special_attrs(self) -> set[str]:
+        return (
+            set(self.locks) | set(self.conditions) | self.events
+            | self.files | self.threads
+        )
+
+
+@dataclass
+class ModuleConc:
+    """One file's contribution to the global analysis."""
+
+    path: str
+    source: str
+    classes: dict[str, ClassConc] = field(default_factory=dict)
+    accesses: list[_Access] = field(default_factory=list)
+    edges: list[_Edge] = field(default_factory=list)
+    findings: list[Finding] = field(default_factory=list)  # RC003/4/5/6
+
+
+# ---------------------------------------------------------------------------
+# pass 1: declared locks / conditions / events / threads / files per class
+# ---------------------------------------------------------------------------
+
+
+def _collect_class_surface(path: str, tree: ast.Module) -> dict[str, ClassConc]:
+    classes: dict[str, ClassConc] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = ClassConc(name=node.name, path=path)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or not isinstance(sub.value, ast.Call):
+                continue
+            for target in sub.targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                attr, call = target.attr, sub.value
+                if _is_threading_ctor(call, _LOCK_CTORS):
+                    info.locks[attr] = f"{node.name}.{attr}"
+                elif _is_threading_ctor(call, {"Condition"}):
+                    # Condition(self._lock) aliases that lock; a bare
+                    # Condition() owns a private one
+                    lock_node = f"{node.name}.{attr}"
+                    if call.args:
+                        chain = _attr_chain(call.args[0])
+                        if chain and chain[0] == "self" and len(chain) == 2:
+                            lock_node = f"{node.name}.{chain[1]}"
+                    info.conditions[attr] = lock_node
+                elif _is_threading_ctor(call, {"Event"}):
+                    info.events.add(attr)
+                elif _is_threading_ctor(call, {"Thread", "Timer"}):
+                    info.threads.add(attr)
+                elif _dotted(call.func) == "open":
+                    info.files.add(attr)
+                elif isinstance(call.func, ast.Name) and call.func.id == "maybe_watch":
+                    # the LockWatch wrapper: maybe_watch(threading.Lock(), ...)
+                    if call.args and _is_threading_ctor(call.args[0], _LOCK_CTORS):
+                        info.locks[attr] = f"{node.name}.{attr}"
+            # lists of threads: self._threads = [Thread(...), ...]
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Assign)
+                and isinstance(sub.value, (ast.List, ast.Tuple))
+                and any(
+                    _is_threading_ctor(e, {"Thread"}) for e in sub.value.elts
+                )
+            ):
+                for target in sub.targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        info.threads.add(target.attr)
+        classes[node.name] = info
+    return classes
+
+
+# ---------------------------------------------------------------------------
+# pass 2: held-region scan per function / method
+# ---------------------------------------------------------------------------
+
+
+class _FunctionScan:
+    """Walks one function body tracking the lexically-held lock set plus
+    an inferred entry-held set, recording accesses, acquisition edges,
+    self-call sites, and the purely-local findings (RC003/4/5/6)."""
+
+    def __init__(
+        self,
+        module: "_ModuleAnalyzer",
+        cls: ClassConc | None,
+        fn: ast.FunctionDef,
+        qualname: str,
+        entry_held: frozenset,
+        entry_any: frozenset = frozenset(),
+    ):
+        self.m = module
+        self.cls = cls
+        self.fn = fn
+        self.qualname = qualname
+        self.entry_held = entry_held
+        self.entry_any = entry_any | entry_held
+        self.is_init = fn.name == "__init__"
+        self.loop_stack: list[str] = []
+        self.aliases: dict[str, tuple[str, str]] = {}  # local -> ("file"|"thread", detail)
+        # function-local lock variables (`lk = threading.Lock()`): scoped to
+        # this function and inherited by nested scopes (closures, local HTTP
+        # Handler classes) — two same-named locals in unrelated functions are
+        # DIFFERENT locks and must never merge into one order-graph node
+        self.local_locks: dict[str, str] = dict(module.inherited_locks(qualname))
+        self.thread_locals: dict[str, bool] = {}  # local thread var -> daemon?
+        self.started_thread_at: int | None = None  # stmt line of first .start()
+        self.calls: list[tuple[str, frozenset]] = []  # (callee qualname, held)
+
+    # -- resolution ----------------------------------------------------------
+
+    def _class_of_receiver(self, root: str) -> ClassConc | None:
+        """``self`` → the current class; otherwise unify the receiver name
+        (``router`` / ``self._router``) with a lock-owning class."""
+        if root == "self":
+            return self.cls
+        return self.m.unify(root)
+
+    def _resolve_lock_expr(self, expr: ast.AST) -> str | None:
+        """A with-item's context expression → lock node id (or None when it
+        is not a lock/condition this pass knows about)."""
+        chain = _attr_chain(expr)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            # plain name: this function's (or an enclosing scope's) local
+            # lock first, then a module-level lock variable
+            local = self.local_locks.get(chain[0])
+            if local is not None:
+                return local
+            return self.m.var_locks.get(chain[0])
+        # self._lock / self._router._lock / router._lock
+        root, rest = chain[0], chain[1:]
+        if root == "self" and len(rest) == 2:
+            # self._router._lock → unify the middle hop
+            owner = self.m.unify(rest[0])
+            if owner is not None:
+                root, rest = rest[0], rest[1:]
+                return self._lock_of(owner, rest[0])
+            return None
+        if len(rest) != 1:
+            return None
+        owner = self._class_of_receiver(root)
+        if owner is not None:
+            return self._lock_of(owner, rest[0])
+        return None
+
+    @staticmethod
+    def _lock_of(owner: ClassConc, attr: str) -> str | None:
+        if attr in owner.locks:
+            return owner.locks[attr]
+        if attr in owner.conditions:
+            return owner.conditions[attr]
+        # heuristic: an attribute *named* like a lock (Metric's ctor-passed
+        # self._lock) still participates, so shared-lock classes are not
+        # silently skipped
+        if "lock" in attr.lower() or "mutex" in attr.lower():
+            return f"{owner.name}.{attr}"
+        return None
+
+    def _condition_lock(self, chain: list[str]) -> str | None:
+        """``["self", "_work"]`` / ``["router", "_work"]`` → the lock node
+        the condition guards with (None when not a known condition)."""
+        if len(chain) != 2:
+            return None
+        owner = self._class_of_receiver(chain[0])
+        if owner is not None and chain[1] in owner.conditions:
+            return owner.conditions[chain[1]]
+        return None
+
+    # -- findings ------------------------------------------------------------
+
+    def _finding(self, rule: str, node: ast.AST, message: str, severity=None):
+        r = RC_RULES[rule]
+        self.m.report.findings.append(
+            Finding(
+                rule=rule,
+                severity=severity or r.severity,
+                message=message,
+                fixit=r.fixit,
+                path=self.m.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+            )
+        )
+
+    # -- the walk ------------------------------------------------------------
+
+    def run(self):
+        self._scan_body(self.fn.body, self.entry_held)
+
+    def _scan_body(self, stmts, held: frozenset):
+        for st in stmts:
+            self._scan_stmt(st, held)
+
+    def _scan_stmt(self, st: ast.stmt, held: frozenset):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired: list[str] = []
+            for item in st.items:
+                self._scan_expr(item.context_expr, held)
+                lock = self._resolve_lock_expr(item.context_expr)
+                if lock is not None:
+                    for h in tuple(held) + tuple(acquired):
+                        if h != lock:
+                            self.m.report.edges.append(
+                                _Edge(
+                                    held=h,
+                                    new=lock,
+                                    path=self.m.path,
+                                    line=item.context_expr.lineno,
+                                    col=item.context_expr.col_offset,
+                                    where=self.qualname,
+                                )
+                            )
+                    acquired.append(lock)
+            self._scan_body(st.body, held | frozenset(acquired))
+        elif isinstance(st, ast.While):
+            self._scan_expr(st.test, held)
+            self.loop_stack.append("while")
+            self._scan_body(st.body, held)
+            self.loop_stack.pop()
+            self._scan_body(st.orelse, held)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter, held)
+            self._note_for_alias(st)
+            self.loop_stack.append("for")
+            self._scan_body(st.body, held)
+            self.loop_stack.pop()
+            self._scan_body(st.orelse, held)
+        elif isinstance(st, ast.If):
+            self._scan_expr(st.test, held)
+            self._scan_body(st.body, held)
+            self._scan_body(st.orelse, held)
+        elif isinstance(st, ast.Try):
+            self._scan_body(st.body, held)
+            for h in st.handlers:
+                self._scan_body(h.body, held)
+            self._scan_body(st.orelse, held)
+            self._scan_body(st.finalbody, held)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, not here — scanned as its own scope
+            # with an empty entry-held set by the module analyzer, but it
+            # closes over this scope's local locks
+            self.m.queue_nested(
+                st, self.cls, f"{self.qualname}.{st.name}", self.local_locks
+            )
+        elif isinstance(st, ast.ClassDef):
+            # function-local class (the serve/exporter HTTP Handler idiom):
+            # its methods run on server threads later, with nothing held,
+            # closing over this scope's local locks (the refresh_lock idiom)
+            info = _collect_class_surface(
+                self.m.path, ast.Module(body=[st], type_ignores=[])
+            )[st.name]
+            for sub in st.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.m.queue_nested(
+                        sub, info, f"{self.qualname}.{st.name}.{sub.name}",
+                        self.local_locks,
+                    )
+        else:
+            self._track_aliases(st)
+            self._track_thread_lifecycle(st, held)
+            self._scan_expr(st, held)
+
+    def _note_for_alias(self, st: ast.For):
+        """``for t in self._threads:`` makes ``t`` a thread alias."""
+        chain = _attr_chain(st.iter)
+        if (
+            chain is not None
+            and len(chain) == 2
+            and chain[0] == "self"
+            and self.cls is not None
+            and chain[1] in self.cls.threads
+            and isinstance(st.target, ast.Name)
+        ):
+            self.aliases[st.target.id] = ("thread", chain[1])
+
+    def _track_aliases(self, st: ast.stmt):
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            return
+        target = st.targets[0]
+        if not isinstance(target, ast.Name):
+            return
+        value = st.value
+        chain = _attr_chain(value)
+        if chain and len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            if chain[1] in self.cls.files:
+                self.aliases[target.id] = ("file", chain[1])
+            elif chain[1] in self.cls.threads:
+                self.aliases[target.id] = ("thread", chain[1])
+        elif isinstance(value, ast.Call):
+            if _dotted(value.func) == "open":
+                self.aliases[target.id] = ("file", target.id)
+            elif _is_threading_ctor(value, {"Thread", "Timer"}):
+                self.aliases[target.id] = ("thread", target.id)
+                self.thread_locals[target.id] = _thread_is_daemon(value)
+            elif _is_threading_ctor(value, {"Event"}):
+                self.aliases[target.id] = ("event", target.id)
+            elif _is_threading_ctor(value, _LOCK_CTORS):
+                self.local_locks[target.id] = (
+                    f"{self.m.modkey}.{self.qualname}.{target.id}"
+                )
+
+    # -- RC005: thread lifecycle ---------------------------------------------
+
+    def _track_thread_lifecycle(self, st: ast.stmt, held: frozenset):
+        # escape: a local thread stored on an attribute, returned, or passed
+        # as an argument is join-able elsewhere under another name — drop
+        # its fire-and-forget candidacy rather than false-positive
+        for node in ast.walk(st):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.thread_locals
+                and any(not isinstance(t, ast.Name) for t in node.targets)
+            ):
+                self.m.note_join(node.value.id)
+            elif (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.thread_locals
+            ):
+                self.m.note_join(node.value.id)
+            elif isinstance(node, ast.Call):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if (
+                        isinstance(arg, ast.Name)
+                        and arg.id in self.thread_locals
+                    ):
+                        self.m.note_join(arg.id)
+        # fire-and-forget: threading.Thread(...).start() with no daemon flag
+        for node in ast.walk(st):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+            ):
+                recv = node.func.value
+                if _is_threading_ctor(recv, {"Thread"}):
+                    if not _thread_is_daemon(recv):
+                        self._finding(
+                            "RC005",
+                            node,
+                            "non-daemon thread started fire-and-forget (never "
+                            "joined): it blocks interpreter exit and outlives "
+                            "its owner",
+                        )
+                    if self.is_init:
+                        self.started_thread_at = node.lineno
+                elif self._is_thread_receiver(recv):
+                    if self.is_init:
+                        self.started_thread_at = node.lineno
+                    # the aliased spelling: `t = Thread(...); t.start()` —
+                    # deferred to module end so a `.join` anywhere in the
+                    # module (even another method) clears the candidate
+                    chain = _attr_chain(recv)
+                    if (
+                        chain is not None
+                        and len(chain) == 1
+                        and self.thread_locals.get(chain[0]) is False
+                    ):
+                        self.m.note_thread_start(chain[0], node, self.qualname)
+        # __init__ ordering: self-state assigned AFTER a worker thread started
+        if (
+            self.is_init
+            and self.started_thread_at is not None
+            and isinstance(st, ast.Assign)
+        ):
+            for target in st.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and st.lineno > self.started_thread_at
+                    and self.cls is not None
+                    and target.attr not in self.cls.special_attrs
+                ):
+                    self._finding(
+                        "RC005",
+                        st,
+                        f"__init__ assigns self.{target.attr} AFTER starting a "
+                        "worker thread (line "
+                        f"{self.started_thread_at}): the thread can observe "
+                        "the object half-built",
+                    )
+
+    def _is_thread_receiver(self, node: ast.AST) -> bool:
+        chain = _attr_chain(node)
+        if chain is None:
+            return False
+        if len(chain) == 1:
+            return self.aliases.get(chain[0], ("",))[0] == "thread"
+        if len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            return chain[1] in self.cls.threads
+        return False
+
+    def _is_event_receiver(self, node: ast.AST) -> bool:
+        chain = _attr_chain(node)
+        if chain is None:
+            return False
+        if len(chain) == 1:
+            return self.aliases.get(chain[0], ("",))[0] == "event"
+        if len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            return chain[1] in self.cls.events
+        return False
+
+    def _is_file_receiver(self, node: ast.AST) -> bool:
+        chain = _attr_chain(node)
+        if chain is None:
+            return False
+        if len(chain) == 1:
+            return self.aliases.get(chain[0], ("",))[0] == "file"
+        if len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            return chain[1] in self.cls.files
+        return False
+
+    # -- expression scan -------------------------------------------------------
+
+    def _scan_expr(self, root: ast.AST, held: frozenset):
+        for node in self._walk_scope(root):
+            if isinstance(node, ast.Call):
+                self._scan_call(node, held)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ) and isinstance(node.value, ast.Attribute):
+                # self._meta[k] = v mutates self._meta
+                self._record_receiver_access(node.value, held, write=True)
+            elif isinstance(node, ast.Attribute):
+                self._record_attr_access(node, held)
+
+    @staticmethod
+    def _walk_scope(root: ast.AST):
+        """ast.walk that does not descend into nested function scopes or
+        lambdas (they run later, under a different held set)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                stack.append(child)
+
+    def _record_attr_access(self, node: ast.Attribute, held: frozenset):
+        # only direct receiver-rooted accesses: `recv.X`, not `recv.X.Y`
+        if not isinstance(node.value, ast.Name) and not (
+            isinstance(node.value, ast.Attribute)
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id == "self"
+        ):
+            return
+        self._record_receiver_access(
+            node, held, write=isinstance(node.ctx, (ast.Store, ast.Del))
+        )
+
+    def _record_receiver_access(
+        self, node: ast.Attribute, held: frozenset, write: bool
+    ):
+        chain = _attr_chain(node)
+        if chain is None:
+            return
+        if chain[0] == "self" and len(chain) == 3:
+            # self._router.replicas → treat as <unified>.replicas
+            owner = self.m.unify(chain[1])
+            if owner is None:
+                return
+            attr = chain[2]
+        elif len(chain) == 2:
+            owner = self._class_of_receiver(chain[0])
+            attr = chain[1]
+        else:
+            return
+        if owner is None or attr in owner.special_attrs:
+            return
+        cls_key = owner.name
+        if owner.name in self.m.ambiguous:
+            # two same-named classes in different files must not pool their
+            # guarded-by evidence
+            cls_key = f"{owner.name} ({os.path.basename(owner.path)})"
+        self.m.report.accesses.append(
+            _Access(
+                cls=cls_key,
+                attr=attr,
+                write=write,
+                held=held,
+                held_any=held | self.entry_any,
+                path=self.m.path,
+                line=node.lineno,
+                col=node.col_offset,
+                method=self.qualname,
+                in_init=self.is_init and owner is self.cls,
+            )
+        )
+
+    def _scan_call(self, node: ast.Call, held: frozenset):
+        func = node.func
+        dotted = _dotted(func)
+        tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+
+        # self-method call edges (entry-held inference)
+        chain = _attr_chain(func)
+        if chain and len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            self.calls.append((f"{self.cls.name}.{chain[1]}", held))
+        elif chain and len(chain) == 1:
+            self.calls.append((chain[0], held))
+
+        # `.join` anywhere in the module clears a fire-and-forget candidate
+        if tail == "join" and chain is not None and len(chain) >= 2:
+            self.m.note_join(chain[-2])
+
+        # mutator method on a receiver attribute → a write for RC001
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _MUTATORS
+            and isinstance(func.value, ast.Attribute)
+        ):
+            self._record_receiver_access(func.value, held, write=True)
+
+        if not held:
+            # everything below only fires inside a lock-held region
+            # (RC004's lockless wait/notify is checked right here though)
+            if tail in ("wait", "notify", "notify_all") and chain:
+                cond_lock = self._condition_lock(chain[:-1])
+                if cond_lock is not None:
+                    self._finding(
+                        "RC004",
+                        node,
+                        f"{'.'.join(chain)}() called without holding "
+                        f"{cond_lock} — Condition wait/notify outside the "
+                        "lock raises RuntimeError at run time",
+                    )
+            return
+
+        held_names = ", ".join(sorted(held))
+
+        # RC003: blocking calls under a lock
+        blocking = None
+        if dotted in _BLOCKING_DOTTED:
+            blocking = _BLOCKING_DOTTED[dotted]
+        elif dotted.startswith("subprocess.") and tail in _BLOCKING_SUBPROCESS:
+            blocking = f"subprocess.{tail}()"
+        elif tail == "communicate":
+            blocking = ".communicate()"
+        elif tail == "sleep" and dotted == "sleep" and self.m.sleep_imported:
+            blocking = "sleep()"
+        elif tail == "urlopen":
+            blocking = "urlopen (HTTP)"
+        elif (
+            tail == "join"
+            and isinstance(func, ast.Attribute)
+            and self._is_thread_receiver(func.value)
+        ):
+            blocking = "thread .join()"
+        elif (
+            tail == "wait"
+            and isinstance(func, ast.Attribute)
+            and self._is_event_receiver(func.value)
+        ):
+            blocking = "Event.wait()"
+        elif (
+            tail in ("write", "flush", "writelines")
+            and isinstance(func, ast.Attribute)
+            and self._is_file_receiver(func.value)
+        ):
+            blocking = f"file .{tail}()"
+        if blocking is not None:
+            self._finding(
+                "RC003",
+                node,
+                f"{blocking} while holding {held_names}: every other thread "
+                "needing the lock stalls behind this call",
+            )
+
+        # RC004: condition discipline under the lock
+        if tail in ("wait", "notify", "notify_all") and chain:
+            cond_lock = self._condition_lock(chain[:-1])
+            if cond_lock is not None:
+                if cond_lock not in held:
+                    self._finding(
+                        "RC004",
+                        node,
+                        f"{'.'.join(chain)}() while holding {held_names} but "
+                        f"not {cond_lock} — the condition's own lock must be "
+                        "held",
+                    )
+                elif tail == "wait" and "while" not in self.loop_stack:
+                    self._finding(
+                        "RC004",
+                        node,
+                        f"{'.'.join(chain)}() is not inside a while-predicate "
+                        "loop: a spurious (or stale) wakeup proceeds on a "
+                        "false predicate",
+                    )
+
+        # RC006: user callback under the lock
+        is_callback = tail in _CALLBACK_NAMES or any(
+            tail.endswith(s) for s in _CALLBACK_SUFFIXES
+        )
+        if is_callback:
+            self._finding(
+                "RC006",
+                node,
+                f"callback {dotted or tail}(...) invoked while holding "
+                f"{held_names}: if the callback re-enters the owner (submit, "
+                "stats, …) the thread self-deadlocks",
+            )
+
+
+# ---------------------------------------------------------------------------
+# module analyzer
+# ---------------------------------------------------------------------------
+
+
+class _ModuleAnalyzer:
+    """Analyzes one parsed module against a (possibly multi-file) class
+    registry; produces a :class:`ModuleConc`."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        tree: ast.Module,
+        registry: dict[str, ClassConc],
+        ambiguous: set[str],
+    ):
+        self.path = path
+        self.tree = tree
+        self.registry = registry
+        self.ambiguous = ambiguous
+        self.report = ModuleConc(path=path, source=source)
+        self.var_locks: dict[str, str] = {}
+        self.sleep_imported = False
+        self._unify_map: dict[str, str] = {}
+        self._nested: list[tuple] = []
+        self._nested_locks: dict[str, dict[str, str]] = {}
+        self._thread_candidates: list[tuple] = []
+        self._joined: set[str] = set()
+        self.modkey = os.path.splitext(os.path.basename(path))[0]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                if any(a.name == "sleep" for a in node.names):
+                    self.sleep_imported = True
+        # MODULE-level lock variables only: a function-local
+        # `lk = threading.Lock()` is a different lock per call (and per
+        # function) — those are tracked per scope by _FunctionScan
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_threading_ctor(
+                node.value, _LOCK_CTORS
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.var_locks[target.id] = f"{self.modkey}.{target.id}"
+        for cls_name in registry:
+            if cls_name in ambiguous:
+                continue
+            key = cls_name.lower()
+            self._unify_map.setdefault(key, cls_name)
+
+    def unify(self, receiver: str) -> ClassConc | None:
+        """Map a receiver identifier to a lock-owning class: ``router`` /
+        ``_router`` → ``Router``. Only classes that declare at least one
+        lock participate (keeps ``handler``-style names from binding to
+        lock-free classes), and ambiguous class names never unify."""
+        key = receiver.lstrip("_").lower()
+        name = self._unify_map.get(key)
+        if name is None:
+            return None
+        cls = self.registry.get(name)
+        if cls is None or not (cls.locks or cls.conditions):
+            return None
+        return cls
+
+    def queue_nested(self, fn, cls, qualname, parent_locks=None):
+        self._nested.append((fn, cls, qualname))
+        if parent_locks:
+            self._nested_locks[qualname] = dict(parent_locks)
+
+    def note_thread_start(self, name: str, node: ast.AST, qualname: str) -> None:
+        self._thread_candidates.append(
+            (name, node.lineno, node.col_offset, qualname)
+        )
+
+    def note_join(self, name: str) -> None:
+        self._joined.add(name)
+
+    def inherited_locks(self, qualname: str) -> dict[str, str]:
+        """Local locks a nested scope closes over (empty for top-level
+        functions and methods)."""
+        return self._nested_locks.get(qualname, {})
+
+    def run(self) -> ModuleConc:
+        # entry-held fixpoint: re-scan with inferred entry sets until stable,
+        # then one authoritative pass that also knows the union over call
+        # sites (guard inference is optimistic, violation checks pessimistic)
+        entry: dict[str, frozenset] = {}
+        entry_any: dict[str, frozenset] = {}
+
+        def one_round() -> dict[str, list[frozenset]]:
+            self.report.accesses.clear()
+            self.report.edges.clear()
+            self.report.findings.clear()
+            self._nested = []
+            self._nested_locks = {}
+            self._thread_candidates = []
+            self._joined = set()
+            calls: dict[str, list[frozenset]] = {}
+            scans: list[_FunctionScan] = []
+
+            def scan_fn(fn, cls, qualname):
+                s = _FunctionScan(
+                    self, cls, fn, qualname,
+                    entry.get(qualname, frozenset()),
+                    entry_any.get(qualname, frozenset()),
+                )
+                s.run()
+                scans.append(s)
+
+            for node in self.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_fn(node, None, node.name)
+                elif isinstance(node, ast.ClassDef):
+                    cls = self.registry.get(node.name)
+                    if cls is None or cls.path != self.path:
+                        cls = _collect_class_surface(self.path, ast.Module(
+                            body=[node], type_ignores=[]
+                        ))[node.name]
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            scan_fn(sub, cls, f"{node.name}.{sub.name}")
+            # nested defs (closures) run with an empty entry set
+            i = 0
+            while i < len(self._nested):
+                fn, cls, qualname = self._nested[i]
+                i += 1
+                scan_fn(fn, cls, qualname)
+            for s in scans:
+                for callee, held in s.calls:
+                    calls.setdefault(callee, []).append(held)
+            return calls
+
+        stable = False
+        for _ in range(4):
+            calls = one_round()
+            new_entry = {
+                callee: frozenset.intersection(*helds)
+                for callee, helds in calls.items()
+                if helds
+            }
+            new_entry = {k: v for k, v in new_entry.items() if v}
+            new_any = {
+                callee: frozenset().union(*helds)
+                for callee, helds in calls.items()
+                if helds
+            }
+            new_any = {k: v for k, v in new_any.items() if v}
+            stable = new_entry == entry and new_any == entry_any
+            entry, entry_any = new_entry, new_any
+            if stable:
+                # the round that just ran already used these exact maps —
+                # its records ARE authoritative
+                break
+        if not stable:
+            one_round()  # iteration cap hit: one pass with the final maps
+        # aliased fire-and-forget threads: a local non-daemon Thread whose
+        # name is never `.join`ed anywhere in the module
+        rule = RC_RULES["RC005"]
+        for name, line, col, qualname in self._thread_candidates:
+            if name in self._joined:
+                continue
+            self.report.findings.append(
+                Finding(
+                    rule="RC005",
+                    severity=rule.severity,
+                    message=(
+                        f"non-daemon thread {name!r} started in {qualname} "
+                        "and never joined anywhere in this module: it blocks "
+                        "interpreter exit and outlives its owner"
+                    ),
+                    fixit=rule.fixit,
+                    path=self.path,
+                    line=line,
+                    col=col,
+                )
+            )
+        return self.report
+
+
+# ---------------------------------------------------------------------------
+# merge: guarded-by findings (RC001) + lock-order cycles (RC002)
+# ---------------------------------------------------------------------------
+
+
+def _guarded_by_findings(reports: list[ModuleConc]) -> list[Finding]:
+    """Cross-file guarded-by inference over the merged access tables."""
+    by_class: dict[str, list[_Access]] = {}
+    for rep in reports:
+        for acc in rep.accesses:
+            by_class.setdefault(acc.cls, []).append(acc)
+    findings: list[Finding] = []
+    rule = RC_RULES["RC001"]
+    for cls, accesses in sorted(by_class.items()):
+        guards: dict[str, set[str]] = {}
+        guard_sites: dict[str, int] = {}
+        for acc in accesses:
+            if acc.write and not acc.in_init and acc.held_any:
+                guards.setdefault(acc.attr, set()).update(acc.held_any)
+                guard_sites[acc.attr] = guard_sites.get(acc.attr, 0) + 1
+        # one access per (site, attr); a mutator call records both a Load of
+        # the attribute and the write — the write wins
+        coalesced: dict[tuple, _Access] = {}
+        for acc in accesses:
+            key = (acc.path, acc.line, acc.col, acc.attr)
+            prev = coalesced.get(key)
+            if prev is None or (acc.write and not prev.write):
+                coalesced[key] = acc
+        for acc in coalesced.values():
+            guard = guards.get(acc.attr)
+            if not guard or acc.in_init:
+                continue
+            if acc.held & guard:
+                continue
+            verb = "written" if acc.write else "read"
+            findings.append(
+                Finding(
+                    rule="RC001",
+                    severity="error" if acc.write else "warning",
+                    message=(
+                        f"{cls}.{acc.attr} is lock-guarded ({verb} here in "
+                        f"{acc.method or '<module>'} without a lock, but "
+                        f"mutated under {', '.join(sorted(guard))} at "
+                        f"{guard_sites[acc.attr]} site(s))"
+                    ),
+                    fixit=rule.fixit,
+                    path=acc.path,
+                    line=acc.line,
+                    col=acc.col,
+                )
+            )
+    return findings
+
+
+def _lock_order_findings(reports: list[ModuleConc]) -> list[Finding]:
+    """Cycle detection over the merged acquisition-order graph."""
+    edges: dict[tuple[str, str], _Edge] = {}
+    succ: dict[str, set[str]] = {}
+    for rep in reports:
+        for e in rep.edges:
+            edges.setdefault((e.held, e.new), e)
+            succ.setdefault(e.held, set()).add(e.new)
+
+    def path_between(a: str, b: str) -> list[str] | None:
+        """Shortest a→…→b node path over the order graph (BFS)."""
+        from collections import deque
+
+        prev: dict[str, str] = {a: a}
+        q = deque([a])
+        while q:
+            n = q.popleft()
+            if n == b:
+                out = [b]
+                while out[-1] != a:
+                    out.append(prev[out[-1]])
+                return list(reversed(out))
+            for s in succ.get(n, ()):
+                if s not in prev:
+                    prev[s] = n
+                    q.append(s)
+        return None
+
+    findings: list[Finding] = []
+    rule = RC_RULES["RC002"]
+    seen_cycles: set[frozenset] = set()
+    for (a, b), e in sorted(edges.items()):
+        back = path_between(b, a)
+        if back is None:
+            continue
+        cycle = frozenset(back) | {a, b}
+        if cycle in seen_cycles:
+            continue
+        seen_cycles.add(cycle)
+        counter = edges.get((back[0], back[1]))
+        counter_site = (
+            f"{counter.path}:{counter.line} in {counter.where}"
+            if counter is not None
+            else "?"
+        )
+        findings.append(
+            Finding(
+                rule="RC002",
+                severity=rule.severity,
+                message=(
+                    f"lock-order inversion: {b} acquired while holding {a} "
+                    f"(here, in {e.where}), but the reverse order "
+                    f"{' -> '.join(back)} is taken at {counter_site} — "
+                    "two threads on these paths deadlock"
+                ),
+                fixit=rule.fixit,
+                path=e.path,
+                line=e.line,
+                col=e.col,
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# public entry points (the CLI's engine)
+# ---------------------------------------------------------------------------
+
+
+def _parse(path: str, source: str) -> ast.Module | Finding:
+    try:
+        return ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(
+            rule="RC000",
+            severity="error",
+            message=f"could not parse: {e.msg}",
+            fixit="fix the syntax error; nothing else was checked",
+            path=path,
+            line=e.lineno or 0,
+            col=e.offset or 0,
+        )
+
+
+def race_check_sources(
+    sources: dict[str, str],
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> list[Finding]:
+    """Race-check a set of ``{path: source}`` modules as one program:
+    classes unify across files, so a supervisor taking ``router._lock``
+    joins the router's analysis. Suppressions apply per file."""
+    trees: dict[str, ast.Module] = {}
+    parse_failures: list[Finding] = []
+    for path, source in sources.items():
+        parsed = _parse(path, source)
+        if isinstance(parsed, Finding):
+            parse_failures.append(parsed)
+        else:
+            trees[path] = parsed
+
+    registry: dict[str, ClassConc] = {}
+    ambiguous: set[str] = set()
+    for path, tree in trees.items():
+        for name, cls in _collect_class_surface(path, tree).items():
+            if name in registry and registry[name].path != path:
+                ambiguous.add(name)  # same name, different files: never unify
+            else:
+                registry[name] = cls
+
+    reports = [
+        _ModuleAnalyzer(path, sources[path], tree, registry, ambiguous).run()
+        for path, tree in sorted(trees.items())
+    ]
+    merged = (
+        [f for rep in reports for f in rep.findings]
+        + _guarded_by_findings(reports)
+        + _lock_order_findings(reports)
+    )
+    by_path: dict[str, list[Finding]] = {}
+    for f in merged:
+        by_path.setdefault(f.path, []).append(f)
+    out = list(parse_failures)
+    for path, findings in by_path.items():
+        out.extend(filter_findings(sources[path], findings, select, ignore))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def race_check_source(
+    source: str,
+    path: str = "<string>",
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> list[Finding]:
+    """Race-check one module's source text (tests, editors)."""
+    return race_check_sources({path: source}, select=select, ignore=ignore)
+
+
+def race_check_paths(
+    paths: list[str],
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> tuple[list[Finding], int]:
+    """Race-check every ``.py`` under ``paths`` as one program.
+    Returns (findings, files_scanned)."""
+    files = iter_python_files(paths)
+    sources: dict[str, str] = {}
+    unreadable: list[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                sources[path] = f.read()
+        except OSError as e:
+            unreadable.append(
+                Finding(
+                    rule="RC000",
+                    severity="error",
+                    message=f"could not read: {e}",
+                    fixit="check the path",
+                    path=path,
+                    line=0,
+                )
+            )
+    findings = unreadable + race_check_sources(sources, select=select, ignore=ignore)
+    return findings, len(files)

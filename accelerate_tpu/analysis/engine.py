@@ -73,14 +73,7 @@ def lint_source(
                 col=e.offset or 0,
             )
         ]
-    suppressed = _suppressions(source)
-    findings = []
-    for f in run_rules(tree, path):
-        if f.rule in suppressed.get(f.line, ()):
-            continue
-        if _selected(f, select, ignore):
-            findings.append(f)
-    return findings
+    return filter_findings(source, run_rules(tree, path), select, ignore)
 
 
 def lint_file(
@@ -134,22 +127,52 @@ def lint_paths(
     return findings, len(files)
 
 
-def normalize_rule_ids(raw: str | None) -> set[str] | None:
+def normalize_rule_ids(
+    raw: str | None,
+    catalogue: dict | None = None,
+    prefix: str = "TPU",
+) -> set[str] | None:
     """``"TPU001,tpu4"`` → ``{"TPU001", "TPU004"}`` (zero-padded); None
     passes through. Unknown IDs raise ValueError so a typo'd --select
-    fails loudly instead of silently selecting nothing."""
+    fails loudly instead of silently selecting nothing.
+
+    The same machinery serves every rule family riding this engine:
+    ``race-check`` passes its own ``catalogue`` (RC001…) and ``prefix``."""
     if not raw:
         return None
+    catalogue = RULES if catalogue is None else catalogue
     out: set[str] = set()
     for part in raw.split(","):
         part = part.strip().upper()
         if not part:
             continue
-        if part.startswith("TPU"):
-            part = "TPU" + part[3:].zfill(3)
-        if part not in RULES and part != "TPU000":
+        if part.startswith(prefix):
+            part = prefix + part[len(prefix):].zfill(3)
+        if part not in catalogue and part != prefix + "000":
             raise ValueError(
-                f"unknown rule id {part!r} (known: {', '.join(sorted(RULES))})"
+                f"unknown rule id {part!r} (known: {', '.join(sorted(catalogue))})"
             )
         out.add(part)
     return out or None
+
+
+def filter_findings(
+    source: str,
+    findings: list[Finding],
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+) -> list[Finding]:
+    """Apply this file's suppression comments + --select/--ignore to a
+    finding list — the shared back half of every rule family's file pass
+    (``lint`` runs TPU rules through it; ``race-check`` RC rules)."""
+    head = "\n".join(source.splitlines()[:10])
+    if _SKIP_FILE_RE.search(head):
+        return []
+    suppressed = _suppressions(source)
+    out = []
+    for f in findings:
+        if f.rule in suppressed.get(f.line, ()):
+            continue
+        if _selected(f, select, ignore):
+            out.append(f)
+    return out

@@ -1,0 +1,411 @@
+"""LockWatch — the runtime lock-order sanitizer (the dynamic half of
+``accelerate-tpu race-check``).
+
+Armed via ``ACCELERATE_SANITIZE=1`` (the same switch as the compile-path
+:mod:`.sanitizer` — one knob arms every runtime check), LockWatch wraps
+the serving fleet's locks in instrumented shims that:
+
+* keep the **per-thread acquisition stack** — which locks this thread
+  holds right now, in order;
+* maintain a **global acquisition-order graph** — lock A held while B
+  was acquired adds the edge A→B, with the first witnessing thread and
+  call site recorded;
+* on a **cycle-forming acquisition** (B→…→A already in the graph when
+  A→B appears), count a violation, print both witnesses to stderr, and
+  dump ``RACE_REPORT_<host>.json`` — both acquisition stacks named, the
+  full cycle, and the hold-time histograms — next to the run's other
+  crash artifacts (``accelerate-tpu monitor --once`` exits 2 when one
+  exists, the same contract as ``HANG_REPORT``);
+* record **hold-time histograms** per lock (p50/p99/max) that
+  :meth:`LockWatch.flush` hands to the telemetry recorder.
+
+Static analysis only sees ``with`` statements; LockWatch sees every
+acquisition — including bare ``.acquire()`` calls and Condition
+re-acquires — on the *real* interleavings the chaos harness produces.
+Disabled cost follows the telemetry convention exactly: construction
+sites call :func:`maybe_watch`, which is one module-global read and a
+truthiness test, and hands back the raw lock unchanged when LockWatch is
+off — the hot acquire/release path pays **zero** extra instructions.
+
+Pure stdlib and jax-free: the router/supervisor processes that use it
+never import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+RACE_REPORT_PATTERN = "RACE_REPORT_{host}.json"
+
+#: per-lock hold-time samples kept for the histograms (ring-capped)
+_MAX_HOLD_SAMPLES = 4096
+
+
+def _truthy_env(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes", "on")
+
+
+class _NullLockWatch:
+    """Disabled mode: falsy, every method a no-op."""
+
+    enabled = False
+    violations = 0
+
+    def __bool__(self):
+        return False
+
+    def flush(self):
+        pass
+
+    def report(self):
+        return {}
+
+
+NULL_LOCKWATCH = _NullLockWatch()
+
+_ACTIVE: "_NullLockWatch | LockWatch" = NULL_LOCKWATCH
+
+
+def get_active_lockwatch():
+    return _ACTIVE
+
+
+def set_active_lockwatch(watch) -> None:
+    global _ACTIVE
+    _ACTIVE = watch if watch is not None else NULL_LOCKWATCH
+
+
+def maybe_watch(lock, name: str, report_dir: str | None = None):
+    """Wrap ``lock`` in a :class:`WatchedLock` when LockWatch is armed;
+    hand it back untouched otherwise (the construction-time gate — the
+    acquire/release hot path pays nothing when disabled)."""
+    watch = _ACTIVE
+    if not watch:
+        return lock
+    if report_dir is not None and watch.report_dir is None:
+        watch.report_dir = report_dir
+    return WatchedLock(lock, name, watch)
+
+
+class WatchedLock:
+    """A lock shim that reports acquisitions/releases to a LockWatch.
+
+    Duck-types ``threading.Lock`` far enough for ``with``, bare
+    ``acquire``/``release``, and ``threading.Condition(WatchedLock)``
+    (the Condition fallback protocol only needs acquire/release)."""
+
+    __slots__ = ("_lock", "name", "_watch")
+
+    def __init__(self, lock, name: str, watch: "LockWatch"):
+        self._lock = lock
+        self.name = name
+        self._watch = watch
+
+    def acquire(self, blocking=True, timeout=-1):
+        if blocking:
+            # order facts are recorded at ATTEMPT time: a true deadlock
+            # never returns from the underlying acquire, so waiting for
+            # success would miss exactly the cycle that matters
+            self._watch.note_attempt(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._watch.note_acquired(self.name)
+        return ok
+
+    def release(self):
+        self._lock.release()
+        self._watch.note_released(self.name)
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"WatchedLock({self.name!r})"
+
+
+class LockWatch:
+    """Owns the order graph, the per-thread stacks, and the report.
+
+    Args:
+        report_dir: where ``RACE_REPORT_<host>.json`` lands on a
+            violation (None → first ``maybe_watch(report_dir=…)`` caller
+            sets it, else the current directory).
+        host: identity stamped into the report filename (defaults to the
+            pid — the router side is jax-free, so there is no process
+            index to ask for).
+        stream: violation sink (stderr by default; tests inject).
+        max_stack: frames kept per recorded acquisition stack.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        report_dir: str | None = None,
+        host: str | int | None = None,
+        stream=None,
+        max_stack: int = 12,
+    ):
+        self.report_dir = report_dir
+        self.host = host if host is not None else os.getpid()
+        self._stream = stream
+        self.max_stack = int(max_stack)
+        # bookkeeping is a leaf lock: nothing is ever acquired under it,
+        # and it is never watched itself
+        self._bookkeeping_lock = threading.Lock()
+        self._tls = threading.local()
+        #: lock name -> (owning thread's stack list, its live entry) — lets a
+        #: cross-thread release (the legal Lock handoff pattern) pop the
+        #: ACQUIRER's entry instead of leaking it into that thread's held
+        #: stack forever (which would fabricate order edges from then on)
+        self._live_entries: dict[str, tuple] = {}
+        #: (held, new) -> first-witness info
+        self._edges: dict[tuple, dict] = {}
+        self._succ: dict[str, set] = {}
+        self._holds: dict[str, list] = {}
+        self.violations = 0
+        self.reports: list[dict] = []
+
+    def __bool__(self):
+        return True
+
+    # -- per-thread stack ------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @staticmethod
+    def _now() -> float:
+        return time.perf_counter()
+
+    def _site(self) -> list[str]:
+        """Compact acquisition stack: innermost frames outside this
+        module."""
+        frames = traceback.extract_stack()
+        out = [
+            f"{os.path.basename(f.filename)}:{f.lineno} in {f.name}"
+            for f in frames
+            if os.path.basename(f.filename) != "lockwatch.py"
+        ]
+        return out[-self.max_stack:]
+
+    # -- WatchedLock callbacks -------------------------------------------------
+
+    def note_attempt(self, name: str) -> None:
+        held = [h for h, _ in self._stack()]
+        if not held or name in held:
+            # nothing held, or a re-entrant acquire (RLock anywhere in this
+            # thread's stack, not just top): re-entry can never block, so
+            # it is not an order fact — recording it would false-positive
+            # `with R: with X: with R:` as an X->R inversion
+            return
+        cycle = None
+        with self._bookkeeping_lock:
+            for h in held:
+                if h == name:
+                    continue
+                key = (h, name)
+                if key not in self._edges:
+                    self._edges[key] = {
+                        "thread": threading.current_thread().name,
+                        "stack": self._site(),
+                        "ts": time.time(),
+                    }
+                    self._succ.setdefault(h, set()).add(name)
+                    back = self._path(name, h)
+                    if back is not None:
+                        cycle = (h, name, back)
+            if cycle is not None:
+                self.violations += 1
+        if cycle is not None:
+            self._report_cycle(*cycle)
+
+    def note_acquired(self, name: str) -> None:
+        stack = self._stack()
+        entry = (name, self._now())
+        stack.append(entry)
+        with self._bookkeeping_lock:
+            self._live_entries[name] = (stack, entry)
+
+    def note_released(self, name: str) -> None:
+        stack = self._stack()
+        entry = None
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                entry = stack.pop(i)
+                break
+        if entry is None:
+            # released by a thread that never acquired it (the legal Lock
+            # handoff pattern): pop the acquirer's live entry by identity,
+            # or that thread's held stack leaks the lock and fabricates
+            # order edges for the rest of the run
+            with self._bookkeeping_lock:
+                live = self._live_entries.pop(name, None)
+            if live is None:
+                return
+            owner_stack, entry = live
+            try:
+                owner_stack.remove(entry)
+            except ValueError:
+                return  # already popped by the owner racing us
+        _, t0 = entry
+        dt = self._now() - t0
+        with self._bookkeeping_lock:
+            self._live_entries.pop(name, None)
+            samples = self._holds.setdefault(name, [])
+            samples.append(dt)
+            if len(samples) > _MAX_HOLD_SAMPLES:
+                del samples[: len(samples) - _MAX_HOLD_SAMPLES]
+
+    def _path(self, a: str, b: str) -> list[str] | None:
+        """a→…→b over the order graph (caller holds the bookkeeping
+        lock)."""
+        from collections import deque
+
+        prev = {a: a}
+        q = deque([a])
+        while q:
+            n = q.popleft()
+            if n == b:
+                out = [b]
+                while out[-1] != a:
+                    out.append(prev[out[-1]])
+                return list(reversed(out))
+            for s in self._succ.get(n, ()):
+                if s not in prev:
+                    prev[s] = n
+                    q.append(s)
+        return None
+
+    # -- violation report ------------------------------------------------------
+
+    def _report_cycle(self, held: str, new: str, back: list[str]) -> None:
+        with self._bookkeeping_lock:
+            edge_here = dict(self._edges.get((held, new), {}))
+            counter_edges = {
+                f"{a} -> {b}": dict(self._edges.get((a, b), {}))
+                for a, b in zip(back, back[1:])
+            }
+        report = {
+            "kind": "lock_order_inversion",
+            "host": self.host,
+            "ts": time.time(),
+            "acquiring": new,
+            "while_holding": held,
+            "cycle": back + [new] if back[-1] != new else back,
+            "witness": {
+                "thread": threading.current_thread().name,
+                "stack": self._site(),
+            },
+            "reverse_order_witnesses": counter_edges,
+            "first_seen_this_order": edge_here,
+            "hold_time_histograms": self.hold_histograms(),
+        }
+        self.reports.append(report)
+        stream = self._stream or sys.stderr
+        print(
+            f"LOCKWATCH[inversion]: acquiring {new} while holding {held}, "
+            f"but the order {' -> '.join(back)} was already observed "
+            f"(thread {report['witness']['thread']}); both stacks in the "
+            "race report",
+            file=stream,
+            flush=True,
+        )
+        self._write_report(report)
+        self._record_telemetry_event(report)
+
+    def _write_report(self, report: dict) -> None:
+        out_dir = self.report_dir or "."
+        path = os.path.join(out_dir, RACE_REPORT_PATTERN.format(host=self.host))
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _record_telemetry_event(self, report: dict) -> None:
+        from ..telemetry import get_active_recorder
+
+        tel = get_active_recorder()
+        if tel:
+            tel.record_event(
+                "lockwatch_inversion",
+                acquiring=report["acquiring"],
+                while_holding=report["while_holding"],
+                cycle=" -> ".join(report["cycle"]),
+            )
+
+    # -- histograms / summary --------------------------------------------------
+
+    def hold_histograms(self) -> dict:
+        """Per-lock hold-time stats in milliseconds (count/p50/p99/max)."""
+        out = {}
+        with self._bookkeeping_lock:
+            holds = {k: list(v) for k, v in self._holds.items()}
+        for name, samples in sorted(holds.items()):
+            if not samples:
+                continue
+            samples.sort()
+            n = len(samples)
+            out[name] = {
+                "count": n,
+                "p50_ms": round(samples[n // 2] * 1e3, 4),
+                "p99_ms": round(samples[min(n - 1, int(n * 0.99))] * 1e3, 4),
+                "max_ms": round(samples[-1] * 1e3, 4),
+            }
+        return out
+
+    def flush(self) -> None:
+        """Hand the hold-time histograms to the telemetry recorder (one
+        event per lock) — wired into the router's shutdown path."""
+        from ..telemetry import get_active_recorder
+
+        tel = get_active_recorder()
+        if not tel:
+            return
+        for name, h in self.hold_histograms().items():
+            tel.record_event("lockwatch_holds", lock=name, **h)
+
+    def report(self) -> dict:
+        with self._bookkeeping_lock:
+            edges = {f"{a} -> {b}": dict(v) for (a, b), v in self._edges.items()}
+            violations = self.violations
+            reports = list(self.reports)
+        return {
+            "violations": violations,
+            "edges": edges,
+            "reports": reports,
+            "hold_time_histograms": self.hold_histograms(),
+        }
+
+
+def _arm_from_env() -> None:
+    """ACCELERATE_SANITIZE=1 arms LockWatch at import time — the serving
+    processes are jax-free and never build an Accelerator, so the env
+    switch is the only arming path they have."""
+    if _truthy_env("ACCELERATE_SANITIZE"):
+        set_active_lockwatch(
+            LockWatch(report_dir=os.environ.get("ACCELERATE_LOCKWATCH_DIR"))
+        )
+
+
+_arm_from_env()

@@ -55,6 +55,14 @@ def env_command(args) -> int:
             "report, collective digests, NaN loss probe; static pass: "
             "`accelerate-tpu lint <paths>`)"
         ),
+        "LockWatch": (
+            "active (ACCELERATE_SANITIZE=1): serving locks are wrapped, "
+            "lock-order inversions dump RACE_REPORT_<host>.json"
+            if parse_flag_from_env("ACCELERATE_SANITIZE")
+            else "inactive (set ACCELERATE_SANITIZE=1 for the runtime "
+            "lock-order sanitizer; static pass: `accelerate-tpu "
+            "race-check <paths>`)"
+        ),
         "Metrics": (
             "active (ACCELERATE_METRICS=1)"
             if parse_flag_from_env("ACCELERATE_METRICS")

@@ -25,19 +25,21 @@ def monitor_command(args) -> int:
 
     * ``0`` — healthy (or nothing to report yet)
     * ``1`` — usage error (``logging_dir`` is not a directory)
-    * ``2`` — a host is wedged, a ``HANG_REPORT`` exists, a serving-fleet
-      replica is dead or its router rows went stale mid-run, or the
-      per-host collective-sequence digests diverge (a pre-deadlock
-      condition: the sanitizer writes one digest file per host, and
-      disagreement means a cross-host collective will never match up).
-      A supervised replica waiting out its respawn backoff still counts
-      as dead — the condition clears itself once the respawned process
-      writes a fresh ``ready`` row (newest row per replica wins)
+    * ``2`` — a host is wedged, a ``HANG_REPORT`` exists, a ``RACE_REPORT``
+      exists (LockWatch witnessed a lock-order inversion — a deadlock
+      waiting for the right interleaving), a serving-fleet replica is
+      dead or its router rows went stale mid-run, or the per-host
+      collective-sequence digests diverge (a pre-deadlock condition: the
+      sanitizer writes one digest file per host, and disagreement means
+      a cross-host collective will never match up). A supervised replica
+      waiting out its respawn backoff still counts as dead — the
+      condition clears itself once the respawned process writes a fresh
+      ``ready`` row (newest row per replica wins)
     * ``3`` — an ``ACCELERATE_SLO_*`` alert rule is firing (``ALERTS.json``
       written next to the run's artifacts; wedged/hang wins when both hold)
 
-    Precedence is fixed: ``1`` (usage) > ``2`` (wedged/dead/divergence) >
-    ``3`` (SLO) > ``0`` — a wedged fleet must not be masked by a mere SLO
+    Precedence is fixed: ``1`` (usage) > ``2`` (wedged/dead/race/divergence)
+    > ``3`` (SLO) > ``0`` — a wedged fleet must not be masked by a mere SLO
     breach, and scripts can rely on the ordering.
     """
     from ..diagnostics.monitor import collect_status, render_status
@@ -72,6 +74,7 @@ def monitor_command(args) -> int:
                 if (
                     status["wedged"]
                     or status["hang_reports"]
+                    or status.get("race_reports")
                     or status.get("collective_divergence")
                     or status.get("fleet_dead")
                 ):

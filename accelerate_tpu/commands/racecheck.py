@@ -1,0 +1,112 @@
+"""``accelerate-tpu race-check`` — the static concurrency-analysis pass.
+
+Checks threaded code (the serving fleet, the metrics exporter, the
+diagnostics watchdog — anything holding locks) for the defect classes
+that reviewer vigilance keeps missing: lock-guarded attributes touched
+without the lock, lock-order inversions, blocking calls under a lock,
+Condition misuse, half-built objects visible to early-started threads,
+and callbacks invoked with a lock held. Rule catalogue RC001…RC006:
+``accelerate_tpu/analysis/concurrency.py`` (docs:
+``usage_guides/linting.md``, "Concurrency rules").
+
+Exit codes (consistent with ``lint`` and ``monitor --once``):
+
+* ``0`` — clean, or warnings only
+* ``1`` — usage error (no such path, unknown rule id)
+* ``2`` — at least one **error**-severity finding
+
+The runtime half of the pass is **LockWatch**
+(``accelerate_tpu/analysis/lockwatch.py``): armed via
+``ACCELERATE_SANITIZE=1``, it wraps the serving fleet's locks, keeps the
+real acquisition-order graph per thread, and dumps
+``RACE_REPORT_<host>.json`` (both stacks named) the moment an
+order-inverting acquisition happens — including through the bare
+``.acquire()`` paths the static pass cannot see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def race_check_command(args) -> int:
+    from ..analysis.concurrency import RC_RULES, race_check_paths
+    from ..analysis.engine import normalize_rule_ids
+
+    if args.list_rules:
+        for rule in RC_RULES.values():
+            print(f"{rule.id}  [{rule.severity:7s}] {rule.summary}")
+        return 0
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"race-check: no such path: {path}", file=sys.stderr)
+            return 1
+    if not args.paths:
+        print(
+            "race-check: no paths given (try `accelerate-tpu race-check "
+            "accelerate_tpu/serving`)",
+            file=sys.stderr,
+        )
+        return 1
+
+    try:
+        select = normalize_rule_ids(args.select, catalogue=RC_RULES, prefix="RC")
+        ignore = normalize_rule_ids(args.ignore, catalogue=RC_RULES, prefix="RC")
+    except ValueError as e:
+        print(f"race-check: {e}", file=sys.stderr)
+        return 1
+
+    findings, files_scanned = race_check_paths(args.paths, select=select, ignore=ignore)
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files_scanned": files_scanned,
+                    "errors": len(errors),
+                    "warnings": len(warnings),
+                    "findings": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"race-check: {files_scanned} file(s) scanned — "
+            f"{len(errors)} error(s), {len(warnings)} warning(s)"
+        )
+    return 2 if errors else 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "race-check",
+        help="Static concurrency analysis (guarded-by violations, lock-order "
+        "inversions, blocking calls under locks)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to check")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule IDs to run exclusively (e.g. RC001,RC002)",
+    )
+    p.add_argument(
+        "--ignore",
+        default=None,
+        help="comma-separated rule IDs to skip",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    p.set_defaults(func=race_check_command)
+    return p

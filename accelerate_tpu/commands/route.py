@@ -62,12 +62,19 @@ def route_command(args) -> int:
         supervisor's respawn/scale-up paths, so a respawned replica is
         byte-identical in configuration to the one it replaces."""
         serve_tail = _serve_args(args)
+        env = None
         if args.logging_dir:
             # one telemetry trail per replica — two processes appending
             # the same telemetry.jsonl would interleave torn rows
             serve_tail += ["--logging-dir",
                            os.path.join(args.logging_dir, f"replica_{replica_id}")]
-        return spawn_replica(replica_id, serve_tail, stderr=sys.stderr)
+            # a replica-side LockWatch (ACCELERATE_SANITIZE=1) must dump its
+            # RACE_REPORT where `monitor --once` globs — the fleet's logging
+            # dir, not the replica process cwd (setdefault: an explicit
+            # operator ACCELERATE_LOCKWATCH_DIR wins)
+            env = dict(os.environ)
+            env.setdefault("ACCELERATE_LOCKWATCH_DIR", args.logging_dir)
+        return spawn_replica(replica_id, serve_tail, env=env, stderr=sys.stderr)
 
     replicas = []
     if args.attach:

@@ -54,21 +54,24 @@ class ServeHealth:
     the stdin reader, the engine loop) only ever look at ``state``."""
 
     def __init__(self, replica_id: int | None = None):
+        from ..analysis.lockwatch import maybe_watch
+
         self.replica_id = replica_id
         self._state = "starting"
-        self._lock = threading.Lock()
+        self._lock = maybe_watch(threading.Lock(), "ServeHealth._lock")
 
     @property
     def state(self) -> str:
-        return self._state
+        with self._lock:  # every reader goes through here (race-check RC001)
+            return self._state
 
     @property
     def ready(self) -> bool:
-        return self._state == "ready"
+        return self.state == "ready"
 
     @property
     def draining(self) -> bool:
-        return self._state == "draining"
+        return self.state == "draining"
 
     def mark_ready(self) -> None:
         with self._lock:
@@ -82,7 +85,7 @@ class ServeHealth:
     def payload(self, engine=None) -> dict:
         """The /healthz answer: state + the router's dispatch gauges."""
         out = {
-            "state": self._state,
+            "state": self.state,
             "pid": os.getpid(),
             "replica_id": self.replica_id,
             "queue_depth": None,

@@ -135,6 +135,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "stragglers": [],
         "wedged": [],
         "hang_reports": [],
+        "race_reports": [],
         "collective_divergence": [],
         "fleet": [],
         "fleet_dead": [],
@@ -278,6 +279,24 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             )
         except (OSError, json.JSONDecodeError):
             status["hang_reports"].append({"path": path})
+
+    # -- race reports (LockWatch lock-order violations) ----------------------
+    for path in sorted(glob.glob(os.path.join(logging_dir, "RACE_REPORT_*.json"))):
+        try:
+            with open(path) as f:
+                report = json.load(f)
+            status["race_reports"].append(
+                {
+                    "path": path,
+                    "host": report.get("host"),
+                    "acquiring": report.get("acquiring"),
+                    "while_holding": report.get("while_holding"),
+                    "cycle": report.get("cycle"),
+                    "ts": report.get("ts"),
+                }
+            )
+        except (OSError, json.JSONDecodeError):
+            status["race_reports"].append({"path": path})
 
     # -- serving fleet (the router's per-replica JSONL trail) ----------------
     fleet_trail = os.path.join(logging_dir, "router", "replicas.jsonl")
@@ -465,6 +484,13 @@ def render_status(status: dict[str, Any]) -> str:
             f"  !! HANG host {r.get('host')}: stalled in "
             f"{r.get('stalled_phase') or '?'} after {_fmt(r.get('elapsed_s'), '{:.0f}')}s "
             f"— {r['path']}"
+        )
+    for r in status.get("race_reports") or []:
+        cycle = " -> ".join(r.get("cycle") or []) or "?"
+        lines.append(
+            f"  !! RACE host {r.get('host')}: lock-order inversion "
+            f"({r.get('acquiring') or '?'} acquired while holding "
+            f"{r.get('while_holding') or '?'}; cycle {cycle}) — {r['path']}"
         )
     for d in status.get("collective_divergence") or []:
         per_host = "  ".join(

@@ -199,13 +199,11 @@ class MetricsRegistry:
     def _family(self, name: str, kind: str, help: str,
                 buckets: tuple[float, ...] | None = None) -> Metric:
         full = f"{self.namespace}_{name}" if self.namespace else name
-        metric = self._metrics.get(full)
-        if metric is None:
-            with self._lock:
-                metric = self._metrics.get(full)
-                if metric is None:
-                    metric = Metric(full, kind, help, self._lock, buckets)
-                    self._metrics[full] = metric
+        with self._lock:
+            metric = self._metrics.get(full)
+            if metric is None:
+                metric = Metric(full, kind, help, self._lock, buckets)
+                self._metrics[full] = metric
         if metric.kind != kind:
             raise ValueError(
                 f"metric {full} already registered as {metric.kind}, not {kind}"

@@ -167,8 +167,10 @@ class ChaosInjector:
         ]
         # fold the replica id into the seed: replicas sharing a spec draw
         # distinct but reproducible jitter streams
+        from ..analysis.lockwatch import maybe_watch
+
         self._rng = random.Random(int(seed) * 1_000_003 + (replica_id or 0))
-        self._lock = threading.Lock()
+        self._lock = maybe_watch(threading.Lock(), "ChaosInjector._lock")
         self._requests = 0
         self._kills = {f.at_request for f in mine if f.kind == "kill"}
         self._stops = {f.at_request: f.arg for f in mine if f.kind == "stop"}

@@ -59,6 +59,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..analysis.lockwatch import get_active_lockwatch, maybe_watch
 from ..logging import get_logger
 from .replica import ReplicaError, ReplicaHandle, ReplicaTimeout
 
@@ -167,8 +168,16 @@ class Router:
         self.supervisor = supervisor
         self.max_queue_depth = max_queue_depth
         self._queue: deque[Ticket] = deque()
-        self._lock = threading.Lock()
+        # LockWatch (ACCELERATE_SANITIZE=1) wraps the fleet's locks in
+        # order-graph shims; disabled, maybe_watch hands the raw lock back
+        self._lock = maybe_watch(threading.Lock(), "Router._lock", logging_dir)
         self._work = threading.Condition(self._lock)
+        # leaf lock serializing fleet-trail writes: the health tick and
+        # _mark_dead both flush rows, and two threads interleaving write()
+        # calls on one buffered file tear JSONL lines mid-row
+        self._trail_lock = maybe_watch(
+            threading.Lock(), "Router._trail_lock", logging_dir
+        )
         self._sessions: dict = {}  # session_id -> replica_id
         # tickets currently POSTed to each replica: _mark_dead requeues these
         # (a wedged-but-alive replica never produces the transport error the
@@ -556,6 +565,12 @@ class Router:
 
     def _mark_dead(self, replica: ReplicaHandle):
         with self._lock:
+            if self._health_paused:
+                # drain/close owns the fleet now: its SIGTERM exits are
+                # expected, and a death verdict racing the teardown would
+                # kill a replica that is busy answering its last in-flight
+                # requests (found while race-checking the drain path)
+                return
             if replica.state == "dead":
                 return
             replica.state = "dead"
@@ -599,12 +614,18 @@ class Router:
         if self.supervisor is not None:
             self.supervisor.notify_death(replica)
 
+    def _teardown_started(self) -> bool:
+        """True once drain/close owns the fleet's states (written and read
+        under the lock — race-check RC001 guards it like the rest)."""
+        with self._lock:
+            return self._health_paused
+
     def _probe_one(self, replica: ReplicaHandle):
         """One replica's health-tick logic (runs on its own probe thread —
         a sweep must not serialize N probe timeouts, or the fleet trail
         goes stale and monitor reads healthy replicas as dead)."""
         r = replica
-        if self._health_paused or self._stopped.is_set():
+        if self._teardown_started() or self._stopped.is_set():
             return  # drain/close started mid-sweep: its exits are expected
         if r.state in ("dead", "terminated"):
             if r.process is None and r.check_health() is not None:
@@ -617,8 +638,7 @@ class Router:
                 r.state = "terminated"
             return
         if r.process_exited():
-            if not self._health_paused:
-                self._mark_dead(r)
+            self._mark_dead(r)  # stands down on its own once teardown owns us
         elif r.check_health(timeout=5.0) is None:
             if r.state == "starting" and r.process is not None:
                 # bring-up: the HTTP server may not even be bound
@@ -635,32 +655,40 @@ class Router:
             # process to ask: three strikes is all the signal there is.
             r.consecutive_failures += 1
             strikes = 3 if r.process is None else 10
-            if r.consecutive_failures >= strikes and not self._health_paused:
+            if r.consecutive_failures >= strikes:
                 self._mark_dead(r)
 
     def _health_loop(self):
         while not self._stopped.wait(self.health_interval):
+            self._health_sweep()
+
+    def _health_sweep(self):
+        """One probe sweep over a lock-held snapshot of the fleet. The
+        supervisor appends/replaces replicas under the lock at runtime
+        (respawn, scale-up) — iterating the live list lock-free here raced
+        those edits (race-check RC001 finding, fixed)."""
+        with self._lock:
             if self._health_paused:
                 # drain is SIGTERM-ing replicas: their exits are *expected*
                 # and must land as `terminated`, not `dead`
-                continue
-            probes = [
-                threading.Thread(
-                    target=self._probe_one, args=(r,),
-                    name=f"router-probe-{r.replica_id}", daemon=True,
-                )
-                for r in self.replicas
-            ]
-            for t in probes:
-                t.start()
-            for t in probes:
-                t.join(timeout=6.0)
-            if not self._health_paused:
-                self._write_fleet_rows()
+                return
+            fleet = list(self.replicas)
+        probes = [
+            threading.Thread(
+                target=self._probe_one, args=(r,),
+                name=f"router-probe-{r.replica_id}", daemon=True,
+            )
+            for r in fleet
+        ]
+        for t in probes:
+            t.start()
+        for t in probes:
+            t.join(timeout=6.0)
+        if not self._teardown_started():
+            self._write_fleet_rows()
 
     def _write_fleet_rows(self):
-        trail = self._trail  # local ref: _shutdown may null the attribute
-        if trail is None:
+        if self.logging_dir is None:  # no trail configured at all
             return
         now = time.time()
         with self._lock:
@@ -716,12 +744,23 @@ class Router:
         # totals lead the tick: readers tailing "the newest replica row"
         # (monitor, tests) keep seeing a replica row last
         rows.insert(0, totals)
-        try:
-            for row in rows:
-                trail.write(json.dumps(row) + "\n")
-            trail.flush()
-        except (OSError, ValueError):
-            pass
+        # _trail_lock is a leaf lock whose entire purpose is this file:
+        # the health tick and _mark_dead both land here, and unsynchronized
+        # write() calls from two threads tear JSONL rows mid-line (the
+        # trail is the monitor's only view of the fleet). Nothing else is
+        # ever acquired under it. The dispatch lock stays released — a
+        # slow disk still never stalls admission/dispatch/delivery.
+        with self._trail_lock:
+            trail = self._trail  # _shutdown nulls it under this same lock
+            if trail is None:
+                return
+            try:
+                for row in rows:
+                    # tpu-lint: ignore[RC003] — serializing this file IS the lock's job; leaf lock, nothing acquired under it
+                    trail.write(json.dumps(row) + "\n")
+                trail.flush()  # tpu-lint: ignore[RC003] — same leaf-lock rationale
+            except (OSError, ValueError):
+                pass
 
     # -- drain / shutdown ----------------------------------------------------
 
@@ -755,16 +794,20 @@ class Router:
         # `terminated`, not `dead`.
         if self.supervisor is not None:
             self.supervisor.stop()
-        self._health_paused = True
-        for r in self.replicas:
+        with self._lock:
+            # under the lock: a _mark_dead racing the teardown must see the
+            # flag (and stand down) or finish first — never interleave
+            self._health_paused = True
+            fleet = list(self.replicas)
+        for r in fleet:
             if r.state not in ("dead", "terminated"):
                 r.state = "draining"
         self._write_fleet_rows()
-        for r in self.replicas:
+        for r in fleet:
             r.drain()
         clean = True
         deadline = time.monotonic() + timeout
-        for r in self.replicas:
+        for r in fleet:
             if r.state == "dead":
                 continue
             if r.process is None:
@@ -791,12 +834,14 @@ class Router:
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=10.0)
-        if self._trail is not None:
-            try:
-                self._trail.close()
-            except OSError:
-                pass
-            self._trail = None
+        with self._trail_lock:
+            if self._trail is not None:
+                try:
+                    self._trail.close()
+                except OSError:
+                    pass
+                self._trail = None
+        get_active_lockwatch().flush()  # hold-time histograms → telemetry
 
     def close(self):
         """Abrupt teardown (tests, error paths): kill what we spawned."""
@@ -804,10 +849,12 @@ class Router:
             self.supervisor.stop()  # no respawns behind the kill loop
         self._stopped.set()
         with self._lock:
+            self._health_paused = True  # death verdicts stand down from here
+            fleet = list(self.replicas)
             self._work.notify_all()
-        for r in self.replicas:
+        for r in fleet:
             r.kill()
-        for r in self.replicas:
+        for r in fleet:
             r.wait(timeout=10.0)  # reap: a killed child must not linger
         self._shutdown()
 

@@ -40,6 +40,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..analysis.lockwatch import maybe_watch
 from ..logging import get_logger
 
 logger = get_logger(__name__)
@@ -102,7 +103,7 @@ class ReplicaSupervisor:
         self.cfg = config or SupervisorConfig()
         self._rng = random.Random(self.cfg.seed)
         self._router = None
-        self._lock = threading.Lock()
+        self._lock = maybe_watch(threading.Lock(), "ReplicaSupervisor._lock")
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         #: replica_id -> {"deaths", "restarts", "quarantined", "backoff_s",
@@ -120,11 +121,17 @@ class ReplicaSupervisor:
 
     def bind(self, router) -> None:
         """Attach to a router (the router calls this from ``__init__``)
-        and start the supervision thread."""
+        and start the supervision thread. Locked even though the thread
+        starts below: ``bind`` is reachable from any caller's thread, and
+        race-check holds every ``_meta``/``replicas`` touch to the same
+        discipline."""
         self._router = router
         now = time.monotonic()
-        for r in router.replicas:
-            self._meta[r.replica_id] = self._fresh_meta(now)
+        with router._lock:
+            fleet = list(router.replicas)
+        with self._lock:
+            for r in fleet:
+                self._meta[r.replica_id] = self._fresh_meta(now)
         self._thread = threading.Thread(
             target=self._loop, name="replica-supervisor", daemon=True
         )
@@ -244,7 +251,7 @@ class ReplicaSupervisor:
     def _loop(self) -> None:
         while not self._stopped.wait(0.05):
             router = self._router
-            if router is None or router._health_paused:
+            if router is None or router._teardown_started():
                 continue  # teardown owns the fleet now
             try:
                 self._respawn_due()
@@ -315,8 +322,12 @@ class ReplicaSupervisor:
         router = self._router
         now = time.monotonic()
         stuck = []
+        # snapshot the fleet under ITS lock (never nested inside ours:
+        # sequential acquisition keeps the order graph acyclic)
+        with router._lock:
+            fleet = list(router.replicas)
         with self._lock:
-            for r in list(router.replicas):
+            for r in fleet:
                 meta = self._meta.get(r.replica_id)
                 if (
                     meta is not None

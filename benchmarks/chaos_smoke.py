@@ -135,60 +135,86 @@ def _assert_no_orphans(pids, timeout=15.0):
 def run(platform: str = "cpu", n_requests: int = 16) -> dict:
     result: dict = {"n_requests": n_requests, "chaos_spec": CHAOS_SPEC}
 
-    # -- leg 1: clean supervised fleet (the baseline goodput) --------------
-    with tempfile.TemporaryDirectory() as logdir:
-        router, pids = _spawn_fleet(MIN_REPLICAS, logdir, supervised=True)
-        try:
-            deliveries, clean_wall, clean_tokens = _run_trace(router, n_requests)
-            errors = [r for r in deliveries if "error" in r]
-            assert not errors, f"clean leg errored: {errors}"
-            assert router.drain(timeout=120), "clean drain failed"
-        finally:
-            router.close()
-        _assert_no_orphans(pids)
+    # LockWatch rides the whole run: the router/supervisor locks are
+    # wrapped in order-graph shims, and the seeded kill/503/delay schedule
+    # must complete with ZERO lock-order violations (the runtime half of
+    # `accelerate-tpu race-check`)
+    from accelerate_tpu.analysis.lockwatch import (
+        LockWatch,
+        get_active_lockwatch,
+        set_active_lockwatch,
+    )
 
-    # -- leg 2: identical trace under the seeded fault schedule ------------
-    with tempfile.TemporaryDirectory() as logdir:
-        router, pids = _spawn_fleet(
-            MIN_REPLICAS, logdir, chaos_spec=CHAOS_SPEC, supervised=True
-        )
-        try:
-            deliveries, fault_wall, fault_tokens = _run_trace(router, n_requests)
-            errors = [r for r in deliveries if "error" in r]
-            assert not errors, f"faults leaked as error rows: {errors}"
+    prior_watch = get_active_lockwatch()
+    watch = LockWatch(host="chaos_smoke")
+    set_active_lockwatch(watch)
 
-            # the fleet must RECOVER to the target count via respawn
-            deadline = time.monotonic() + 120
-            while time.monotonic() < deadline:
+    # the process-global watch must be restored even when a leg fails —
+    # a leaked armed watch would wrap every later lock in this process
+    try:
+        # -- leg 1: clean supervised fleet (the baseline goodput) --------------
+        with tempfile.TemporaryDirectory() as logdir:
+            router, pids = _spawn_fleet(MIN_REPLICAS, logdir, supervised=True)
+            try:
+                deliveries, clean_wall, clean_tokens = _run_trace(router, n_requests)
+                errors = [r for r in deliveries if "error" in r]
+                assert not errors, f"clean leg errored: {errors}"
+                assert router.drain(timeout=120), "clean drain failed"
+            finally:
+                router.close()
+            _assert_no_orphans(pids)
+
+        # -- leg 2: identical trace under the seeded fault schedule ------------
+        with tempfile.TemporaryDirectory() as logdir:
+            router, pids = _spawn_fleet(
+                MIN_REPLICAS, logdir, chaos_spec=CHAOS_SPEC, supervised=True
+            )
+            try:
+                deliveries, fault_wall, fault_tokens = _run_trace(router, n_requests)
+                errors = [r for r in deliveries if "error" in r]
+                assert not errors, f"faults leaked as error rows: {errors}"
+
+                # the fleet must RECOVER to the target count via respawn
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    stats = router.stats()
+                    if stats["ready"] >= MIN_REPLICAS:
+                        break
+                    time.sleep(0.25)
                 stats = router.stats()
-                if stats["ready"] >= MIN_REPLICAS:
-                    break
-                time.sleep(0.25)
-            stats = router.stats()
-            assert stats["ready"] >= MIN_REPLICAS, (
-                f"fleet never recovered: {stats['ready']}/{MIN_REPLICAS} ready"
-            )
-            assert stats["supervisor"]["respawns"] >= 1, (
-                "the kill never triggered a supervised respawn"
-            )
-            result["respawns"] = stats["supervisor"]["respawns"]
-            result["requeues"] = stats["requeues"]
-            result["recovery_ratio"] = stats["ready"] / MIN_REPLICAS
-            # crash-loop backoff is visible in the fleet trail
-            trail = os.path.join(logdir, "router", "replicas.jsonl")
-            rows = [json.loads(line) for line in open(trail) if line.strip()]
-            assert any(
-                r.get("replica_id") == 0 and r.get("backoff_s", 0) > 0
-                for r in rows
-            ), "backoff never reached the fleet trail"
-            assert any(
-                r.get("replica_id") == 0 and r.get("restarts", 0) >= 1
-                for r in rows
-            ), "restart count never reached the fleet trail"
-            assert router.drain(timeout=120), "post-chaos drain failed"
-        finally:
-            router.close()
-        _assert_no_orphans(pids)
+                assert stats["ready"] >= MIN_REPLICAS, (
+                    f"fleet never recovered: {stats['ready']}/{MIN_REPLICAS} ready"
+                )
+                assert stats["supervisor"]["respawns"] >= 1, (
+                    "the kill never triggered a supervised respawn"
+                )
+                result["respawns"] = stats["supervisor"]["respawns"]
+                result["requeues"] = stats["requeues"]
+                result["recovery_ratio"] = stats["ready"] / MIN_REPLICAS
+                # crash-loop backoff is visible in the fleet trail
+                trail = os.path.join(logdir, "router", "replicas.jsonl")
+                rows = [json.loads(line) for line in open(trail) if line.strip()]
+                assert any(
+                    r.get("replica_id") == 0 and r.get("backoff_s", 0) > 0
+                    for r in rows
+                ), "backoff never reached the fleet trail"
+                assert any(
+                    r.get("replica_id") == 0 and r.get("restarts", 0) >= 1
+                    for r in rows
+                ), "restart count never reached the fleet trail"
+                assert router.drain(timeout=120), "post-chaos drain failed"
+            finally:
+                router.close()
+            _assert_no_orphans(pids)
+    finally:
+        set_active_lockwatch(prior_watch)
+
+    assert watch.violations == 0, (
+        f"LockWatch saw {watch.violations} lock-order violation(s) under "
+        f"chaos: {watch.report()['reports']}"
+    )
+    result["lock_order_violations"] = watch.violations
+    result["locks_watched"] = sorted(watch.hold_histograms())
 
     result["clean_tok_s"] = clean_tokens / clean_wall if clean_wall > 0 else 0.0
     result["fault_tok_s"] = fault_tokens / fault_wall if fault_wall > 0 else 0.0
@@ -205,7 +231,8 @@ def main() -> int:
         f"chaos-smoke OK: {r['n_requests']} + {r['n_requests']} requests under "
         f"'{r['chaos_spec']}' — exactly-once delivery, zero orphans, "
         f"{r['respawns']} respawn(s), recovery {r['recovery_ratio']:.0%} of "
-        f"target fleet\n"
+        f"target fleet, {r['lock_order_violations']} lock-order violation(s) "
+        f"with LockWatch armed on {len(r['locks_watched'])} lock(s)\n"
         f"  goodput under faults {r['fault_tok_s']:.1f} tok/s vs clean "
         f"{r['clean_tok_s']:.1f} tok/s -> chaos_goodput_ratio "
         f"{r['chaos_goodput_ratio']:.2f} ({r['requeues']} requeue(s); CPU "

@@ -686,3 +686,122 @@ def test_serve_healthz_state_machine(monkeypatch):
         stop.set()
         t.join(timeout=30)
         assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# race-check regression pins (PR 12): each of these is a 2-thread proof of a
+# concurrency defect the static pass surfaced in the PR 11 router/supervisor
+# ---------------------------------------------------------------------------
+
+
+class _InterleaveDetectingTrail:
+    """File double whose write() detects a second thread entering while one
+    is mid-write — exactly the torn-JSONL hazard on the real fleet trail
+    (two threads interleaving write() calls on one buffered file)."""
+
+    def __init__(self):
+        self.concurrent_entries = 0
+        self.lines = []
+        self._busy = False
+
+    def write(self, text):
+        if self._busy:
+            self.concurrent_entries += 1
+        self._busy = True
+        time.sleep(0.001)  # widen the interleave window deterministically
+        self.lines.append(text)
+        self._busy = False
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_fleet_trail_writes_serialized_across_threads(tmp_path):
+    """The health tick and _mark_dead both flush fleet rows; without the
+    trail leaf-lock two threads interleave write() calls and tear rows.
+    (race-check drove the _trail_lock; this pins the behaviour.)"""
+    r0 = StubReplica(0)
+    router = _router([r0], logging_dir=str(tmp_path))
+    trail = _InterleaveDetectingTrail()
+    try:
+        with router._trail_lock:
+            router._trail.close()
+            router._trail = trail
+        threads = [
+            threading.Thread(
+                target=lambda: [router._write_fleet_rows() for _ in range(20)],
+                daemon=True,
+            )
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert trail.concurrent_entries == 0, (
+            f"{trail.concurrent_entries} concurrent write() entries — "
+            "fleet-trail rows can tear mid-line"
+        )
+        assert len(trail.lines) == 2 * 20 * 2  # totals row + one replica row
+        for line in trail.lines:
+            json.loads(line)  # every row is intact JSON
+    finally:
+        router.close()
+
+
+def test_mark_dead_stands_down_once_teardown_owns_the_fleet(tmp_path):
+    """drain() SIGTERMs replicas whose exits are EXPECTED; a health probe
+    racing it used to mark the exiting replica dead and SIGKILL it while
+    it answered its last in-flight requests. _mark_dead now checks the
+    teardown flag under the lock and stands down."""
+    r0 = StubReplica(0)
+    router = _router([r0], logging_dir=str(tmp_path))
+    try:
+        with router._lock:
+            router._health_paused = True  # the drain path sets this under the lock
+        t = threading.Thread(target=router._mark_dead, args=(r0,), daemon=True)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert r0.state == "ready", "death verdict raced the teardown"
+    finally:
+        router.close()
+
+
+def test_health_sweep_survives_concurrent_fleet_edits(tmp_path):
+    """The supervisor appends (scale-up) and replaces (respawn) replicas
+    under the router lock at runtime; the sweep used to iterate the live
+    list lock-free. It now probes a lock-held snapshot: edits landing
+    mid-sweep neither crash it nor leak into this sweep's probe set."""
+    r0, r1 = StubReplica(0), StubReplica(1)
+    router = _router([r0, r1], logging_dir=str(tmp_path))
+    probed = []
+    entered = threading.Event()
+    release = threading.Event()
+    orig_probe = router._probe_one
+
+    def slow_probe(r):
+        probed.append(r)
+        entered.set()
+        release.wait(timeout=30)
+        orig_probe(r)
+
+    try:
+        router._probe_one = slow_probe
+        sweep = threading.Thread(target=router._health_sweep, daemon=True)
+        sweep.start()
+        assert entered.wait(timeout=30)
+        with router._lock:  # supervisor-style mid-sweep edits
+            router.replicas.append(StubReplica(2))
+            router.replicas[0] = StubReplica(0)
+        release.set()
+        sweep.join(timeout=60)
+        assert not sweep.is_alive()
+        # the sweep probed its snapshot: the originals, not the mid-sweep edits
+        assert set(probed) == {r0, r1}
+    finally:
+        release.set()
+        router.close()
