@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -56,3 +56,6 @@ chaos-smoke:      ## seeded kill -9 / 503 / delay schedule vs a supervised fleet
 
 race-smoke:       ## concurrency gate: clean tree race-checks 0/0, seeded lock inversion exits 2 naming RC002, chaos fleet runs with LockWatch armed -> zero order violations
 	python benchmarks/race_smoke.py
+
+spec-smoke:       ## speculative serving: spec-on vs spec-off interleaved legs on the identical trace -> TPOT ratio < 1 at the achieved accept rate, goodput no-regress, one decode executable per leg, token parity
+	python benchmarks/spec_smoke.py

@@ -761,10 +761,14 @@ def analyze_plan(
     hbm_gb: float | None = None,
     swap_gb: float | None = None,
     replicated_threshold_bytes: int = 16 << 20,
+    draft_layers: int | None = None,
+    stacked_prefix: str = "layers",
 ) -> PlanReport:
     """The full static pre-flight: tiers (params, optimizer state, grads,
-    paged KV pool, activation estimate) per device, plus SP001-SP004
-    findings.
+    paged KV pool, the speculative ``draft_params`` tier when
+    ``draft_layers`` is set, activation estimate) per device, plus
+    SP001-SP004 findings (SP004's breakdown names every tier, the draft
+    included).
 
     ``params`` may be concrete or abstract (``jax.eval_shape`` output);
     ``mesh`` is an axis-size map (from a real Mesh via
@@ -793,6 +797,13 @@ def analyze_plan(
             for l in leaves
             if l.tier == "params"
         ]
+    if draft_layers:
+        # appended AFTER the optimizer mirror: plan_opt_state unflattens
+        # the params-tier list against the params treedef, which a mixed
+        # list would break
+        leaves += plan_draft_params(
+            params, sizes, rules, draft_layers, stacked_prefix=stacked_prefix
+        )
     if kv_pool:
         leaves += plan_kv_pool(mesh_sizes=sizes, **kv_pool)
     host = None
@@ -963,6 +974,45 @@ def manifest_findings(manifest: dict, param_plans: list[LeafPlan]) -> list[PlanF
 # ---------------------------------------------------------------------------
 
 
+def plan_draft_params(
+    params,
+    mesh_sizes: dict[str, int],
+    rules,
+    draft_layers: int,
+    stacked_prefix: str = "layers",
+) -> list["LeafPlan"]:
+    """The speculative-decoding ``draft_params`` tier: the first
+    ``draft_layers`` entries of the layer-stacked parameter leaves — what
+    an ``early_exit:N`` draft costs. The engine slices these **in-trace**
+    (no persistent copy), but the compiled spec executable still
+    materialises the slice as a transient buffer, so the pre-flight prices
+    it conservatively as a resident tier under the same partition rules as
+    the full stack (the slice inherits the leaves' sharding)."""
+    import jax
+
+    stacked = params.get(stacked_prefix) if isinstance(params, dict) else None
+    if stacked is None:
+        raise ValueError(
+            f"draft tier needs layer-stacked params under {stacked_prefix!r}"
+        )
+    draft_tree = {
+        stacked_prefix: jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                (draft_layers, *tuple(a.shape)[1:]), a.dtype
+            ),
+            stacked,
+        )
+    }
+    leaves = plan_params(draft_tree, mesh_sizes, rules=rules, tier="draft_params")
+    # the tier rides the same rules as params, so rule usage accounting
+    # must not double-claim: SP001 dead-rule detection reads params leaves
+    # only (plan_findings filters by tier), and these leaves are renamed so
+    # a report never shows two identical paths in different tiers
+    for leaf in leaves:
+        leaf.path = "draft." + leaf.path
+    return leaves
+
+
 def engine_preflight(
     params,
     rules,
@@ -971,6 +1021,8 @@ def engine_preflight(
     pool_dtype,
     hbm_budget_gb: float,
     swap_gb: float | None = None,
+    draft_layers: int | None = None,
+    stacked_prefix: str = "layers",
 ) -> dict:
     """The serving engine's capacity check, run BEFORE the pools allocate:
     predicted per-device bytes of params (under the same planner
@@ -982,10 +1034,20 @@ def engine_preflight(
     set, ``swap_pool_host_bytes`` reports the host-DRAM swap tier's
     footprint alongside — deliberately *excluded* from ``total_bytes`` (a
     swapped block lives in host memory, not HBM), so the HBM pre-flight
-    stays truthful with swap on."""
+    stays truthful with swap on. ``draft_layers`` (speculative decoding
+    armed) adds the ``draft_params`` tier — :func:`plan_draft_params` —
+    into ``total_bytes`` and reports it as ``draft_bytes``."""
     sizes = mesh_sizes_of(mesh) if mesh is not None else {ax: 1 for ax in MESH_AXES}
     param_plans = plan_params(params, sizes, rules=rules)
     params_bytes = sum(p.bytes_per_device for p in param_plans)
+    draft_bytes = 0
+    if draft_layers:
+        draft_bytes = sum(
+            p.bytes_per_device
+            for p in plan_draft_params(
+                params, sizes, rules, draft_layers, stacked_prefix=stacked_prefix
+            )
+        )
     pool_plans = plan_kv_pool(
         num_layers=pool_shape[0],
         num_blocks=pool_shape[1],
@@ -999,7 +1061,7 @@ def engine_preflight(
     )
     pool_bytes = sum(p.bytes_per_device for p in pool_plans)
     budget = int(hbm_budget_gb * (1 << 30))
-    total = params_bytes + pool_bytes
+    total = params_bytes + draft_bytes + pool_bytes
     report = {
         "params_bytes": params_bytes,
         "pool_bytes": pool_bytes,
@@ -1008,6 +1070,9 @@ def engine_preflight(
         "headroom_bytes": budget - total,
         "over": total > budget,
     }
+    if draft_layers:
+        report["draft_bytes"] = draft_bytes
+        report["draft_layers"] = int(draft_layers)
     if swap_gb:
         report["swap_pool_host_bytes"] = plan_swap_pool(
             num_layers=pool_shape[0],

@@ -35,6 +35,7 @@ _ENGINE_FLAGS = (
     ("--max-new-tokens", "max_new_tokens"), ("--eos-token-id", "eos_token_id"),
     ("--temperature", "temperature"), ("--seed", "seed"),
     ("--kv-dtype", "kv_dtype"), ("--chaos-spec", "chaos_spec"),
+    ("--spec-k", "spec_k"), ("--draft", "draft"),
 )
 
 
@@ -304,6 +305,14 @@ def add_parser(subparsers):
                    help="forwarded to every replica's serve --kv-dtype "
                    "(replicas must store KV identically for dispatch to "
                    "treat them as interchangeable)")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="forwarded to every replica's serve --spec-k "
+                   "(speculative decoding; the fleet must decode "
+                   "identically for dispatch to treat replicas as "
+                   "interchangeable)")
+    p.add_argument("--draft", default=None,
+                   help="forwarded to every replica's serve --draft "
+                   "(e.g. early_exit:2)")
     p.add_argument("--mesh", action="store_true",
                    help="each replica shards its engine over the attached mesh "
                    "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")

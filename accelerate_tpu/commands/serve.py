@@ -232,6 +232,8 @@ def _make_engine(args):
             prefix_cache=args.prefix_cache,
             swap_gb=args.swap_gb,
             kv_dtype=args.kv_dtype,
+            spec_k=args.spec_k,
+            draft=args.draft,
         ),
         mesh=mesh,
     )
@@ -665,6 +667,30 @@ def add_parser(subparsers):
                    "compute dtype; env ACCELERATE_SERVE_KV_DTYPE): int8/fp8 "
                    "quantize on scatter with per-row amax scales — half the "
                    "decode bytes, ~2x the slot capacity at equal --hbm-gb")
+    try:
+        spec_k_default = int(os.environ.get("ACCELERATE_SERVE_SPEC_K", "0") or 0)
+    except ValueError:
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_SPEC_K="
+            f"{os.environ['ACCELERATE_SERVE_SPEC_K']!r} (want an integer)",
+            file=sys.stderr,
+        )
+        spec_k_default = 0
+    p.add_argument("--spec-k", type=int, default=spec_k_default,
+                   help="speculative decoding: draft this many tokens per "
+                   "slot per round and verify them in ONE [num_slots, k+1] "
+                   "compiled forward (default 0 = off; env "
+                   "ACCELERATE_SERVE_SPEC_K). Greedy only — output stays "
+                   "token-identical to the non-speculative engine; a bad "
+                   "spec/draft combination is a startup refusal (error row, "
+                   "exit 2)")
+    p.add_argument("--draft", default=os.environ.get(
+                       "ACCELERATE_SERVE_DRAFT", "early_exit:2"),
+                   help="draft policy when --spec-k > 0 (env "
+                   "ACCELERATE_SERVE_DRAFT): 'early_exit:N' runs the "
+                   "target's own first N layers as the draft, sharing the "
+                   "target's paged pool — no second cache, no extra "
+                   "weights resident")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
                    help="enable sampling at this temperature (default: greedy)")

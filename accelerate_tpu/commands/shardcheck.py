@@ -151,6 +151,20 @@ def shard_check_command(args) -> int:
             num_blocks=args.num_blocks,
             dtype=kv_dtype,
         )
+    draft_layers = None
+    if args.spec_k:
+        # the serving engine's speculative-decoding draft tier: parse with
+        # the SAME parser EngineConfig uses, so shard-check refuses exactly
+        # the drafts the engine would refuse at bring-up
+        from ..serving.spec import parse_draft_spec
+
+        try:
+            draft_layers = parse_draft_spec(
+                args.draft, config.num_hidden_layers
+            ).layers
+        except ValueError as e:
+            print(f"shard-check: {e}", file=sys.stderr)
+            return 1
     activations = None
     include_grads = False
     if args.batch:
@@ -178,6 +192,7 @@ def shard_check_command(args) -> int:
             hbm_gb=args.hbm_gb,
             swap_gb=args.swap_gb,
             replicated_threshold_bytes=int(args.replicated_threshold_mb * (1 << 20)),
+            draft_layers=draft_layers,
         )
     except ValueError as e:
         print(f"shard-check: {e}", file=sys.stderr)
@@ -292,6 +307,14 @@ def add_parser(subparsers):
                    help="KV pool storage policy (EngineConfig(kv_dtype=...)): "
                    "int8/fp8 price the quantized payload + f32 amax scale "
                    "arrays; auto follows --dtype")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding armed (EngineConfig(spec_k=...)): "
+                   "adds the draft_params tier — the early-exit draft's "
+                   "sliced layer stack — to the plan and the SP004 budget "
+                   "breakdown, matching the engine's --hbm-gb pre-flight")
+    p.add_argument("--draft", default="early_exit:2",
+                   help="draft policy priced when --spec-k > 0 "
+                   "(EngineConfig(draft=...), e.g. 'early_exit:2')")
     p.add_argument("--swap-gb", type=float, default=None,
                    help="serving KV swap tier (EngineConfig(swap_gb=...)): "
                    "report its host-DRAM footprint alongside the HBM tiers "

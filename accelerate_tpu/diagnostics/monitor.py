@@ -231,6 +231,13 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "kv_dtype": last_step.get("kv_dtype"),
             "kv_bytes_per_token": last_step.get("kv_bytes_per_token"),
             "kv_slot_capacity": last_step.get("kv_slot_capacity"),
+            # speculative decoding (cumulative step-row counters + the
+            # accept-rate gauge — absent entirely when spec is off)
+            "spec_k": last_step.get("spec_k"),
+            "spec_draft": last_step.get("spec_draft"),
+            "spec_accept_rate": last_step.get("spec_accept_rate"),
+            "spec_drafted_tokens": last_step.get("spec_drafted_tokens"),
+            "spec_accepted_tokens": last_step.get("spec_accepted_tokens"),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -385,6 +392,13 @@ def render_status(status: dict[str, Any]) -> str:
                 f"  kv cache: {srv['kv_dtype']}   "
                 f"{_fmt(srv.get('kv_bytes_per_token'), '{:.0f}')} B/token   "
                 f"slot capacity {_fmt(srv.get('kv_slot_capacity'), '{}')}"
+            )
+        if srv.get("spec_k"):
+            lines.append(
+                f"  spec: k={srv['spec_k']} ({srv.get('spec_draft') or '?'})   "
+                f"accept {_fmt(srv.get('spec_accept_rate'), '{:.0%}')}   "
+                f"drafted {_fmt(srv.get('spec_drafted_tokens'), '{}')}   "
+                f"accepted {_fmt(srv.get('spec_accepted_tokens'), '{}')}"
             )
         if srv.get("prefix_hit_ratio") is not None or srv.get("preemptions"):
             lines.append(

@@ -488,6 +488,40 @@ def _generate_cached(
     return buf[:, : int(lengths.max())]
 
 
+def spec_accept_tokens(d, preds):
+    """Greedy speculative acceptance — the SINGLE source for the
+    accept/emit token math, shared by the batch ``generate()`` spec loop
+    (:func:`_spec_loop_for`) and the serving engine's compiled spec-decode
+    step (``serving/engine.py``). Change it in one place or the two paths'
+    acceptance semantics diverge.
+
+    ``d`` ``[b, k]`` are the draft's proposed tokens; ``preds`` ``[b, k+1]``
+    the target's greedy picks at each position of the verify chunk
+    ``[pending, d_1 .. d_k]``. Returns ``(accept, tok_seq)``:
+
+    * ``accept`` ``[b]`` int32 in ``0..k`` — the longest prefix of ``d``
+      agreeing with the target's own greedy choices;
+    * ``tok_seq`` ``[b, k+1]`` int32 — the round's emittable tokens: the
+      accepted draft prefix, then the target's correction at index
+      ``accept``, zeros after (callers emit ``tok_seq[:, : accept + 1]``).
+
+    Greedy acceptance is exact for ANY draft: every emitted token equals
+    what plain greedy decoding of the target would have produced, so the
+    draft only changes how many target forwards a sequence costs."""
+    b, k = d.shape
+    match = preds[:, :k] == d
+    accept = jnp.where(
+        match.all(axis=1), k, jnp.argmin(match, axis=1)
+    ).astype(jnp.int32)  # [b]
+    j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    corr = jnp.take_along_axis(preds, accept[:, None], axis=1)  # [b, 1]
+    d_ext = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    tok_seq = jnp.where(
+        j < accept[:, None], d_ext, jnp.where(j == accept[:, None], corr, 0)
+    )
+    return accept, tok_seq
+
+
 def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool):
     """The WHOLE speculative loop as one compiled program — draft scan,
     feed-only push of the last draft token (so the draft cache never
@@ -545,17 +579,11 @@ def _spec_loop_for(apply_fn, draft_apply, cache_len: int, k: int, has_eos: bool)
             kv_t = out_t["kv_cache"]
             preds = jnp.argmax(out_t["logits"], axis=-1).astype(jnp.int32)  # [b, k+1]
 
-            # greedy accept: longest agreeing prefix + the target's own token
-            match = preds[:, :k] == d
-            accept = jnp.where(
-                match.all(axis=1), k, jnp.argmin(match, axis=1)
-            ).astype(jnp.int32)  # [b]
+            # greedy accept: longest agreeing prefix + the target's own
+            # token — the shared helper (also the serving engine's rule)
+            accept, tok_seq = spec_accept_tokens(d, preds)
             j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
-            corr = jnp.take_along_axis(preds, accept[:, None], axis=1)  # [b, 1]
-            d_ext = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
-            tok_seq = jnp.where(
-                j < accept[:, None], d_ext, jnp.where(j == accept[:, None], corr, 0)
-            )
+            corr = jnp.take_along_axis(tok_seq, accept[:, None], axis=1)  # [b, 1]
 
             # emit semantics identical to the sequential rule: skip finished
             # rows, cut a run at its first eos, cap at the token budget

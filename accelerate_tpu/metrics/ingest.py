@@ -138,6 +138,20 @@ _KV_GAUGES = (
     ("kv_slot_capacity", "serving_kv_slot_capacity",
      "Max-length requests the paged pool holds concurrently"),
 )
+#: speculative-decoding counters/gauge — the accept rate is serving's TPOT
+#: lever (each spec round costs one dispatch and emits accept+1 tokens);
+#: counters render with the OpenMetrics ``_total`` suffix, giving the
+#: documented ``serving_spec_{drafted,accepted}_tokens_total``
+_SPEC_COUNTERS = (
+    ("spec_drafted_tokens", "serving_spec_drafted_tokens",
+     "Draft tokens proposed by the speculative decoder"),
+    ("spec_accepted_tokens", "serving_spec_accepted_tokens",
+     "Draft tokens the verify forward accepted (greedy agreeing prefix)"),
+)
+_SPEC_ACCEPT_GAUGE = (
+    "spec_accept_rate", "serving_spec_accept_rate",
+    "Accepted / drafted speculative tokens (0-1, run-cumulative)",
+)
 
 
 def _observe_serving(registry, record: dict) -> None:
@@ -169,6 +183,7 @@ def _observe_serving(registry, record: dict) -> None:
             ("free_blocks", "serving_free_blocks", "Free KV-cache blocks"),
             _PREFIX_HIT_GAUGE,
             *_KV_GAUGES,
+            _SPEC_ACCEPT_GAUGE,
         ):
             if _num(record.get(field)) is not None:
                 registry.gauge(name, help).set(record[field])
@@ -177,6 +192,7 @@ def _observe_serving(registry, record: dict) -> None:
             ("completed_total", "serving_completed",
              "Engine-reported completed requests (cumulative)"),
             *_SHARING_COUNTERS,
+            *_SPEC_COUNTERS,
         ):
             if _num(record.get(field)) is not None:
                 registry.counter(name, help).set_total(record[field])
@@ -279,9 +295,9 @@ def observe_engine_stats(registry, stats: dict) -> None:
         registry.counter("serving_iterations", "Engine scheduler iterations").set_total(
             stats["iterations"]
         )
-    for field, name, help in (_PREFIX_HIT_GAUGE, *_KV_GAUGES):
+    for field, name, help in (_PREFIX_HIT_GAUGE, *_KV_GAUGES, _SPEC_ACCEPT_GAUGE):
         if _num(stats.get(field)) is not None:
             registry.gauge(name, help).set(stats[field])
-    for field, name, help in _SHARING_COUNTERS:
+    for field, name, help in (*_SHARING_COUNTERS, *_SPEC_COUNTERS):
         if _num(stats.get(field)) is not None:
             registry.counter(name, help).set_total(stats[field])

@@ -465,6 +465,46 @@ def _llama_paged_step(
     return ModelOutput(logits=logits, paged_kv=out_pages)
 
 
+def llama_early_exit_apply(config: LlamaConfig, draft_layers: int):
+    """Early-exit draft for speculative decoding: an apply fn running only
+    the target's first ``draft_layers`` transformer blocks, closed with the
+    target's own final norm + head — the cheapest draft that shares the
+    target's representation space (the bench ``spec`` mode's construction,
+    here as a reusable factory the serving engine arms via
+    ``EngineConfig(draft="early_exit:N")``).
+
+    The returned fn takes the FULL model's params and slices the stacked
+    layer leaves **in-trace** (``a[:draft_layers]``), so no persistent
+    draft copy of the weights exists — the slice is a transient buffer of
+    the compiled program (shard-check prices it as the ``draft_params``
+    tier). Because the draft's layers are byte-identical to the target's
+    prefix, its K/V at any cached position equal the target's for those
+    layers: the serving engine exploits this by pointing the draft at the
+    first ``draft_layers`` layers of the target's own paged pool — no
+    separate draft cache, and prefix sharing / CoW / swap maintain the
+    draft state for free."""
+    if not 1 <= draft_layers < config.num_hidden_layers:
+        raise ValueError(
+            f"early-exit draft needs 1 <= layers < {config.num_hidden_layers} "
+            f"(the target's depth), got {draft_layers}"
+        )
+    import dataclasses as _dc
+
+    draft_config = _dc.replace(config, num_hidden_layers=draft_layers)
+
+    def early_exit_apply(params, **kw):
+        draft_params = {
+            "embed_tokens": params["embed_tokens"],
+            "layers": jax.tree.map(lambda a: a[:draft_layers], params["layers"]),
+            "norm": params["norm"],
+        }
+        if "lm_head" in params:
+            draft_params["lm_head"] = params["lm_head"]
+        return llama_apply(draft_config, draft_params, **kw)
+
+    return early_exit_apply
+
+
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")
 
 
@@ -606,6 +646,10 @@ class LlamaForCausalLM:
         model.stacked_params_prefix = "layers"
         model.supports_kv_cache = True
         model.supports_paged_kv = True  # serving engine's block-paged decode
+        # speculative decoding's early-exit draft factory (EngineConfig(
+        # spec_k=..., draft="early_exit:N")): first-N-layers apply over the
+        # FULL params, sliced in-trace
+        model.early_exit_apply = lambda n: llama_early_exit_apply(config, n)
         model.convert_state_dict = lambda flat: convert_hf_llama_state_dict(flat, config)
         # tied embeddings are a single leaf in this functional design (no
         # separate lm_head param exists), so no tie group is declared

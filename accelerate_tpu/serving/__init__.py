@@ -16,11 +16,13 @@ from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .engine import EngineConfig, InferenceEngine
 from .radix import RadixCache, SwapPool
 from .scheduler import PRIORITY_CLASSES, Request, RequestState, SlotScheduler
+from .spec import DraftSpec, parse_draft_spec
 
 __all__ = [
     "NULL_BLOCK",
     "BlockAllocator",
     "blocks_needed",
+    "DraftSpec",
     "EngineConfig",
     "InferenceEngine",
     "PRIORITY_CLASSES",
@@ -29,4 +31,5 @@ __all__ = [
     "RequestState",
     "SlotScheduler",
     "SwapPool",
+    "parse_draft_spec",
 ]

@@ -16,11 +16,21 @@ vLLM-style block-paged cache):
 * KV memory is allocated in ``block_size``-token blocks from a freelist
   (:mod:`.blocks`) — padding waste is bounded by block granularity, and a
   finished short completion's blocks are serving a new request on the next
-  iteration.
+  iteration;
+* **speculative decoding** (``spec_k > 0``): each dispatch becomes one
+  compiled spec round — every active slot drafts ``k`` tokens from the
+  early-exit draft (the target's own first layers, sharing the target
+  pool's leading layers), ONE ``[num_slots, k+1]`` verify forward scores
+  all drafts through the fused paged kernel, and the longest agreeing
+  prefix + correction emit. Rollback is position bookkeeping only, so the
+  one-executable contract and greedy token parity both survive.
 
 Sampling/eos semantics reuse ``generation.py``'s traced pick helper
 (:func:`accelerate_tpu.generation._pick_traced`), so greedy engine output
-is token-for-token identical to ``generate(use_cache=True)``.
+is token-for-token identical to ``generate(use_cache=True)`` — and the
+spec round's acceptance reuses
+:func:`accelerate_tpu.generation.spec_accept_tokens`, so the spec-armed
+engine stays token-identical to the non-spec engine.
 """
 
 from __future__ import annotations
@@ -100,6 +110,27 @@ class EngineConfig:
     #: holds at every setting (scales are just two more donated pool
     #: operands of the same single executable).
     kv_dtype: str = "auto"
+    #: speculative decoding (0 = off, the plain burst decode). ``spec_k > 0``
+    #: replaces the decode step with ONE compiled spec round per dispatch:
+    #: every active slot drafts ``spec_k`` tokens from the cheap draft, a
+    #: single ``[num_slots, spec_k+1]`` verify forward scores all drafts
+    #: through the fused paged-attention kernel, and the longest agreeing
+    #: prefix + the target's correction are emitted (greedy acceptance is
+    #: exact — output stays token-identical to the non-spec engine).
+    #: Rejected drafts are rolled back purely by position bookkeeping: the
+    #: next round re-writes those pool rows and attention never reads past
+    #: each slot's valid prefix, so no pool edit beyond the normal scatter
+    #: happens at any kv_dtype. ``decode_burst`` is ignored while armed —
+    #: one spec round already amortises the host round trip over up to
+    #: ``spec_k + 1`` tokens. Greedy only: ``do_sample=True`` refuses.
+    spec_k: int = 0
+    #: draft policy when ``spec_k > 0`` (see :mod:`.spec`):
+    #: ``"early_exit:N"`` runs the target's own first N layers (+ its final
+    #: norm/head) as the draft, reading/writing the FIRST N LAYERS of the
+    #: target's paged pool — identical weights make the draft's K/V a
+    #: strict subset of the target's, so prefix sharing, copy-on-write and
+    #: swap preemption maintain the draft state with zero extra machinery.
+    draft: str = "early_exit:2"
 
     @property
     def blocks_per_slot(self) -> int:
@@ -144,6 +175,37 @@ class InferenceEngine:
             raise ValueError(
                 "prefill_chunk, block_size, num_slots, decode_burst must be >= 1"
             )
+
+        # speculative decoding (spec_k > 0): parse the draft policy and
+        # bind the early-exit draft apply BEFORE anything allocates — a bad
+        # spec must refuse at bring-up, like every other geometry error
+        self._spec = None
+        self._draft_apply = None
+        if cfg.spec_k:
+            if cfg.spec_k < 1:
+                raise ValueError("spec_k must be >= 1 (0 disables speculation)")
+            if cfg.do_sample:
+                raise ValueError(
+                    "speculative decoding is greedy-only (generation.py's "
+                    "rule): rejection sampling for do_sample=True is not "
+                    "implemented — disable sampling or set spec_k=0"
+                )
+            from .spec import parse_draft_spec
+
+            self._spec = parse_draft_spec(cfg.draft, mcfg.num_hidden_layers)
+            factory = getattr(inner, "early_exit_apply", None)
+            if factory is None:
+                raise ValueError(
+                    f"model {getattr(inner, 'name', type(inner).__name__)!r} "
+                    "declares no early_exit_apply factory: the spec_k engine "
+                    "needs the early-exit draft path (models/llama.py "
+                    "llama_early_exit_apply)"
+                )
+            self._draft_apply = factory(self._spec.layers)
+        #: cache positions one decode dispatch may write past context_len —
+        #: the block-growth lookahead (a spec round writes k+1 positions;
+        #: a plain dispatch writes decode_burst)
+        self._decode_lookahead = (cfg.spec_k + 1) if self._spec else cfg.decode_burst
 
         self._mb = cfg.blocks_per_slot  # block-table width
         # explicit is-None test: an explicit num_blocks=0 must reach the
@@ -245,8 +307,16 @@ class InferenceEngine:
         self._swapped_in_blocks = 0
         self._out_of_blocks_total = 0
         self._deadline_expired = 0
+        # speculative accounting (accept rate = accepted / drafted):
+        # drafted counts spec_k per live lane per round, accepted the
+        # verify-agreed prefix length (the correction token is free and
+        # counted in neither)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
 
-        self._decode_fn = self._build_decode_fn()
+        self._decode_fn = (
+            self._build_spec_decode_fn() if self._spec else self._build_decode_fn()
+        )
         self._prefill_fn = self._build_prefill_fn()
         # block-granular pool edits for CoW copies and swap restores:
         # donated so XLA aliases the pool buffer instead of copying the
@@ -309,8 +379,10 @@ class InferenceEngine:
     def _hbm_preflight(self, inner, pool_shape, pool_dtype, mesh) -> None:
         """shard-check's SP004 at the serving seam: predicted per-device
         bytes of params (under the placement ``_place_on_mesh`` would pick)
-        plus both paged pools, refused against ``hbm_budget_gb`` BEFORE a
-        single buffer allocates."""
+        plus both paged pools — plus, with speculation armed, the
+        ``draft_params`` tier (the transient in-trace slice of the target's
+        first layers the spec executable materialises) — refused against
+        ``hbm_budget_gb`` BEFORE a single buffer allocates."""
         from ..analysis.shardplan import engine_preflight
 
         report = engine_preflight(
@@ -321,18 +393,25 @@ class InferenceEngine:
             pool_dtype,
             self.config.hbm_budget_gb,
             swap_gb=self.config.swap_gb or None,
+            draft_layers=self._spec.layers if self._spec else None,
+            stacked_prefix=getattr(inner, "stacked_params_prefix", "layers"),
         )
         self.hbm_preflight = report
         if report["over"]:
             gib = 1 << 30
+            draft = (
+                f" + draft {report['draft_bytes'] / gib:.3f}"
+                if report.get("draft_bytes") else ""
+            )
             raise ValueError(
                 f"SP004: engine refuses to start — predicted "
                 f"{report['total_bytes'] / gib:.3f} GiB/device "
-                f"(params {report['params_bytes'] / gib:.3f} + "
+                f"(params {report['params_bytes'] / gib:.3f}{draft} + "
                 f"kv pools {report['pool_bytes'] / gib:.3f}) exceeds the "
                 f"{self.config.hbm_budget_gb:.3f} GiB budget. Lower "
                 f"num_blocks/max_seq_len (or use serve --auto-blocks), shard "
-                f"over a larger mesh, or raise the budget"
+                f"over a larger mesh, shrink the draft (or spec_k=0), or "
+                f"raise the budget"
             )
 
     # -- compiled programs ---------------------------------------------------
@@ -393,6 +472,112 @@ class InferenceEngine:
             return kp, vp, toks_out, key
 
         return jax.jit(decode_plain, donate_argnums=donate)
+
+    def _build_spec_decode_fn(self):
+        """Speculative twin of ``_build_decode_fn`` — when ``spec_k`` is
+        armed this IS the engine's one decode executable. One dispatch runs
+        the whole round:
+
+        1. **draft scan**: ``k`` greedy steps of the early-exit draft (the
+           target's first ``draft_layers`` layers), autoregressing through
+           a sliced view of the target pool's first layers — identical
+           weights make its K/V a strict subset of the target's, so the
+           draft needs no cache of its own;
+        2. **one verify forward** of static shape ``[num_slots, k+1]`` over
+           ``[pending, d_1 .. d_k]`` through the fused paged-attention
+           kernel (quantize-on-scatter + in-register dequant ride along at
+           every ``kv_dtype``). The verify re-scatters ALL layers at the
+           round's positions — including the draft layers, which makes the
+           draft scan's own pool writes disposable (they are discarded, not
+           written back);
+        3. **greedy acceptance** via the shared
+           :func:`~accelerate_tpu.generation.spec_accept_tokens` helper —
+           the single source of acceptance semantics with ``generate()``.
+
+        Rollback of rejected drafts is pure position bookkeeping: the host
+        advances each slot by ``accept+1``, the next round re-writes the
+        stale rows before any query can attend them, and no pool edit
+        beyond the normal scatter ever happens. Donation discipline and the
+        traced-body compile counter are identical to the plain decode fn,
+        so ``decode_compiles == 1`` remains the asserted contract."""
+        from ..generation import spec_accept_tokens
+
+        apply_fn, cfg = self._apply_fn, self.config
+        draft_apply = self._draft_apply
+        dl = self._spec.layers
+        k = cfg.spec_k
+        quantized = self._quantized
+
+        def spec_decode(params, kp, vp, ks, vs, block_tables, pos0, toks, active):
+            self._decode_traces += 1  # traced-body side effect: cache misses only
+
+            def dstep(carry, _):
+                dkp, dvp, dks, dvs, tok, pos = carry
+                pages_in = {"k": dkp, "v": dvp}
+                if quantized:
+                    pages_in["k_scale"], pages_in["v_scale"] = dks, dvs
+                out = draft_apply(
+                    params,
+                    input_ids=tok,
+                    paged_kv=pages_in,
+                    block_tables=block_tables,
+                    cache_positions=pos,
+                    paged_write_mask=active,  # PREFILL/free lanes must not scribble
+                )
+                pages = out["paged_kv"]
+                nxt = jnp.argmax(out["logits"][:, -1, :], axis=-1).astype(jnp.int32)
+                return (
+                    pages["k"], pages["v"],
+                    pages.get("k_scale", dks), pages.get("v_scale", dvs),
+                    nxt[:, None], pos + 1,
+                ), nxt
+
+            # the draft autoregresses through a sliced copy of the target
+            # pool's first dl layers; its writes only feed its OWN next
+            # steps — the verify below regenerates those rows from the same
+            # tokens/weights, so the scan carry is dropped, not merged back
+            d0 = (
+                kp[:dl], vp[:dl],
+                ks[:dl] if quantized else None,
+                vs[:dl] if quantized else None,
+                toks, pos0,
+            )
+            _, d = jax.lax.scan(dstep, d0, None, length=k)
+            d = d.T  # [num_slots, k] draft proposals
+
+            # ONE verify forward over [pending, d_1 .. d_k]: scatters k+1
+            # positions per active slot, reads the pool through the fused
+            # block-table kernel (query j attends positions <= pos0+j)
+            chunk = jnp.concatenate([toks, d], axis=1)  # [num_slots, k+1]
+            vmask = jnp.broadcast_to(active, (cfg.num_slots, k + 1))
+            out = apply_fn(
+                params,
+                input_ids=chunk,
+                paged_kv=self._paged_kv_dict(kp, vp, ks, vs),
+                block_tables=block_tables,
+                cache_positions=pos0,
+                paged_write_mask=vmask,
+            )
+            pages = out["paged_kv"]
+            preds = jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)  # [slots, k+1]
+            accept, tok_seq = spec_accept_tokens(d, preds)
+            return (
+                pages["k"], pages["v"],
+                pages.get("k_scale", ks), pages.get("v_scale", vs),
+                tok_seq, accept,
+            )
+
+        donate = (1, 2, 3, 4) if quantized else (1, 2)
+        if quantized:
+            return jax.jit(spec_decode, donate_argnums=donate)
+
+        def spec_plain(params, kp, vp, block_tables, pos0, toks, active):
+            kp, vp, _, _, tok_seq, accept = spec_decode(
+                params, kp, vp, None, None, block_tables, pos0, toks, active
+            )
+            return kp, vp, tok_seq, accept
+
+        return jax.jit(spec_plain, donate_argnums=donate)
 
     def _build_prefill_fn(self):
         apply_fn, cfg = self._apply_fn, self.config
@@ -552,6 +737,8 @@ class InferenceEngine:
         self._swapped_in_blocks = 0
         self._out_of_blocks_total = 0
         self._deadline_expired = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # hit accounting restarts with the measurement window; the trie and
         # its cached blocks deliberately stay warm (steady-state behaviour
         # is what a warmed bench leg measures)
@@ -560,6 +747,25 @@ class InferenceEngine:
         if self.radix is not None:
             self.radix.evicted_blocks = 0
             self.radix.inserted_blocks = 0
+
+    def _spec_stats(self) -> dict:
+        """Speculative health fields (accept rate is the TPOT lever — each
+        round costs one dispatch and emits accept+1 tokens). The SINGLE
+        source for both export surfaces, ``stats()`` and the telemetry
+        step rows; empty when speculation is off (monitor keys off
+        ``spec_k``)."""
+        if self._spec is None:
+            return {}
+        return {
+            "spec_k": self.config.spec_k,
+            "spec_draft": str(self._spec),
+            "spec_drafted_tokens": self._spec_drafted,
+            "spec_accepted_tokens": self._spec_accepted,
+            "spec_accept_rate": (
+                self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else 0.0
+            ),
+        }
 
     def stats(self) -> dict:
         """Aggregate serving health: goodput, TTFT/TPOT percentiles over
@@ -608,6 +814,7 @@ class InferenceEngine:
             "out_of_blocks_total": self._out_of_blocks_total,
             "deadline_expired_total": self._deadline_expired,
         }
+        out.update(self._spec_stats())
         if self.radix is not None:
             out["radix_inserted_blocks"] = self.radix.inserted_blocks
             out["radix_evicted_blocks"] = self.radix.evicted_blocks
@@ -858,8 +1065,7 @@ class InferenceEngine:
         Truncation (``out_of_blocks``) is the last resort: swap disabled or
         full, or ``req`` alone in the pool with nothing left to reclaim."""
         sched = self.scheduler
-        burst = self.config.decode_burst
-        while not sched.grow_for_decode(req, tokens_ahead=burst):
+        while not sched.grow_for_decode(req, tokens_ahead=self._decode_lookahead):
             if self._swap is None:
                 # no swap tier: keep PR 4's FCFS contract — the request
                 # that failed to grow is the one truncated, never an
@@ -897,7 +1103,6 @@ class InferenceEngine:
 
     def _decode_once(self, decoding: list[Request], finished: list[Request]) -> None:
         cfg = self.config
-        burst = cfg.decode_burst
         # pass 1 — capacity: grow every lane (evicting cached blocks,
         # preempting victims, truncating last-resort). A later lane's
         # preemption may take an *earlier* lane out of its slot, so lane
@@ -911,9 +1116,9 @@ class InferenceEngine:
         toks = np.zeros((cfg.num_slots, 1), np.int32)
         live: list[Request] = []
         for req in decoding:
-            # the burst writes up to `burst` positions ahead (capped at the
-            # request's own budget); lane-steps past the budget scatter into
-            # the null block and are dropped host-side
+            # a dispatch writes up to `_decode_lookahead` positions ahead
+            # (capped at the request's own budget); lane-steps past the
+            # budget scatter into the null block and are dropped host-side
             if req.slot is None or req.state is not RequestState.DECODE:
                 continue
             self._sync_block_table(req)
@@ -933,15 +1138,22 @@ class InferenceEngine:
             args = [
                 ("kp", self._kp), ("vp", self._vp),
                 ("block_tables", self._block_tables), ("pos0", pos0),
-                ("toks", toks), ("active", active), ("key", self._key),
-                ("temp", self._temp),
+                ("toks", toks), ("active", active),
             ]
+            if self._spec is None:  # the spec round is greedy: no key/temp
+                args += [("key", self._key), ("temp", self._temp)]
             if self._quantized:
                 args[2:2] = [("ks", self._ks), ("vs", self._vs)]
             decode_sig = tuple(
                 (name, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
                 for name, v in args
             )
+
+        if self._spec is not None:
+            self._spec_decode_dispatch(
+                pos0, toks, active, live, finished, decode_sig
+            )
+            return
         if self._quantized:
             (self._kp, self._vp, self._ks, self._vs, next_toks,
              self._key) = self._decode_fn(
@@ -956,10 +1168,46 @@ class InferenceEngine:
         self._check_one_executable(decode_sig)
         next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
         for req in live:
-            for t in range(burst):
+            for t in range(cfg.decode_burst):
                 if req.state is RequestState.FINISHED:
                     break  # mid-burst eos/length: the tail lane-steps are waste
                 self._emit_token(req, int(next_toks[t, req.slot]), finished)
+
+    def _spec_decode_dispatch(
+        self, pos0, toks, active, live: list[Request],
+        finished: list[Request], decode_sig: tuple | None,
+    ) -> None:
+        """One speculative round: dispatch the single compiled
+        draft+verify executable, then emit each live slot's accepted
+        prefix + correction through the SAME host-side ``_emit_token``
+        path the plain engine uses (eos and length budgets are host
+        state, so greedy parity with the non-spec engine is inherited,
+        not re-implemented). Rollback is implicit: a slot advances by
+        ``accept+1`` positions; the rejected rows beyond that are
+        re-scattered by the next round before anything can attend them."""
+        if self._quantized:
+            (self._kp, self._vp, self._ks, self._vs, tok_seq,
+             accept) = self._decode_fn(
+                self._params, self._kp, self._vp, self._ks, self._vs,
+                self._block_tables, pos0, toks, active,
+            )
+        else:
+            self._kp, self._vp, tok_seq, accept = self._decode_fn(
+                self._params, self._kp, self._vp, self._block_tables,
+                pos0, toks, active,
+            )
+        self._check_one_executable(decode_sig)
+        tok_seq = np.asarray(jax.device_get(tok_seq))  # [num_slots, k+1]
+        accept = np.asarray(jax.device_get(accept))    # [num_slots]
+        k = self.config.spec_k
+        for req in live:
+            a = int(accept[req.slot])
+            self._spec_drafted += k
+            self._spec_accepted += a
+            for t in range(a + 1):
+                if req.state is RequestState.FINISHED:
+                    break  # mid-round eos/length: the tail of the run is waste
+                self._emit_token(req, int(tok_seq[req.slot, t]), finished)
 
     def _check_one_executable(self, decode_sig: tuple | None) -> None:
         """ONE compiled decode executable is the engine's core contract.
@@ -1070,4 +1318,5 @@ class InferenceEngine:
                 swapped_in_blocks=self._swapped_in_blocks,
                 out_of_blocks_total=self._out_of_blocks_total,
                 deadline_expired_total=self._deadline_expired,
+                **self._spec_stats(),
             )

@@ -711,6 +711,28 @@ def _mode_spec(platform: str) -> None:
     )
 
 
+def _mode_spec_serve(platform: str) -> None:
+    """Speculative decoding IN THE SERVING ENGINE (the bench row for
+    benchmarks/spec_smoke.py): spec-on vs spec-off interleaved legs on the
+    identical Poisson trace/model/geometry, pairwise-median TPOT and
+    goodput ratios, the achieved accept rate, and the per-leg
+    decode-compile counts (the one-executable contract, both sides)."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.spec_smoke import run as spec_serve_run
+
+    r = spec_serve_run(platform)
+    print(
+        f"BENCH_SPEC_SERVE {r['spec_serve_tpot_ratio']:.4f} "
+        f"{r['spec_serve_accept_rate']:.4f} "
+        f"{r['spec_serve_goodput_ratio']:.4f} "
+        f"{r['spec_k']} "
+        f"{r['decode_compiles'][0]} {r['decode_compiles'][1]} "
+        f"{r['spec_tpot_p50_s']:.6f} {r['off_tpot_p50_s']:.6f}"
+    )
+
+
 def _mode_telemetry(platform: str) -> None:
     """Telemetry overhead row: the SAME toy train loop timed with telemetry
     off and on. The instrumentation cost is host-side and per-step, so a
@@ -1607,6 +1629,39 @@ def main():
     except Exception:
         pass
     try:
+        ss = _run_subprocess("spec-serve", platform, attempts=2)
+        (tpot_ratio, acc, good_ratio, ss_k, ss_spec_compiles, ss_off_compiles,
+         ss_spec_tpot, ss_off_tpot) = (float(v) for v in ss["BENCH_SPEC_SERVE"])
+        extra_rows.append(
+            {
+                "metric": "spec_serve_tpot_ratio",
+                "value": round(tpot_ratio, 4),
+                "unit": "ratio",
+                "accept_rate": round(acc, 4),
+                "goodput_ratio": round(good_ratio, 4),
+                "spec_k": int(ss_k),
+                "draft": "early_exit:1",
+                "tpot_p50_spec_s": ss_spec_tpot,
+                "tpot_p50_off_s": ss_off_tpot,
+                "decode_compiles": [int(ss_spec_compiles), int(ss_off_compiles)],
+                "note": "speculative decoding in the continuous-batching "
+                "engine (EngineConfig(spec_k=...) / serve --spec-k): "
+                "spec-on vs spec-off interleaved legs on the identical "
+                "Poisson trace, pairwise-median TPOT p50 ratio (< 1 = "
+                "speculation cut inter-token latency at the reported "
+                "accept rate) and goodput ratio (mixed-traffic "
+                "no-regress). The smoke's deep layers are scaled "
+                "near-transparent so the early-exit draft reaches a "
+                "usable accept rate deterministically — the win at THIS "
+                "rate, not the random-weights floor (that floor is the "
+                "`spec` row). One decode executable per leg asserted, "
+                "token parity with the non-spec engine asserted "
+                "(benchmarks/spec_smoke.py, make spec-smoke)",
+            }
+        )
+    except Exception:
+        pass
+    try:
         tel = _run_subprocess("telemetry", platform, attempts=2)
         t_off, t_on = (float(v) for v in tel["BENCH_TELEMETRY"])
         extra_rows.append(
@@ -1925,6 +1980,7 @@ def main():
         "llama_decode_tokens_per_sec_kv_cache": ("decode_tok_s", "value"),
         "serve_goodput_tokens_per_sec": ("serve_tok_s", "value"),
         "spec_decode_tokens_per_sec": ("spec_decode_tok_s", "value"),
+        "spec_serve_tpot_ratio": ("spec_serve_tpot_ratio", "value"),
         "disk_offload_fp32_disk_effective_stream_gb_per_s": ("offload_fp32_s_per_token", "s_per_token"),
         "disk_offload_int8_disk_effective_stream_gb_per_s": ("offload_int8_s_per_token", "s_per_token"),
         "disk_offload_nf4_disk_effective_stream_gb_per_s": ("offload_nf4_s_per_token", "s_per_token"),
@@ -1965,6 +2021,9 @@ def main():
             headline["chaos_respawns"] = row.get("respawns")
         if row.get("metric") == "spec_decode_tokens_per_sec":
             headline["spec_accept_rate"] = row.get("accept_rate")
+        if row.get("metric") == "spec_serve_tpot_ratio":
+            headline["spec_serve_accept_rate"] = row.get("accept_rate")
+            headline["spec_serve_goodput_ratio"] = row.get("goodput_ratio")
         if row.get("metric", "").startswith("disk_offload_"):
             tag = row["metric"].split("disk_offload_")[1].split("_disk_")[0]
             headline[f"offload_{tag}_gb_per_s"] = row.get("value")
@@ -1976,8 +2035,8 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
         "decode", "telemetry", "watchdog", "metrics", "sanitize", "race",
-        "shard", "goodput", "ckpt", "serve", "spec", "route", "radix", "kv",
-        "chaos",
+        "shard", "goodput", "ckpt", "serve", "spec", "spec-serve", "route",
+        "radix", "kv", "chaos",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -2000,6 +2059,7 @@ if __name__ == "__main__":
             "ckpt": _mode_ckpt,
             "serve": _mode_serve,
             "spec": _mode_spec,
+            "spec-serve": _mode_spec_serve,
             "route": _mode_route,
             "radix": _mode_radix,
             "kv": _mode_kv,
