@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -59,3 +59,6 @@ race-smoke:       ## concurrency gate: clean tree race-checks 0/0, seeded lock i
 
 spec-smoke:       ## speculative serving: spec-on vs spec-off interleaved legs on the identical trace -> TPOT ratio < 1 at the achieved accept rate, goodput no-regress, one decode executable per leg, token parity
 	python benchmarks/spec_smoke.py
+
+reqtrace-smoke:   ## request tracing: 2-replica routed fleet -> every request stitched cross-process under one trace_id, zero orphan flows, exactly-once finishes, trace-tail TTFT within 5ms, exemplar scrape round-trips
+	python benchmarks/reqtrace_smoke.py

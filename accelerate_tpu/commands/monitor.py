@@ -89,27 +89,63 @@ def monitor_command(args) -> int:
 
 
 def trace_merge_command(args) -> int:
-    from ..diagnostics.tracing import merge_traces, validate_chrome_trace
+    from ..diagnostics.tracing import (
+        discover_trace_files,
+        merge_traces,
+        validate_chrome_trace,
+    )
 
     trace_dir = args.logging_dir
-    # accept either the logging dir or its traces/ subdir directly
-    subdir = os.path.join(trace_dir, "traces")
-    if os.path.isdir(subdir):
-        trace_dir = subdir
-    output = args.output or os.path.join(trace_dir, "merged.trace.json")
-    try:
-        trace = merge_traces(trace_dir, output_path=output)
-    except FileNotFoundError as e:
-        print(f"trace merge: {e}", file=sys.stderr)
+    # accept the logging dir, its traces/ subdir, or a whole routed-fleet
+    # dir (router traces/ + every replica_*/traces/) — discovery finds all
+    # per-process files so one merge shows a request hopping processes
+    paths = discover_trace_files(trace_dir)
+    if not paths:
+        print(f"trace merge: no host_*.trace.json under {trace_dir}", file=sys.stderr)
         return 1
+    subdir = os.path.join(trace_dir, "traces")
+    out_dir = subdir if os.path.isdir(subdir) else trace_dir
+    output = args.output or os.path.join(out_dir, "merged.trace.json")
+    trace = merge_traces(paths=paths, output_path=output)
     validate_chrome_trace(trace)
     hosts = trace["metadata"]["merged_hosts"]
+    flows = trace["metadata"].get("request_flows") or {}
+    flow_text = ""
+    if flows.get("trace_ids"):
+        flow_text = (
+            f"\nstitched {flows['trace_ids']} request flow(s) by trace_id "
+            f"({flows['cross_process']} cross-process, "
+            f"{flows['orphan_flows']} orphan flow event(s))"
+        )
     print(
         f"merged {len(trace['traceEvents'])} events from "
-        f"{len(hosts) or '?'} host(s) -> {output}\n"
+        f"{len(hosts) or '?'} process(es) -> {output}{flow_text}\n"
         f"open in https://ui.perfetto.dev or chrome://tracing"
     )
     return 0
+
+
+def trace_tail_command(args) -> int:
+    """Tail-latency attribution over the slowest K requests — exit 1 when
+    the directory holds no request-scoped trace events at all (tracing was
+    off, or the run predates request tracing)."""
+    import json as _json
+
+    from ..diagnostics.reqtrace import render_tail_report, tail_report
+
+    if not os.path.isdir(args.logging_dir):
+        print(f"trace tail: {args.logging_dir} is not a directory", file=sys.stderr)
+        return 1
+    try:
+        report = tail_report(args.logging_dir, k=args.k, metric=args.metric)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"trace tail: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(report, indent=2))
+    else:
+        print(render_tail_report(report))
+    return 0 if report["total_requests"] else 1
 
 
 def add_parser(subparsers):
@@ -140,4 +176,18 @@ def add_parser(subparsers):
     merge.add_argument("logging_dir", help="the run's logging dir (or its traces/ subdir)")
     merge.add_argument("-o", "--output", default=None, help="merged output path")
     merge.set_defaults(func=trace_merge_command)
+
+    tail = trace_sub.add_parser(
+        "tail",
+        help="slowest-K requests by TTFT/TPOT with per-phase tail attribution "
+        "(queued / prefill / swap_in / preempted) from the request-scoped "
+        "trace events",
+    )
+    tail.add_argument("logging_dir", help="the serve/route logging dir")
+    tail.add_argument("-k", type=int, default=10, help="tail size (default 10)")
+    tail.add_argument("--metric", choices=("ttft", "tpot"), default="ttft",
+                      help="latency metric ranking the tail (default ttft)")
+    tail.add_argument("--json", action="store_true",
+                      help="machine-readable report instead of the table")
+    tail.set_defaults(func=trace_tail_command)
     return monitor

@@ -57,6 +57,13 @@ def route_command(args) -> int:
 
     if args.logging_dir:
         os.makedirs(args.logging_dir, exist_ok=True)
+        from ..diagnostics.tracing import Tracer, set_active_tracer
+
+        # the router's half of every request flow (submit → dispatch →
+        # finish) lands in <logging_dir>/traces/; each replica writes its
+        # own half under replica_<i>/ — `trace merge` stitches them by
+        # trace_id into one timeline, `trace tail` attributes the slowest
+        set_active_tracer(Tracer(logging_dir=args.logging_dir, process_name="router"))
 
     def spawn_fn(replica_id: int):
         """One replica's spawn recipe — shared by bring-up and the

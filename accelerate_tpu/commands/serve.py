@@ -242,6 +242,7 @@ def _make_engine(args):
 def _result_dict(req, req_id) -> dict:
     return {
         "id": req_id,
+        "trace_id": req.trace_id,
         "tokens": req.output_tokens,
         "ttft_s": req.ttft_s,
         "tpot_s": req.tpot_s,
@@ -300,6 +301,15 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
                         payload["prompt"], payload.get("max_new_tokens"),
                         priority=payload.get("priority", "interactive"),
                         deadline_ms=payload.get("deadline_ms"),
+                        trace_id=payload.get("trace_id"),
+                        # only a routed replica closes the router's flow
+                        # arrow — a standalone serve emitting flow heads
+                        # would count every request as an orphaned flow
+                        upstream_hop=(
+                            health is not None
+                            and health.replica_id is not None
+                            and payload.get("trace_id") is not None
+                        ),
                     )
                 except Exception as e:  # noqa: BLE001 — reported, not fatal
                     deliver({"id": req_id, "error": str(e)}, cb)
@@ -334,9 +344,21 @@ def serve_command(args) -> int:
 
     set_active_registry(MetricsRegistry())
     if args.logging_dir:
+        from ..diagnostics.tracing import Tracer, set_active_tracer
         from ..telemetry import TelemetryRecorder, set_active_recorder
 
         set_active_recorder(TelemetryRecorder(logging_dir=args.logging_dir))
+        # request-scoped tracing rides the same switch as telemetry: every
+        # request's lifecycle (arrive → admit → prefill → first token →
+        # finish) lands in this process's trace file, stitched fleet-wide
+        # by `accelerate-tpu trace merge`/`trace tail` via the trace_id
+        set_active_tracer(Tracer(
+            logging_dir=args.logging_dir,
+            process_name=(
+                f"replica_{args.replica_id}" if args.replica_id is not None
+                else "serve"
+            ),
+        ))
 
     health = ServeHealth(replica_id=args.replica_id)
     # SIGTERM = drain request (the preemption contract): flag only; the

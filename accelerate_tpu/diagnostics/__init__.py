@@ -26,14 +26,24 @@ call sites cost a ``None`` check.
 from .tracing import (
     NULL_TRACER,
     Tracer,
+    discover_trace_files,
+    ensure_trace_id,
     get_tracer,
     merge_traces,
+    new_trace_id,
     parse_trace_file,
     set_active_tracer,
     trace_instant,
     trace_span,
     traced,
+    valid_trace_id,
     validate_chrome_trace,
+)
+from .reqtrace import (
+    collect_request_flows,
+    render_tail_report,
+    request_timeline,
+    tail_report,
 )
 from .watchdog import Watchdog, get_active_watchdog
 from .monitor import collect_status, render_status
@@ -42,15 +52,23 @@ __all__ = [
     "NULL_TRACER",
     "Tracer",
     "Watchdog",
+    "collect_request_flows",
     "collect_status",
+    "discover_trace_files",
+    "ensure_trace_id",
     "get_active_watchdog",
     "get_tracer",
     "merge_traces",
+    "new_trace_id",
     "parse_trace_file",
     "render_status",
+    "render_tail_report",
+    "request_timeline",
     "set_active_tracer",
+    "tail_report",
     "trace_instant",
     "trace_span",
     "traced",
+    "valid_trace_id",
     "validate_chrome_trace",
 ]

@@ -130,6 +130,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "last_record_age_s": None,
         "serving": None,
         "goodput": None,
+        "request_tail": None,
         "skipped_unknown_schema": 0,
         "hosts": [],
         "stragglers": [],
@@ -355,6 +356,13 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
     from ..metrics.goodput import ledger_from_dir_throttled
 
     status["goodput"] = ledger_from_dir_throttled(logging_dir)
+
+    # -- request-trace tail (slowest requests + phase attribution from the
+    # request-scoped trace events; throttled like the goodput ledger, None
+    # when request tracing is off) -------------------------------------------
+    from .reqtrace import tail_from_dir_throttled
+
+    status["request_tail"] = tail_from_dir_throttled(logging_dir)
     return status
 
 
@@ -406,6 +414,26 @@ def render_status(status: dict[str, Any]) -> str:
                 f"preemptions {_fmt(srv.get('preemptions'), '{}')}   "
                 f"swapped-out blocks {_fmt(srv.get('swapped_out_blocks'), '{}')}   "
                 f"out-of-blocks {_fmt(srv.get('out_of_blocks_total'), '{}')}"
+            )
+    tail = status.get("request_tail")
+    if tail and tail.get("tail"):
+        attribution = "   ".join(
+            f"{phase} {pct:.0f}%"
+            for phase, pct in sorted(
+                (tail.get("attribution") or {}).items(), key=lambda kv: -kv[1]
+            )
+            if pct >= 0.5
+        )
+        lines.append(
+            f"  slow requests ({tail['metric']} tail of "
+            f"{tail['measured_requests']}): " + (attribution or "-")
+        )
+        for t in tail["tail"][:3]:
+            lines.append(
+                f"    {t['trace_id'][:16]:<16} "
+                f"{tail['metric']} {_fmt(t.get(tail['metric'] + '_s'), '{:.3f}')}s  "
+                f"queued {_fmt((t.get('phases') or {}).get('queued'), '{:.3f}')}s  "
+                f"finish {t.get('finish_reason') or '?'}"
             )
     fleet = status.get("fleet")
     if fleet:

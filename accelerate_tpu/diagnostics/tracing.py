@@ -32,8 +32,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
+import uuid
 from typing import Any
 
 from ..logging import get_logger
@@ -45,6 +47,36 @@ logger = get_logger(__name__)
 #: file name pattern for per-host traces (the merge tool globs on this)
 TRACE_FILE_PATTERN = "host_{host}.trace.json"
 TRACE_SUBDIR = "traces"
+
+#: category stamped on every request-scoped event (async ``b``/``n``/``e``
+#: and flow ``s``/``f`` phases) — the merge stitcher and ``trace tail``
+#: select on this, so free-form span names can never collide with the
+#: request lifecycle vocabulary
+REQUEST_CATEGORY = "request"
+
+#: the shape a trace id must have to ride the wire: client-supplied ids
+#: outside this alphabet are replaced at the submit boundary (a trace id
+#: lands in file names, JSONL rows, and exemplar labels — it must never
+#: need escaping anywhere)
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_.:-]{1,64}$")
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex request trace id (random, not sequential: ids from
+    independent routers/engines must not collide in a merged timeline)."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_trace_id(trace_id) -> bool:
+    return isinstance(trace_id, str) and bool(_TRACE_ID_RE.match(trace_id))
+
+
+def ensure_trace_id(trace_id) -> str:
+    """The submit-boundary contract: a well-formed client-supplied id
+    survives verbatim; anything else (missing, wrong type, unsafe chars)
+    is replaced with a generated one — tracing must never reject a
+    request."""
+    return trace_id if valid_trace_id(trace_id) else new_trace_id()
 
 #: version stamped as ``schema`` on every trace event (the trace-row
 #: counterpart of ``telemetry.SCHEMA_VERSION``): readers skip-with-warning
@@ -107,6 +139,18 @@ class _NullTracer:
         pass
 
     def counter(self, name, value):
+        pass
+
+    def request_begin(self, trace_id, name, ts=None, **attrs):
+        pass
+
+    def request_instant(self, trace_id, name, ts=None, **attrs):
+        pass
+
+    def request_end(self, trace_id, name, ts=None, **attrs):
+        pass
+
+    def flow(self, trace_id, phase, name="req/hop", **attrs):
         pass
 
     def open_spans(self):
@@ -179,6 +223,10 @@ class Tracer:
             every event, the crash-safest; the default batches a little to
             keep the hot path cheap without risking more than a step's
             worth of spans on a crash).
+        process_name: label for this process in the merged timeline
+            (default ``host_<n>``) — serving processes pass ``router`` /
+            ``replica_<i>`` so a stitched request flow reads as a hop
+            between *roles*, not anonymous host indices.
     """
 
     enabled = True
@@ -188,8 +236,10 @@ class Tracer:
         logging_dir: str | None = None,
         host: int | None = None,
         buffer_events: int = 16,
+        process_name: str | None = None,
     ):
         self.host = _host_index() if host is None else int(host)
+        self.process_name = process_name or f"host_{self.host}"
         self._file = None
         self.path = None
         self._lock = threading.Lock()
@@ -221,7 +271,7 @@ class Tracer:
         self._write_event(
             {
                 "name": "process_name", "ph": "M", "pid": self.host, "tid": 0,
-                "args": {"name": f"host_{self.host}"},
+                "args": {"name": self.process_name},
             },
             flush=True,
         )
@@ -265,6 +315,59 @@ class Tracer:
                 "args": {"value": value},
             }
         )
+
+    # -- request-scoped events (the per-request lifecycle surface) -----------
+    #
+    # Perfetto *nestable async* events keyed on (cat="request", id=trace_id):
+    # ``b``/``e`` bracket the request's lifetime inside THIS process and the
+    # ``n`` instants mark lifecycle transitions in between — deliberately
+    # NOT per-token spans, so a 10k-token completion costs a handful of
+    # events, not 10k. ``ts`` may be supplied (monotonic seconds) so an
+    # event can be stamped with the engine's own timing fields — `trace
+    # tail` then reproduces the engine-reported TTFT exactly instead of
+    # within call-latency noise.
+
+    def _request_event(self, ph: str, trace_id: str, name: str,
+                       ts: float | None, attrs: dict):
+        event = {
+            "name": name, "cat": REQUEST_CATEGORY, "ph": ph,
+            "id": str(trace_id),
+            "ts": (time.perf_counter() if ts is None else float(ts)) * 1e6,
+            "pid": self.host, "tid": threading.get_ident(),
+        }
+        if attrs:
+            event["args"] = attrs
+        self._write_event(event)
+
+    def request_begin(self, trace_id: str, name: str, ts: float | None = None,
+                      **attrs):
+        self._request_event("b", trace_id, name, ts, attrs)
+
+    def request_instant(self, trace_id: str, name: str, ts: float | None = None,
+                        **attrs):
+        self._request_event("n", trace_id, name, ts, attrs)
+
+    def request_end(self, trace_id: str, name: str, ts: float | None = None,
+                    **attrs):
+        self._request_event("e", trace_id, name, ts, attrs)
+
+    def flow(self, trace_id: str, phase: str, name: str = "req/hop", **attrs):
+        """A flow-event endpoint (``s`` = arrow tail at the sender, ``f`` =
+        arrow head at the receiver) keyed on the trace id: after ``trace
+        merge`` fuses the per-process files, Perfetto draws the arrow from
+        the router's dispatch to the replica's admission — the visual form
+        of cross-process trace propagation."""
+        event = {
+            "name": name, "cat": REQUEST_CATEGORY, "ph": phase,
+            "id": str(trace_id),
+            "ts": time.perf_counter() * 1e6,
+            "pid": self.host, "tid": threading.get_ident(),
+        }
+        if phase == "f":
+            event["bp"] = "e"  # bind to the enclosing slice
+        if attrs:
+            event["args"] = attrs
+        self._write_event(event)
 
     def open_spans(self) -> dict[int, list[dict]]:
         """Snapshot of currently-open spans per thread (outermost first) —
@@ -484,7 +587,74 @@ def parse_trace_file(path: str) -> list[dict]:
     return events
 
 
-def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
+def discover_trace_files(logging_dir: str) -> list[str]:
+    """Every per-process trace file a run (or a routed fleet) left under
+    ``logging_dir``: the host files in ``traces/`` plus — for a fleet —
+    each replica's own ``replica_*/traces/`` files, so one merge shows a
+    request hopping router → replica."""
+    import glob as _glob
+
+    pats = (
+        os.path.join(logging_dir, TRACE_SUBDIR, "host_*.trace.json"),
+        os.path.join(logging_dir, "host_*.trace.json"),
+        os.path.join(logging_dir, "replica_*", TRACE_SUBDIR, "host_*.trace.json"),
+    )
+    seen: list[str] = []
+    for pat in pats:
+        for path in sorted(_glob.glob(pat)):
+            if path not in seen:
+                seen.append(path)
+    return seen
+
+
+def iter_offset_events(events):
+    """Yield ``(event, offset_us)`` pairs where ``offset_us`` is the most
+    recent ``clock_sync``'s wall-minus-monotonic offset — applied
+    SEQUENTIALLY, because one file can hold several monotonic epochs (the
+    tracer appends across restarts, each with a fresh ``perf_counter``
+    origin). The single source of the offset arithmetic shared by
+    :func:`merge_traces` and the reqtrace reader, so ``trace merge`` and
+    ``trace tail`` can never disagree about a file's wall timestamps.
+    ``clock_sync`` rows are yielded too (with the offset they establish)
+    so callers can record per-host offsets and warn on torn payloads."""
+    offset_us = 0.0
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "clock_sync":
+            wall_minus_mono = (e.get("args") or {}).get("wall_minus_mono_s")
+            if wall_minus_mono is not None:
+                offset_us = float(wall_minus_mono) * 1e6
+        yield e, offset_us
+
+
+def _stitch_request_flows(merged: list[dict]) -> dict:
+    """Cross-process request accounting over the merged (clock-corrected)
+    timeline: for every trace id, which processes it touched and whether
+    its flow arrows pair up. ``orphan_flows`` counts ``s`` events with no
+    ``f`` (or vice versa) — the smoke harness's zero-orphans bar."""
+    by_id: dict[str, dict] = {}
+    for e in merged:
+        if e.get("cat") != REQUEST_CATEGORY or "id" not in e:
+            continue
+        info = by_id.setdefault(e["id"], {"pids": set(), "s": 0, "f": 0})
+        info["pids"].add(e.get("pid"))
+        ph = e.get("ph")
+        if ph == "s":
+            info["s"] += 1
+        elif ph == "f":
+            info["f"] += 1
+    orphans = sum(abs(i["s"] - i["f"]) for i in by_id.values())
+    return {
+        "trace_ids": len(by_id),
+        "cross_process": sum(1 for i in by_id.values() if len(i["pids"]) > 1),
+        "orphan_flows": orphans,
+    }
+
+
+def merge_traces(
+    trace_dir: str | None = None,
+    output_path: str | None = None,
+    paths: list[str] | None = None,
+) -> dict:
     """Fuse ``host_*.trace.json`` files into ONE Perfetto-loadable timeline.
 
     Every host's events carry monotonic timestamps with an arbitrary origin;
@@ -494,28 +664,60 @@ def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
     t=0 — cross-host skew is then exactly the wall-clock skew between
     hosts, which is what a straggler investigation wants to see.
 
+    ``paths`` (instead of a directory) merges an explicit file list — the
+    ``trace merge``/``trace tail`` CLIs pass a whole fleet's files (router
+    + every replica) through :func:`discover_trace_files`. Two *files*
+    claiming the same pid (a router and a replica each being host 0 of
+    their own process) are disambiguated by remapping the later file onto
+    a fresh pid, so the merged view keeps one track per process. Request-
+    scoped events (``cat="request"``) are stitched by trace id and the
+    tally lands in ``metadata.request_flows``.
+
     Returns the merged trace dict (``{"traceEvents": [...]}``); when
     ``output_path`` is given it is also written there as well-formed JSON.
     """
     import glob as _glob
 
-    paths = sorted(_glob.glob(os.path.join(trace_dir, "host_*.trace.json")))
+    if paths is None:
+        paths = sorted(_glob.glob(os.path.join(trace_dir, "host_*.trace.json")))
     if not paths:
         raise FileNotFoundError(f"no host_*.trace.json under {trace_dir}")
 
     merged: list[dict] = []
     offsets: dict[int, float] = {}
+    used_pids: set[int] = set()
     for path in paths:
         events = parse_trace_file(path)
-        # A file can hold SEVERAL monotonic epochs: the tracer appends
-        # across restarts (auto-resume in the same logging_dir), and each
-        # restart writes a fresh clock_sync with its own perf_counter
-        # origin. Offsets therefore apply SEQUENTIALLY — every event uses
-        # the most recent clock_sync above it, so a resumed run's spans
-        # land at their true wall-clock position, not the dead process's.
-        offset_us = 0.0  # until the first clock_sync (legacy/foreign files)
+        # pid disambiguation across FILES: each process writes its own file
+        # with its own host index as pid, and two independent processes
+        # (router + replica, or two replicas' own host 0) may collide —
+        # remap this file's colliding pids onto fresh ones so each file
+        # stays one distinct track in the merged timeline
+        file_pids = sorted(
+            {e["pid"] for e in events if isinstance(e.get("pid"), int)}
+        )
+        pid_map: dict[int, int] = {}
+        for pid in file_pids:
+            if pid in used_pids:
+                new = (max(used_pids | set(pid_map.values())) + 1) if used_pids else 0
+                pid_map[pid] = new
+                used_pids.add(new)
+            else:
+                used_pids.add(pid)
+        if pid_map:
+            remapped = []
+            for e in events:
+                if isinstance(e.get("pid"), int) and e["pid"] in pid_map:
+                    e = dict(e)
+                    e["pid"] = pid_map[e["pid"]]
+                remapped.append(e)
+            events = remapped
+        # offsets apply SEQUENTIALLY via iter_offset_events — every event
+        # uses the most recent clock_sync above it, so a resumed run's
+        # spans land at their true wall-clock position, not the dead
+        # process's (a file holds one epoch per restart)
         saw_clock_sync = False
-        for e in events:
+        for e, offset_us in iter_offset_events(events):
             if e.get("ph") == "M":
                 if e.get("name") == "clock_sync":
                     # a partial/killed host can leave a clock_sync with a
@@ -529,7 +731,6 @@ def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
                             "(partial/killed host?) — assuming zero offset", path,
                         )
                     else:
-                        offset_us = float(wall_minus_mono) * 1e6
                         saw_clock_sync = True
                     host = e.get("pid")
                     if host is not None:
@@ -569,6 +770,7 @@ def merge_traces(trace_dir: str, output_path: str | None = None) -> dict:
             "merged_hosts": sorted(offsets),
             "clock_offsets_s": {str(h): o for h, o in sorted(offsets.items())},
             "t0_wall_s": t0 / 1e6,
+            "request_flows": _stitch_request_flows(merged),
         },
     }
     if output_path is not None:

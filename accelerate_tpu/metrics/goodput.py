@@ -270,21 +270,33 @@ GOODPUT_REFRESH_SECONDS = 10.0
 _throttle_cache: dict[str, tuple[float, dict | None]] = {}
 
 
-def ledger_from_dir_throttled(
-    logging_dir: str, min_interval_s: float = GOODPUT_REFRESH_SECONDS
-) -> dict | None:
-    """:func:`ledger_from_dir`, recomputed at most every
-    ``min_interval_s`` per logging_dir (errors degrade to None, never
-    propagate — a broken trail must not kill a monitor/exporter loop)."""
+def throttled_from_dir(cache, logging_dir, min_interval_s, compute):
+    """Shared per-logging_dir throttle for cadence consumers (the monitor
+    repaint loop, a per-second scrape): run ``compute(logging_dir)`` at
+    most every ``min_interval_s`` per dir, caching in ``cache``; errors
+    degrade to a cached None, never propagate — a broken trail must not
+    kill a monitor/exporter loop. Also backs the request-trace tail panel
+    (:mod:`accelerate_tpu.diagnostics.reqtrace`)."""
     key = os.path.abspath(logging_dir)
-    cached = _throttle_cache.get(key)
+    cached = cache.get(key)
     now = time.monotonic()
     if cached is not None and now - cached[0] < min_interval_s:
         return cached[1]
     try:
-        ledger = ledger_from_dir(logging_dir)
+        result = compute(logging_dir)
     except Exception:
-        logger.warning("goodput ledger failed for %s", logging_dir, exc_info=True)
-        ledger = None
-    _throttle_cache[key] = (now, ledger)
-    return ledger
+        logger.warning("%s failed for %s", getattr(compute, "__name__", "compute"),
+                       logging_dir, exc_info=True)
+        result = None
+    cache[key] = (now, result)
+    return result
+
+
+def ledger_from_dir_throttled(
+    logging_dir: str, min_interval_s: float = GOODPUT_REFRESH_SECONDS
+) -> dict | None:
+    """:func:`ledger_from_dir`, recomputed at most every
+    ``min_interval_s`` per logging_dir."""
+    return throttled_from_dir(
+        _throttle_cache, logging_dir, min_interval_s, ledger_from_dir
+    )

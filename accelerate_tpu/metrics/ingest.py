@@ -164,16 +164,32 @@ def _observe_serving(registry, record: dict) -> None:
             registry.counter("serving_tokens", "Tokens emitted by the engine").inc(
                 record["new_tokens"]
             )
+        # per-priority-class latency series (rows without a priority — old
+        # trails, foreign writers — keep the unlabeled series), and a
+        # trace_id exemplar so a scrape links a bad bucket straight to the
+        # request's stitched trace (`accelerate-tpu trace tail` / merge)
+        labels = (
+            {"class": record["priority"]}
+            if isinstance(record.get("priority"), str)
+            else {}
+        )
+        exemplar = (
+            # capped so the exemplar labelset can never trip the spec's
+            # 128-char limit, whatever a foreign trail put in the row
+            {"trace_id": record["trace_id"][:64]}
+            if isinstance(record.get("trace_id"), str) and record["trace_id"]
+            else None
+        )
         if _num(record.get("ttft_s")) is not None:
             registry.histogram(
                 "serving_ttft_seconds", "Time to first token",
                 buckets=_LATENCY_BUCKETS,
-            ).observe(record["ttft_s"])
+            ).observe(record["ttft_s"], exemplar=exemplar, **labels)
         if _num(record.get("tpot_s")) is not None:
             registry.histogram(
                 "serving_tpot_seconds", "Time per output token",
                 buckets=_LATENCY_BUCKETS,
-            ).observe(record["tpot_s"])
+            ).observe(record["tpot_s"], exemplar=exemplar, **labels)
     elif kind == "step":
         for field, name, help in (
             ("tokens_per_sec", "serving_tokens_per_second", "Engine token throughput (window)"),

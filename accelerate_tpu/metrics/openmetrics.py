@@ -50,6 +50,19 @@ def _labels_text(labels: tuple, extra: tuple = ()) -> str:
     return "{" + inner + "}"
 
 
+def _exemplar_text(exemplar: tuple) -> str:
+    """OpenMetrics 1.0 exemplar suffix: `` # {labels} value ts`` — the
+    braces are mandatory (unlike a sample's label set) even when empty."""
+    labels, value, ts = exemplar
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in labels
+    )
+    text = f" # {{{inner}}} {_format_value(value)}"
+    if ts is not None:
+        text += f" {repr(float(ts))}"
+    return text
+
+
 def render_openmetrics(registry) -> str:
     """One scrape's worth of exposition text for every family in
     ``registry`` (insertion-ordered, samples label-sorted for determinism)."""
@@ -71,18 +84,27 @@ def render_openmetrics(registry) -> str:
                 )
             else:  # histogram: cumulative le buckets + +Inf + sum/count
                 cum = 0
-                for bound, raw in zip(metric.buckets, series.bucket_counts):
+                exemplars = getattr(series, "exemplars", None) or {}
+                for i, (bound, raw) in enumerate(
+                    zip(metric.buckets, series.bucket_counts)
+                ):
                     cum += raw
-                    lines.append(
+                    line = (
                         f"{metric.name}_bucket"
                         f"{_labels_text(series.labels, (('le', _format_value(bound)),))} "
                         f"{cum}"
                     )
-                lines.append(
+                    if i in exemplars:
+                        line += _exemplar_text(exemplars[i])
+                    lines.append(line)
+                line = (
                     f"{metric.name}_bucket"
                     f"{_labels_text(series.labels, (('le', '+Inf'),))} "
                     f"{series.count}"
                 )
+                if len(metric.buckets) in exemplars:
+                    line += _exemplar_text(exemplars[len(metric.buckets)])
+                lines.append(line)
                 lines.append(
                     f"{metric.name}_count{_labels_text(series.labels)} {series.count}"
                 )
@@ -162,11 +184,56 @@ def _parse_value(text: str, line: str) -> float:
         raise ValueError(f"bad sample value {text!r} in: {line}") from None
 
 
-def _split_sample(line: str) -> tuple[str, dict[str, str], float]:
+def _parse_exemplar(text: str, line: str) -> dict:
+    """Parse the OpenMetrics exemplar tail ``{labels} value [ts]`` (the
+    part after ``# ``), strictly: mandatory braces, escape-aware labelset,
+    the spec's 128-char labelset cap."""
+    text = text.strip()
+    if not text.startswith("{"):
+        raise ValueError(f"exemplar must start with a labelset in: {line}")
+    i = _find_close_brace(text, 0)
+    if i < 0:
+        raise ValueError(f"unterminated exemplar labelset in: {line}")
+    labels = _parse_labels(text[1:i], line)
+    if sum(len(k) + len(v) for k, v in labels.items()) > 128:
+        raise ValueError(f"exemplar labelset exceeds 128 characters in: {line}")
+    rest = text[i + 1 :].split()
+    if not rest or len(rest) > 2:
+        raise ValueError(
+            f"exemplar needs a value (and at most a timestamp) in: {line}"
+        )
+    value = _parse_value(rest[0], line)
+    ts = _parse_value(rest[1], line) if len(rest) == 2 else None
+    return {"labels": labels, "value": value, "ts": ts}
+
+
+def _find_close_brace(text: str, start: int) -> int:
+    """Index of the ``}`` closing the labelset opened at ``start``,
+    respecting quoted values and escapes; -1 when unterminated."""
+    i, n, in_str, esc = start + 1, len(text), False, False
+    while i < n:
+        ch = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+        elif ch == '"':
+            in_str = True
+        elif ch == "}":
+            return i
+        i += 1
+    return -1
+
+
+def _split_sample(line: str) -> tuple[str, dict[str, str], float, dict | None]:
     brace = line.find("{")
-    if brace >= 0:
-        close = line.rfind("}")
-        if close < brace:
+    hash_pos = line.find("#")
+    if brace >= 0 and (hash_pos < 0 or brace < hash_pos):
+        close = _find_close_brace(line, brace)
+        if close < 0:
             raise ValueError(f"unbalanced braces in: {line}")
         name = line[:brace]
         labels = _parse_labels(line[brace + 1 : close], line)
@@ -179,8 +246,24 @@ def _split_sample(line: str) -> tuple[str, dict[str, str], float]:
         labels = {}
     if not rest:
         raise ValueError(f"sample line needs a value: {line}")
-    value_text = rest.split()[0]  # a timestamp after the value would be legal
-    return name, labels, _parse_value(value_text, line)
+    parts = rest.split(None, 1)
+    value = _parse_value(parts[0], line)
+    exemplar = None
+    if len(parts) == 2:
+        tail = parts[1].strip()
+        if tail.startswith("#"):
+            exemplar = _parse_exemplar(tail[1:], line)
+        else:
+            # a timestamp after the value, optionally followed by the
+            # exemplar — anything else is junk
+            sub = tail.split(None, 1)
+            _parse_value(sub[0], line)
+            if len(sub) == 2:
+                t2 = sub[1].strip()
+                if not t2.startswith("#"):
+                    raise ValueError(f"junk after sample timestamp in: {line}")
+                exemplar = _parse_exemplar(t2[1:], line)
+    return name, labels, value, exemplar
 
 
 def _check_histogram(family: dict, name: str) -> None:
@@ -219,6 +302,27 @@ def _check_histogram(family: dict, name: str) -> None:
             raise ValueError(
                 f"histogram {name} +Inf bucket {values[-1]} != _count {counts[key]}"
             )
+    # bucket exemplars must sit INSIDE their bucket's value range — an
+    # exemplar above its le bound links a scrape to the wrong trace
+    for entry in family.get("exemplars", ()):
+        if not entry["sample"].endswith("_bucket"):
+            continue
+        le_text = entry["labels"].get("le")
+        if le_text is None:
+            continue  # already rejected by the bucket-without-le check
+        le = _parse_value(le_text, f'le="{le_text}"')
+        key = tuple(
+            sorted((k, v) for k, v in entry["labels"].items() if k != "le")
+        )
+        bounds = sorted(b for b, _ in by_series.get(key, ()))
+        idx = bounds.index(le) if le in bounds else -1
+        lower = bounds[idx - 1] if idx > 0 else -math.inf
+        value = entry["exemplar"]["value"]
+        if not (lower < value <= le):
+            raise ValueError(
+                f"histogram {name} exemplar value {value} outside its "
+                f"bucket (le={le_text})"
+            )
 
 
 def parse_openmetrics(text: str) -> dict[str, dict]:
@@ -248,7 +352,7 @@ def parse_openmetrics(text: str) -> dict[str, dict]:
                 raise ValueError(f"unknown metric type {kind!r}: {line}")
             if name in families:
                 raise ValueError(f"duplicate TYPE for {name}")
-            families[name] = {"type": kind, "help": "", "samples": []}
+            families[name] = {"type": kind, "help": "", "samples": [], "exemplars": []}
             current = name
             continue
         if line.startswith("# HELP "):
@@ -259,7 +363,7 @@ def parse_openmetrics(text: str) -> dict[str, dict]:
             continue
         if line.startswith("#"):
             raise ValueError(f"unknown comment line: {line}")
-        name, labels, value = _split_sample(line)
+        name, labels, value, exemplar = _split_sample(line)
         family = None
         for fam_name, fam in families.items():
             for suffix in _SUFFIXES[fam["type"]]:
@@ -272,6 +376,16 @@ def parse_openmetrics(text: str) -> dict[str, dict]:
             raise ValueError(f"sample {name!r} matches no declared family")
         if families[family]["type"] == "counter" and not name.endswith("_total"):
             raise ValueError(f"counter sample must end in _total: {line}")
+        if exemplar is not None:
+            # the spec admits exemplars on histogram buckets and counter
+            # totals only — a gauge (or _sum/_count) carrying one is junk
+            if not (name.endswith("_bucket") or name.endswith("_total")):
+                raise ValueError(
+                    f"exemplar on a sample that cannot carry one: {line}"
+                )
+            families[family].setdefault("exemplars", []).append(
+                {"sample": name, "labels": labels, "exemplar": exemplar}
+            )
         families[family]["samples"].append((name, labels, value))
     if not saw_eof:
         raise ValueError("missing # EOF terminator")

@@ -25,6 +25,7 @@ lives in :mod:`.ingest`.
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 
 __all__ = [
@@ -68,7 +69,7 @@ def _label_key(labels: dict | None) -> tuple:
 class _Series:
     """One (metric, label-set) time series."""
 
-    __slots__ = ("labels", "value", "bucket_counts", "total", "count")
+    __slots__ = ("labels", "value", "bucket_counts", "total", "count", "exemplars")
 
     def __init__(self, labels: tuple, n_buckets: int = 0):
         self.labels = labels
@@ -77,6 +78,11 @@ class _Series:
             self.bucket_counts = [0] * n_buckets
             self.total = 0.0
             self.count = 0
+            #: bucket index (len(buckets) = the +Inf bucket) → the newest
+            #: OpenMetrics exemplar observed into it: (label pairs, value,
+            #: wall ts). One slot per bucket — a scrape links a bad bucket
+            #: to ONE representative trace, not a history.
+            self.exemplars: dict[int, tuple[tuple, float, float]] = {}
 
     def snapshot(self) -> "_Series":
         """A consistent copy (caller holds the registry lock): the renderer
@@ -90,6 +96,7 @@ class _Series:
             copy.bucket_counts = list(self.bucket_counts)
             copy.total = self.total
             copy.count = self.count
+            copy.exemplars = dict(self.exemplars)
         return copy
 
 
@@ -145,7 +152,14 @@ class Metric:
             if value > series.value:
                 series.value = float(value)
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: dict | None = None, **labels):
+        """``exemplar`` (e.g. ``{"trace_id": "..."}``) attaches an
+        OpenMetrics exemplar to the bucket this observation lands in: the
+        scrape then links the bucket straight to the trace that filled it
+        (``# {trace_id="…"} value ts`` per the 1.0 spec). An exemplar
+        whose labelset exceeds the spec's 128-character cap is dropped
+        here — the renderer must never emit exposition text its own
+        strict parser rejects."""
         with self._lock:
             series = self._get_series(labels)
             # per-bucket raw counts; the renderer accumulates them into the
@@ -155,6 +169,12 @@ class Metric:
                 series.bucket_counts[idx] += 1
             series.total += float(value)
             series.count += 1
+            if exemplar:
+                pairs = tuple(
+                    sorted((str(k), str(v)) for k, v in exemplar.items())
+                )
+                if sum(len(k) + len(v) for k, v in pairs) <= 128:
+                    series.exemplars[idx] = (pairs, float(value), time.time())
 
     # -- queries -------------------------------------------------------------
 
@@ -259,7 +279,7 @@ class _NullMetric:
     def set_total(self, value, **labels):
         pass
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
         pass
 
     def series(self):

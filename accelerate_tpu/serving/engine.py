@@ -36,6 +36,7 @@ engine stays token-identical to the non-spec engine.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass
 
 import jax
@@ -43,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.sanitizer import get_active_sanitizer as _get_sanitizer
-from ..diagnostics.tracing import trace_span
+from ..diagnostics.tracing import ensure_trace_id, get_tracer, trace_span, valid_trace_id
 from ..generation import _pick_traced
 from ..telemetry import get_active_recorder
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
@@ -80,6 +81,13 @@ class EngineConfig:
     decode_burst: int = 8
     #: emit a telemetry "serving" row every N iterations (0 disables)
     stats_interval: int = 32
+    #: finished :class:`Request` objects retained for ``stats()``
+    #: percentiles — a *ring*, not a list: a long-lived serve process must
+    #: not leak every completed request (nor rescan an unbounded history
+    #: O(n) per stats() call). Cumulative counts stay exact through
+    #: ``completed_total``; the percentile window is the newest this-many
+    #: completions.
+    completed_history: int = 4096
     #: per-device HBM budget in GiB; when set, the engine runs the
     #: shard-check pre-flight BEFORE allocating anything and refuses to
     #: start (ValueError naming SP004) if params + the paged pools exceed
@@ -297,7 +305,16 @@ class InferenceEngine:
         self._tokens_emitted = 0
         self._occupancy_sum = 0.0
         self._start_time: float | None = None
-        self._completed: list[Request] = []
+        # bounded completion history (percentile window) + exact totals:
+        # the ring caps memory and stats() cost on a long-lived server
+        # while completed_total keeps counting past the cap
+        self._completed: deque[Request] = deque(
+            maxlen=max(1, int(cfg.completed_history))
+        )
+        self._completed_total = 0
+        #: per-iteration request tracer (None when tracing is disabled —
+        #: refreshed by ONE get_tracer() read at the top of step())
+        self._tr = None
         self._last_stats_t: float | None = None
         self._last_stats_tokens = 0
         # sharing / preemption counters (reset_stats zeroes them with the
@@ -626,19 +643,33 @@ class InferenceEngine:
         arrival_time: float | None = None,
         priority: str = "interactive",
         deadline_ms: float | None = None,
+        trace_id: str | None = None,
+        upstream_hop: bool = False,
     ) -> Request:
         """Enqueue one request. ``deadline_ms`` is a *relative* budget from
         now: once it elapses the scheduler finishes the request with
         ``finish_reason="deadline_exceeded"`` (partial output kept, blocks
         freed the same iteration). A malformed value raises ValueError —
         the serve front end answers that as an error row, mirroring the
-        unknown-``priority`` handling."""
+        unknown-``priority`` handling.
+
+        ``trace_id`` is the request's distributed-trace identity: a
+        well-formed supplied id (the router's, or a client's) survives
+        verbatim; otherwise one is generated here. It rides every answer
+        row, request-scoped trace event, and latency exemplar.
+        ``upstream_hop=True`` declares that a routing tier dispatched this
+        request (and emitted the flow arrow's tail) — the engine then
+        lands the arrow's head at arrival. A standalone engine must leave
+        it False even for client-supplied ids, or every request counts as
+        an orphaned flow in the merged timeline."""
+        upstream = upstream_hop and valid_trace_id(trace_id)
         req = Request(
             prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
             max_new_tokens=int(
                 self.config.max_new_tokens if max_new_tokens is None else max_new_tokens
             ),
             priority=priority,
+            trace_id=ensure_trace_id(trace_id),
         )
         if arrival_time is not None:
             req.arrival_time = arrival_time
@@ -653,7 +684,22 @@ class InferenceEngine:
                     "number of milliseconds"
                 )
             req.deadline = time.perf_counter() + budget_ms / 1000.0
-        return self.scheduler.submit(req)
+        self.scheduler.submit(req)
+        tr = get_tracer()
+        if tr:
+            # the engine-side async span opens at ARRIVAL (stamped with the
+            # request's own arrival_time, so span math reproduces the
+            # engine-reported TTFT exactly); a request that arrived with an
+            # upstream trace_id also lands the flow-arrow head the
+            # router's dispatch tail points at
+            tr.request_begin(
+                req.trace_id, "req/arrive", ts=req.arrival_time,
+                request_id=req.request_id, prompt_tokens=req.prompt_len,
+                max_new_tokens=req.max_new_tokens, priority=req.priority,
+            )
+            if upstream:
+                tr.flow(req.trace_id, "f")
+        return req
 
     def step(self) -> list[Request]:
         """One engine iteration: evict finished → admit queued → one
@@ -661,6 +707,9 @@ class InferenceEngine:
         that finished during this iteration."""
         if self._start_time is None:
             self._start_time = self._last_stats_t = time.perf_counter()
+        # ONE global read per iteration when tracing is disabled — every
+        # request-event site below keys off this cached (falsy) handle
+        self._tr = get_tracer() or None
         sched = self.scheduler
         finished: list[Request] = []
 
@@ -690,6 +739,17 @@ class InferenceEngine:
         self._iterations += 1
         self._occupancy_sum += sched.occupancy
         self._completed.extend(finished)
+        self._completed_total += len(finished)
+        if self._tr is not None:
+            for req in finished:
+                # exactly one end event per request, whatever path finished
+                # it (eos/length/out_of_blocks/deadline, queued or running)
+                self._tr.request_end(
+                    req.trace_id, "req/finish", ts=req.finish_time,
+                    finish_reason=req.finish_reason,
+                    new_tokens=len(req.output_tokens),
+                    ttft_s=req.ttft_s, tpot_s=req.tpot_s,
+                )
         self._emit_telemetry(finished)
         return finished
 
@@ -729,7 +789,8 @@ class InferenceEngine:
         self._tokens_emitted = 0
         self._occupancy_sum = 0.0
         self._start_time = None
-        self._completed = []
+        self._completed.clear()
+        self._completed_total = 0
         self._last_stats_t = None
         self._last_stats_tokens = 0
         self._preemptions = 0
@@ -778,7 +839,10 @@ class InferenceEngine:
         )
         out = {
             "iterations": self._iterations,
-            "completed": len(self._completed),
+            # exact cumulative count — NOT the percentile window's length
+            # (the ring caps history; the counter keeps counting past it)
+            "completed": self._completed_total,
+            "completed_window": len(self._completed),
             "queue_depth": sched.queue_depth,
             "active_slots": len(sched.active()),
             "num_slots": self.config.num_slots,
@@ -836,18 +900,32 @@ class InferenceEngine:
             elapsed = time.perf_counter() - self._start_time
             out["elapsed_s"] = elapsed
             out["tokens_per_sec"] = self._tokens_emitted / elapsed if elapsed > 0 else 0.0
-        ttfts = [r.ttft_s for r in self._completed if r.ttft_s is not None]
-        tpots = [r.tpot_s for r in self._completed if r.tpot_s is not None]
-        if ttfts:
-            out["ttft_s"] = {
-                "p50": float(np.percentile(ttfts, 50)),
-                "p99": float(np.percentile(ttfts, 99)),
+        # latency percentiles over the completion window, overall and per
+        # priority class — the per-tenant-SLO groundwork: "p99 TTFT" alone
+        # hides an interactive regression behind a batch flood
+        window = list(self._completed)
+        for attr, key in (("ttft_s", "ttft_s"), ("tpot_s", "tpot_s")):
+            values = [getattr(r, attr) for r in window if getattr(r, attr) is not None]
+            if not values:
+                continue
+            entry = {
+                "p50": float(np.percentile(values, 50)),
+                "p99": float(np.percentile(values, 99)),
             }
-        if tpots:
-            out["tpot_s"] = {
-                "p50": float(np.percentile(tpots, 50)),
-                "p99": float(np.percentile(tpots, 99)),
-            }
+            by_class = {}
+            for cls in {r.priority for r in window}:
+                cls_values = [
+                    getattr(r, attr) for r in window
+                    if r.priority == cls and getattr(r, attr) is not None
+                ]
+                if cls_values:
+                    by_class[cls] = {
+                        "p50": float(np.percentile(cls_values, 50)),
+                        "p99": float(np.percentile(cls_values, 99)),
+                    }
+            if by_class:
+                entry["by_class"] = by_class
+            out[key] = entry
         return out
 
     # -- iteration internals -------------------------------------------------
@@ -863,6 +941,14 @@ class InferenceEngine:
         sched = self.scheduler
         while True:
             for req in sched.admit():
+                if self._tr is not None:
+                    now = time.perf_counter()
+                    self._tr.request_instant(
+                        req.trace_id, "req/admit", ts=now, slot=req.slot,
+                        queued_s=now - req.arrival_time,
+                        radix_hit_tokens=req.matched_tokens,
+                        restored=req.preempted,
+                    )
                 self._place_admitted(req)
             head = sched.peek_head()
             if head is None or self._swap is None:
@@ -880,6 +966,7 @@ class InferenceEngine:
         swapped rows into its freshly allocated blocks, or run the pending
         copy-on-write block copy for a partial-prefix hit."""
         if req.swap_plan:
+            swap_t0 = time.perf_counter() if self._tr is not None else 0.0
             # one gathered scatter per pool (mirrors _swap_out's batched
             # device_get), padded with null-block zero rows
             n = len(req.swap_plan)
@@ -913,6 +1000,13 @@ class InferenceEngine:
             self._swapped_in_blocks += n
             req.swap_plan = []
             req.preempted = False
+            if self._tr is not None:
+                # seconds ride the event: swap-in stalls are exactly the
+                # tail-latency share `trace tail` attributes to this phase
+                self._tr.request_instant(
+                    req.trace_id, "req/swap_in", blocks=n,
+                    seconds=time.perf_counter() - swap_t0,
+                )
             if req.state is RequestState.DECODE:
                 # resume feeding the last emitted token at context_len
                 self._pending_tok[req.slot] = req.output_tokens[-1]
@@ -949,6 +1043,7 @@ class InferenceEngine:
                 swappable.append(i)
         if self._swap is None or not self._swap.can_hold(len(swappable)):
             return False
+        swap_t0 = time.perf_counter() if self._tr is not None else 0.0
         plan: list[tuple[int, int]] = []
         released = [victim.blocks[i] for i in swappable]
         if released:
@@ -982,6 +1077,11 @@ class InferenceEngine:
         self.scheduler.requeue_preempted(victim)
         self._preemptions += 1
         self._swapped_out_blocks += len(plan)
+        if self._tr is not None:
+            self._tr.request_instant(
+                victim.trace_id, "req/preempt", blocks=len(plan),
+                swap_out_s=time.perf_counter() - swap_t0,
+            )
         return True
 
     def _release_expired_queued(self, req: Request) -> None:
@@ -1046,6 +1146,13 @@ class InferenceEngine:
                 self._key, self._temp,
             )
         req.prefill_pos = end
+        if self._tr is not None:
+            # one event per CHUNK (bounded by prompt_len / prefill_chunk),
+            # never per token
+            self._tr.request_instant(
+                req.trace_id, "req/prefill_chunk", start=start, end=end,
+                final=is_final,
+            )
         if is_final:
             if self.radix is not None:
                 # the prompt's full blocks now hold valid K/V: adopt them
@@ -1167,6 +1274,14 @@ class InferenceEngine:
             )
         self._check_one_executable(decode_sig)
         next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
+        if self._tr is not None:
+            # request identity on the decode timeline WITHOUT per-token
+            # spans: one instant per dispatch carries the whole slot batch
+            self._tr.instant(
+                "serve/decode_batch", slots=len(live),
+                burst=cfg.decode_burst,
+                trace_ids=[r.trace_id for r in live],
+            )
         for req in live:
             for t in range(cfg.decode_burst):
                 if req.state is RequestState.FINISHED:
@@ -1200,6 +1315,12 @@ class InferenceEngine:
         tok_seq = np.asarray(jax.device_get(tok_seq))  # [num_slots, k+1]
         accept = np.asarray(jax.device_get(accept))    # [num_slots]
         k = self.config.spec_k
+        if self._tr is not None:
+            self._tr.instant(
+                "serve/spec_round", slots=len(live), k=k,
+                trace_ids=[r.trace_id for r in live],
+                accepted=[int(accept[r.slot]) for r in live],
+            )
         for req in live:
             a = int(accept[req.slot])
             self._spec_drafted += k
@@ -1258,6 +1379,11 @@ class InferenceEngine:
         self._tokens_emitted += 1
         if req.first_token_time is None:
             req.first_token_time = now
+            if self._tr is not None:
+                self._tr.request_instant(
+                    req.trace_id, "req/first_token", ts=now,
+                    ttft_s=now - req.arrival_time,
+                )
         eos = self.config.eos_token_id
         if eos is not None and tok == eos:
             req.finish_reason = "eos"
@@ -1278,6 +1404,8 @@ class InferenceEngine:
             tel.record_serving(
                 kind="request",
                 request_id=req.request_id,
+                trace_id=req.trace_id,
+                priority=req.priority,
                 prompt_tokens=req.prompt_len,
                 new_tokens=len(req.output_tokens),
                 ttft_s=req.ttft_s,
@@ -1305,7 +1433,7 @@ class InferenceEngine:
                 decode_compiles=self._decode_traces,
                 # cumulative totals: the monitor reads a bounded JSONL tail,
                 # so run-total counts must ride every row, not be re-counted
-                completed_total=len(self._completed),
+                completed_total=self._completed_total,
                 tokens_total=self._tokens_emitted,
                 prefix_hit_tokens=sched.prefix_hit_tokens,
                 prefix_hit_ratio=(

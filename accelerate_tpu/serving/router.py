@@ -60,6 +60,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..analysis.lockwatch import get_active_lockwatch, maybe_watch
+from ..diagnostics.tracing import ensure_trace_id, get_tracer
 from ..logging import get_logger
 from .replica import ReplicaError, ReplicaHandle, ReplicaTimeout
 
@@ -117,6 +118,12 @@ class Ticket:
     def req_id(self):
         """The caller's request id, echoed on every answer row."""
         return self.payload.get("id") if isinstance(self.payload, dict) else None
+
+    @property
+    def trace_id(self):
+        """The request's distributed-trace identity (stamped into the
+        payload at submit, so it rides the HTTP hop to the replica)."""
+        return self.payload.get("trace_id") if isinstance(self.payload, dict) else None
 
 
 class Router:
@@ -223,6 +230,17 @@ class Router:
         malformed ``deadline_ms`` is likewise an error *answer*, never a
         crash; a full bounded queue sheds ``batch`` before ``interactive``
         with explicit over-capacity error rows."""
+        # the span's begin timestamp is captured BEFORE the ticket can
+        # enter the queue: the event itself is emitted after the lock
+        # releases, and by then the dispatcher may already have stamped
+        # req/dispatch — an un-pinned begin would sort after it
+        submit_ts = time.perf_counter()
+        if isinstance(payload, dict):
+            # the trace id is BORN here: a well-formed client-supplied
+            # "trace_id" survives verbatim, anything else gets a generated
+            # one — stamped into the payload so the HTTP dispatch carries
+            # it into the replica (and its engine) unchanged
+            payload["trace_id"] = ensure_trace_id(payload.get("trace_id"))
         ticket = Ticket(payload=payload, callback=callback)
         req_id = ticket.req_id
         rejected = None
@@ -285,6 +303,14 @@ class Router:
                 self._arm_deadline(ticket.deadline)
                 self._queue.append(ticket)
                 self._work.notify()
+        tr = get_tracer()
+        if tr and ticket.trace_id:  # events land OUTSIDE the dispatch lock
+            tr.request_begin(
+                ticket.trace_id, "req/submit", ts=submit_ts,
+                request=str(req_id), priority=ticket.priority,
+            )
+            if shed_victim is not None and shed_victim.trace_id:
+                tr.request_instant(shed_victim.trace_id, "req/shed")
         if shed_victim is not None:  # answered outside the lock
             self._finish(shed_victim, {
                 "id": shed_victim.req_id,
@@ -441,6 +467,15 @@ class Router:
                 if ticket is not None:
                     time.sleep(0.05)
                 continue
+            tr = get_tracer()
+            if tr and ticket.trace_id:
+                # the flow-arrow TAIL: merge draws router-dispatch →
+                # replica-admit once both files land in one timeline
+                tr.request_instant(
+                    ticket.trace_id, "req/dispatch",
+                    replica=replica.replica_id, attempt=ticket.attempts,
+                )
+                tr.flow(ticket.trace_id, "s")
             threading.Thread(
                 target=self._dispatch_one, args=(ticket, replica),
                 name=f"router-req-{replica.replica_id}", daemon=True,
@@ -508,6 +543,13 @@ class Router:
                     self._queue.appendleft(ticket)
                     self._arm_deadline(ticket.deadline)
                     self._work.notify()
+                tr = get_tracer()
+                if tr and ticket.trace_id:
+                    tr.request_instant(
+                        ticket.trace_id, "req/requeue",
+                        replica=replica.replica_id, attempt=ticket.attempts,
+                        timeout=timed_out,
+                    )
             return
         cleared_probation = False
         with self._lock:
@@ -532,6 +574,15 @@ class Router:
     def _finish(self, ticket: Ticket, result: dict, count_delivered: bool = True):
         """Deliver exactly once — a retry racing a late first answer must
         not double-deliver."""
+        if (
+            isinstance(result, dict)
+            and ticket.trace_id
+            and "trace_id" not in result
+        ):
+            # router-originated answers (shed/deadline/dead-fleet error
+            # rows) carry the trace id too — every answer row is
+            # correlatable, not just the ones a replica produced
+            result["trace_id"] = ticket.trace_id
         with self._lock:
             if ticket.delivered:
                 return
@@ -544,6 +595,16 @@ class Router:
             # answer from a wedged replica must not double-count
             if isinstance(result, dict) and isinstance(result.get("tokens"), list):
                 self._tokens += len(result["tokens"])
+        tr = get_tracer()
+        if tr and ticket.trace_id:
+            # under the delivered guard above we returned on a duplicate,
+            # so exactly one end event closes the router-side span
+            error = result.get("error") if isinstance(result, dict) else None
+            tr.request_end(
+                ticket.trace_id, "req/finish", ok=error is None,
+                attempts=ticket.attempts, replica=ticket.replica_id,
+                **({"error": str(error)[:200]} if error is not None else {}),
+            )
         if ticket.callback is not None:
             try:
                 ticket.callback(result)
@@ -587,6 +648,7 @@ class Router:
             # exactly once either way.
             stranded = self._inflight.get(replica.replica_id, set())
             rescued = len(stranded)
+            rescued_trace_ids = [t.trace_id for t in stranded if t.trace_id]
             for t in stranded:
                 self._queue.appendleft(t)
                 self._requeues += 1
@@ -596,6 +658,13 @@ class Router:
             stranded.clear()
             if rescued:
                 self._work.notify()
+        tr = get_tracer()
+        if tr:  # outside the lock, like every other event site
+            for tid in rescued_trace_ids:
+                tr.request_instant(
+                    tid, "req/requeue", replica=replica.replica_id,
+                    rescued=True,
+                )
         logger.warning(
             "replica %d (pid %s) is dead — %d in-flight request(s) requeued, "
             "sessions released", replica.replica_id, replica.pid, rescued,

@@ -78,6 +78,12 @@ class Request:
     max_new_tokens: int
     priority: str = "interactive"  # see PRIORITY_CLASSES
     request_id: int = field(default_factory=lambda: next(_request_ids))
+    #: distributed-trace identity: born at the submit boundary (client-
+    #: supplied or generated), echoed on every answer row, and stamped on
+    #: every request-scoped trace event and latency exemplar — the one key
+    #: that stitches a request's router hop, engine lifecycle, and metric
+    #: buckets together
+    trace_id: str | None = None
     arrival_time: float = field(default_factory=time.perf_counter)
     #: absolute ``time.perf_counter`` expiry (None = no deadline): the
     #: scheduler finishes the request with ``finish_reason=

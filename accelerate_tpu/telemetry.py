@@ -67,7 +67,10 @@ see :data:`SCHEMA_VERSION`):
                ``free_blocks``, ``decode_compiles``) and
                ``kind="request"`` (per completion — ``ttft_s``,
                ``tpot_s``, ``prompt_tokens``, ``new_tokens``,
-               ``finish_reason``).
+               ``finish_reason``, ``priority`` — the metrics ingest's
+               ``{class=...}`` label — and ``trace_id``, which becomes
+               the OpenMetrics exemplar linking a latency bucket to the
+               request's stitched trace).
 ``profile``  — ``trace_dir``, ``steps``, ``active_steps`` (one record per
                finished ``accelerator.profile()`` session).
 ``checkpoint`` — ``kind`` (``save``/``restore``), ``seconds``, ``bytes``,

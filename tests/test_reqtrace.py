@@ -1,0 +1,456 @@
+# Copyright The HuggingFace Team. All rights reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+"""Request-scoped distributed tracing: trace_id propagation across the
+router → replica → engine hops, cross-process flow stitching in ``trace
+merge``, tail-latency attribution (``trace tail``), OpenMetrics exemplars,
+and the bounded completed-request ring."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu.diagnostics.reqtrace import (
+    collect_request_flows,
+    render_tail_report,
+    request_timeline,
+    tail_report,
+)
+from accelerate_tpu.diagnostics.tracing import (
+    Tracer,
+    ensure_trace_id,
+    merge_traces,
+    new_trace_id,
+    set_active_tracer,
+    valid_trace_id,
+    validate_chrome_trace,
+)
+
+# ---------------------------------------------------------------------------
+# trace-id contract
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_contract():
+    tid = new_trace_id()
+    assert valid_trace_id(tid) and len(tid) == 16
+    assert ensure_trace_id("client-supplied_1.a:b") == "client-supplied_1.a:b"
+    # malformed / unsafe ids are REPLACED, never rejected
+    for bad in (None, 7, "", "a b", "x" * 65, 'quo"te', "new\nline"):
+        out = ensure_trace_id(bad)
+        assert out != bad and valid_trace_id(out)
+
+
+# ---------------------------------------------------------------------------
+# cross-process merge stitching (synthetic trace files)
+# ---------------------------------------------------------------------------
+
+
+def _write_trace(path, pid, wall_minus_mono_s, events, name=None):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rows = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": name or f"host_{pid}"}},
+        {"name": "clock_sync", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"wall_minus_mono_s": wall_minus_mono_s}},
+    ]
+    with open(path, "w") as f:
+        f.write("[\n")
+        for row in rows + events:
+            f.write(json.dumps(row) + ",\n")
+
+
+def test_merge_stitches_flows_across_skewed_clocks(tmp_path):
+    """Two processes with skewed clock_sync offsets share one trace_id:
+    the merged flow events land in true wall-clock order and the stitch
+    metadata counts the cross-process flow with zero orphans."""
+    tid = "cafef00dcafef00d"
+    # router's monotonic origin is 1000s "behind" the replica's, but its
+    # wall offset compensates: submit/dispatch happen BEFORE arrive/finish
+    router = [
+        {"name": "req/submit", "cat": "request", "ph": "b", "id": tid,
+         "ts": 1_000_000.0, "pid": 0, "tid": 1},
+        {"name": "req/dispatch", "cat": "request", "ph": "n", "id": tid,
+         "ts": 1_100_000.0, "pid": 0, "tid": 1, "args": {"replica": 0}},
+        {"name": "req/hop", "cat": "request", "ph": "s", "id": tid,
+         "ts": 1_100_001.0, "pid": 0, "tid": 1},
+        {"name": "req/finish", "cat": "request", "ph": "e", "id": tid,
+         "ts": 2_000_000.0, "pid": 0, "tid": 1, "args": {"ok": True}},
+    ]
+    replica = [
+        {"name": "req/hop", "cat": "request", "ph": "f", "bp": "e", "id": tid,
+         "ts": 5_200_000.0, "pid": 0, "tid": 9},
+        {"name": "req/arrive", "cat": "request", "ph": "b", "id": tid,
+         "ts": 5_200_002.0, "pid": 0, "tid": 9},
+        {"name": "req/finish", "cat": "request", "ph": "e", "id": tid,
+         "ts": 5_900_000.0, "pid": 0, "tid": 9,
+         "args": {"finish_reason": "length"}},
+    ]
+    _write_trace(str(tmp_path / "traces" / "host_0.trace.json"), 0, 100.0,
+                 router, name="router")
+    # replica clock: wall = mono + 96.9 → its mono 5.2s sits at wall 102.1,
+    # i.e. 1.0s after the router's dispatch at wall 101.1
+    _write_trace(str(tmp_path / "replica_0" / "traces" / "host_0.trace.json"),
+                 0, 96.9, replica, name="replica_0")
+
+    from accelerate_tpu.diagnostics.tracing import discover_trace_files
+
+    paths = discover_trace_files(str(tmp_path))
+    assert len(paths) == 2
+    merged = merge_traces(paths=paths, output_path=str(tmp_path / "m.json"))
+    validate_chrome_trace(merged)
+
+    flows = merged["metadata"]["request_flows"]
+    assert flows == {"trace_ids": 1, "cross_process": 1, "orphan_flows": 0}
+    # the two processes collided on pid 0 — the merge keeps them distinct
+    req = [e for e in merged["traceEvents"] if e.get("id") == tid]
+    assert len({e["pid"] for e in req}) == 2
+    # wall-corrected order: submit → dispatch → s → f → arrive → finishes
+    names = [e["name"] for e in sorted(req, key=lambda e: e["ts"])]
+    assert names.index("req/dispatch") < names.index("req/arrive")
+    assert names.index("req/hop", names.index("req/dispatch")) < names.index("req/arrive")
+
+
+def test_request_timeline_from_stitched_flow(tmp_path):
+    """The reqtrace reader reproduces phases from raw events: queued =
+    arrive→admit, prefill the remainder, explicit swap_in seconds."""
+    tid = "feedbeeffeedbeef"
+    events = [
+        {"name": "req/arrive", "cat": "request", "ph": "b", "id": tid,
+         "ts": 1_000_000.0, "pid": 0, "tid": 1, "args": {"priority": "batch"}},
+        {"name": "req/admit", "cat": "request", "ph": "n", "id": tid,
+         "ts": 1_300_000.0, "pid": 0, "tid": 1, "args": {"slot": 0}},
+        {"name": "req/swap_in", "cat": "request", "ph": "n", "id": tid,
+         "ts": 1_310_000.0, "pid": 0, "tid": 1, "args": {"seconds": 0.05}},
+        {"name": "req/first_token", "cat": "request", "ph": "n", "id": tid,
+         "ts": 1_500_000.0, "pid": 0, "tid": 1},
+        {"name": "req/finish", "cat": "request", "ph": "e", "id": tid,
+         "ts": 1_900_000.0, "pid": 0, "tid": 1,
+         "args": {"finish_reason": "eos", "new_tokens": 5, "tpot_s": 0.1}},
+    ]
+    _write_trace(str(tmp_path / "traces" / "host_0.trace.json"), 0, 0.0, events)
+    flows = collect_request_flows(str(tmp_path))
+    assert set(flows) == {tid}
+    t = request_timeline(tid, flows[tid])
+    assert t["complete"]
+    assert t["ttft_s"] == pytest.approx(0.5)
+    assert t["phases"]["queued"] == pytest.approx(0.3)
+    assert t["phases"]["swap_in"] == pytest.approx(0.05)
+    assert t["phases"]["prefill"] == pytest.approx(0.15)
+    assert t["finish_reason"] == "eos" and t["tpot_s"] == pytest.approx(0.1)
+    report = tail_report(str(tmp_path), k=5)
+    assert report["k"] == 1 and report["attribution"]["queued"] == pytest.approx(60.0)
+    assert "queued 60.0%" in render_tail_report(report)
+
+
+def test_timeline_picks_first_finishing_engine_half_on_timeout_requeue(tmp_path):
+    """A request_timeout requeue can run TWO full engine lifecycles under
+    one trace_id (the slow-but-alive replica keeps going after the router
+    re-dispatched). The router delivers the FIRST answer, so the timeline
+    must come from the half that finished first — never a cross-replica
+    splice of A's arrival with B's first token."""
+    tid = "a0a0a0a0a0a0a0a0"
+
+    def half(t0, ttft_us, dur_us):
+        return [
+            {"name": "req/arrive", "cat": "request", "ph": "b", "id": tid,
+             "ts": t0, "pid": 0, "tid": 1},
+            {"name": "req/admit", "cat": "request", "ph": "n", "id": tid,
+             "ts": t0 + 1000.0, "pid": 0, "tid": 1, "args": {"slot": 0}},
+            {"name": "req/first_token", "cat": "request", "ph": "n", "id": tid,
+             "ts": t0 + ttft_us, "pid": 0, "tid": 1},
+            {"name": "req/finish", "cat": "request", "ph": "e", "id": tid,
+             "ts": t0 + dur_us, "pid": 0, "tid": 1,
+             "args": {"finish_reason": "length", "new_tokens": 4}},
+        ]
+
+    # slow replica A: arrived first, finishes LAST; fast replica B's
+    # answer is the one the router delivered
+    _write_trace(str(tmp_path / "replica_0" / "traces" / "host_0.trace.json"),
+                 0, 0.0, half(1_000_000.0, 900_000.0, 2_000_000.0),
+                 name="replica_0")
+    _write_trace(str(tmp_path / "replica_1" / "traces" / "host_1.trace.json"),
+                 1, 0.0, half(1_400_000.0, 100_000.0, 400_000.0),
+                 name="replica_1")
+    flows = collect_request_flows(str(tmp_path))
+    t = request_timeline(tid, flows[tid])
+    assert t["engine_finish_events"] == 2  # both lifecycles are visible...
+    assert t["ttft_s"] == pytest.approx(0.1)  # ...but the timeline is B's
+    assert t["roles"] == ["replica_0", "replica_1"]
+
+
+# ---------------------------------------------------------------------------
+# engine: request events + completed ring (deadline-expiry path — finishes
+# requests without ever compiling, so this stays in the fast lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4, seq=96)
+    return LlamaForCausalLM.from_config(config, seed=0)
+
+
+def test_completed_ring_caps_history_and_totals_keep_counting(tiny_model, tmp_path):
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+    tracer = Tracer(logging_dir=str(tmp_path), host=0, process_name="serve")
+    set_active_tracer(tracer)
+    try:
+        engine = InferenceEngine(
+            tiny_model,
+            EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                         completed_history=2),
+        )
+        for i in range(5):
+            engine.add_request([1, 2, 3], max_new_tokens=4, deadline_ms=0.01,
+                               trace_id=f"ring{i:012d}")
+        time.sleep(0.05)
+        finished = engine.step()  # all five expire in the queue — no compile
+        assert len(finished) == 5
+        stats = engine.stats()
+        # the counter keeps counting past the cap; the window is the ring
+        assert stats["completed"] == 5
+        assert stats["completed_window"] == 2
+        assert len(engine._completed) == 2
+        assert stats["decode_compiles"] == 0 and stats["prefill_compiles"] == 0
+    finally:
+        tracer.close()
+        set_active_tracer(None)
+    # exactly one begin and one finish event per request, even on the
+    # never-admitted deadline-expiry path
+    flows = collect_request_flows(str(tmp_path))
+    assert len(flows) == 5
+    for tid, events in flows.items():
+        t = request_timeline(tid, events)
+        assert t["engine_finish_events"] == 1
+        assert t["finish_reason"] == "deadline_exceeded"
+        assert t["complete"]
+
+
+@pytest.mark.slow
+def test_engine_spans_reproduce_ttft_and_per_class_stats(tiny_model, tmp_path):
+    """Acceptance: span-derived TTFT matches the engine-reported value to
+    within 5ms, per-class percentiles appear, and tracing armed leaves the
+    one-executable contract intact."""
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+    tracer = Tracer(logging_dir=str(tmp_path), host=0, process_name="serve")
+    set_active_tracer(tracer)
+    try:
+        engine = InferenceEngine(
+            tiny_model,
+            EngineConfig(num_slots=2, block_size=8, max_seq_len=64,
+                         prefill_chunk=8, decode_burst=2),
+        )
+        done = []
+        for i in range(4):
+            engine.add_request(
+                [1 + i, 2, 3, 4], max_new_tokens=5,
+                priority="interactive" if i % 2 else "batch",
+                trace_id=f"req{i:013d}",
+            )
+        done = engine.run_until_idle()
+        stats = engine.stats()
+        assert stats["decode_compiles"] == 1
+    finally:
+        tracer.close()
+        set_active_tracer(None)
+
+    assert {"interactive", "batch"} <= set(stats["ttft_s"]["by_class"])
+    assert stats["ttft_s"]["by_class"]["interactive"]["p50"] > 0
+
+    flows = collect_request_flows(str(tmp_path))
+    assert len(flows) == 4
+    by_id = {t["trace_id"]: t for t in (
+        request_timeline(tid, evs) for tid, evs in flows.items()
+    )}
+    for req in done:
+        t = by_id[req.trace_id]
+        assert t["complete"] and t["engine_finish_events"] == 1
+        assert abs(t["ttft_s"] - req.ttft_s) < 0.005, (t["ttft_s"], req.ttft_s)
+        assert sum(t["phases"].values()) == pytest.approx(t["ttft_s"], abs=1e-6)
+
+
+def test_requests_get_trace_ids_with_tracing_disabled(tiny_model):
+    """No tracer: trace ids still exist (answer rows and exemplars key on
+    them) and nothing else changes."""
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+    engine = InferenceEngine(
+        tiny_model, EngineConfig(num_slots=2, block_size=8, max_seq_len=64)
+    )
+    req = engine.add_request([1, 2, 3], max_new_tokens=2)
+    assert valid_trace_id(req.trace_id)
+    kept = engine.add_request([1, 2, 3], max_new_tokens=2, trace_id="keep-me-1")
+    assert kept.trace_id == "keep-me-1"
+
+
+# ---------------------------------------------------------------------------
+# router: trace_id born at submit, stamped into the dispatched payload
+# ---------------------------------------------------------------------------
+
+
+def test_router_stamps_trace_id_into_dispatched_payload():
+    from accelerate_tpu.serving.replica import ReplicaError, ReplicaHandle
+    from accelerate_tpu.serving.router import Router
+
+    class StubReplica(ReplicaHandle):
+        def __init__(self, replica_id):
+            super().__init__(replica_id, f"http://stub/{replica_id}")
+            self.state = "ready"
+            self.handled = []
+
+        def check_health(self, timeout=2.0):
+            self.last_heartbeat = time.time()
+            return {"state": self.state}
+
+        def generate(self, payload, timeout=None):
+            self.handled.append(payload)
+            return {"id": payload.get("id"), "tokens": [1],
+                    "trace_id": payload.get("trace_id"),
+                    "finish_reason": "length"}
+
+    stub = StubReplica(0)
+    router = Router([stub], health_interval=60.0)
+    try:
+        kept = router.submit({"id": 0, "prompt": [1] * 16,
+                              "trace_id": "client-0001"})
+        fresh = router.submit({"id": 1, "prompt": [2] * 16})
+        malformed = router.submit({"id": 2, "prompt": [3] * 16,
+                                   "trace_id": "spaced out"})
+        for t in (kept, fresh, malformed):
+            assert t.done.wait(timeout=10.0)
+        assert kept.result["trace_id"] == "client-0001"
+        assert valid_trace_id(fresh.result["trace_id"])
+        assert valid_trace_id(malformed.result["trace_id"])
+        assert malformed.result["trace_id"] != "spaced out"
+        dispatched = {p["id"]: p for p in stub.handled}
+        assert dispatched[0]["trace_id"] == "client-0001"
+        assert all("trace_id" in p for p in stub.handled)
+    finally:
+        router.close()
+
+
+def test_router_error_rows_carry_trace_id():
+    from accelerate_tpu.serving.replica import ReplicaHandle
+    from accelerate_tpu.serving.router import Router
+
+    class DeadStub(ReplicaHandle):
+        def __init__(self):
+            super().__init__(0, "http://stub/0")
+            self.state = "ready"
+
+    router = Router([DeadStub()], health_interval=60.0)
+    try:
+        router.stop_admission()
+        ticket = router.submit({"id": 9, "prompt": [1] * 16})
+        assert ticket.done.wait(timeout=10.0)
+        assert "error" in ticket.result
+        assert valid_trace_id(ticket.result["trace_id"])
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# real-process acceptance: a client-supplied trace_id survives the router
+# subprocess → replica row → trace file verbatim, and the merged fleet
+# timeline stitches it with zero orphan flows
+# ---------------------------------------------------------------------------
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("ACCELERATE_TELEMETRY", None)
+    return env
+
+
+def test_route_cli_trace_id_survives_verbatim(tmp_path):
+    logdir = tmp_path / "fleet"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "route", "--replicas", "1", "--logging-dir", str(logdir),
+         "--health-interval", "0.2",
+         "--preset", "tiny", "--num-slots", "2", "--block-size", "8",
+         "--max-seq-len", "64", "--prefill-chunk", "8", "--decode-burst", "2"],
+        env=_cli_env(), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    results = []
+
+    def read():
+        for line in proc.stdout:
+            if line.strip():
+                results.append(line.strip())
+
+    threading.Thread(target=read, daemon=True).start()
+    tid = "cafef00d-e2e-0001"
+    try:
+        proc.stdin.write(json.dumps(
+            {"id": 0, "prompt": [1, 7, 3], "max_new_tokens": 4, "trace_id": tid}
+        ) + "\n")
+        proc.stdin.write(json.dumps(
+            {"id": 1, "prompt": [2, 7, 3], "max_new_tokens": 4}
+        ) + "\n")
+        proc.stdin.flush()
+        deadline = time.monotonic() + 240
+        while len(results) < 2 and time.monotonic() < deadline and proc.poll() is None:
+            time.sleep(0.1)
+        proc.stdin.close()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert rc == 0
+    rows = {r["id"]: r for r in map(json.loads, results)}
+    # verbatim through submit → HTTP hop → engine → answer row
+    assert rows[0]["trace_id"] == tid
+    assert valid_trace_id(rows[1]["trace_id"])
+
+    # ... and verbatim in BOTH processes' trace files
+    router_flows = collect_request_flows(
+        paths=[str(p) for p in (logdir / "traces").glob("host_*.trace.json")]
+    )
+    replica_flows = collect_request_flows(
+        paths=[str(p) for p in logdir.glob("replica_*/traces/host_*.trace.json")]
+    )
+    assert tid in router_flows and tid in replica_flows
+
+    # the stitched fleet timeline: cross-process flows, zero orphans,
+    # exactly-once engine finish per request
+    merged = merge_traces(
+        paths=[str(p) for p in sorted(logdir.glob("**/host_*.trace.json"))],
+        output_path=str(tmp_path / "merged.json"),
+    )
+    validate_chrome_trace(merged)
+    flows = merged["metadata"]["request_flows"]
+    assert flows["trace_ids"] == 2
+    assert flows["cross_process"] == 2
+    assert flows["orphan_flows"] == 0
+
+    report = tail_report(str(logdir), k=5)
+    assert report["measured_requests"] == 2 and report["incomplete"] == 0
+    tail_by_id = {t["trace_id"]: t for t in report["tail"]}
+    # trace tail reproduces the engine-reported TTFT within 5ms
+    assert abs(tail_by_id[tid]["ttft_s"] - rows[0]["ttft_s"]) < 0.005
