@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke flight-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -62,3 +62,6 @@ spec-smoke:       ## speculative serving: spec-on vs spec-off interleaved legs o
 
 reqtrace-smoke:   ## request tracing: 2-replica routed fleet -> every request stitched cross-process under one trace_id, zero orphan flows, exactly-once finishes, trace-tail TTFT within 5ms, exemplar scrape round-trips
 	python benchmarks/reqtrace_smoke.py
+
+flight-smoke:     ## flight recorder: live serve + mid-traffic /profile window -> phase sums == wall on every iteration, trace-tail host fraction agrees with stats(), artifacts land, decode_compiles stays 1
+	python benchmarks/flight_smoke.py

@@ -6,8 +6,8 @@ import argparse
 import sys
 
 from . import (
-    config, env, estimate, launch, lint, merge, metrics, monitor, racecheck,
-    route, serve, shardcheck, test, tpu,
+    config, env, estimate, launch, lint, merge, metrics, monitor, profile,
+    racecheck, route, serve, shardcheck, test, tpu,
 )
 
 
@@ -18,7 +18,7 @@ def main(argv: list[str] | None = None) -> int:
         allow_abbrev=False,
     )
     subparsers = parser.add_subparsers(dest="command")
-    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, racecheck, route, serve, shardcheck, tpu):
+    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, profile, racecheck, route, serve, shardcheck, tpu):
         module.add_parser(subparsers)
 
     args = parser.parse_args(argv)

@@ -90,6 +90,7 @@ def monitor_command(args) -> int:
 
 def trace_merge_command(args) -> int:
     from ..diagnostics.tracing import (
+        discover_profile_artifacts,
         discover_trace_files,
         merge_traces,
         validate_chrome_trace,
@@ -117,25 +118,51 @@ def trace_merge_command(args) -> int:
             f"({flows['cross_process']} cross-process, "
             f"{flows['orphan_flows']} orphan flow event(s))"
         )
+    profile_text = ""
+    profiles = discover_profile_artifacts(trace_dir)
+    if profiles:
+        profile_text = (
+            f"\n{len(profiles)} on-demand profiler capture(s) "
+            "(jax-profiler artifacts + flight windows):\n"
+            + "\n".join(f"  {p}" for p in profiles)
+        )
     print(
         f"merged {len(trace['traceEvents'])} events from "
         f"{len(hosts) or '?'} process(es) -> {output}{flow_text}\n"
-        f"open in https://ui.perfetto.dev or chrome://tracing"
+        f"open in https://ui.perfetto.dev or chrome://tracing" + profile_text
     )
     return 0
 
 
 def trace_tail_command(args) -> int:
-    """Tail-latency attribution over the slowest K requests — exit 1 when
-    the directory holds no request-scoped trace events at all (tracing was
-    off, or the run predates request tracing)."""
+    """Tail-latency attribution over the slowest K requests (or, with
+    ``--iterations``, the slowest K engine iterations by wall time with
+    host-vs-device phase attribution) — exit 1 when the directory holds
+    no matching trace events at all (tracing was off, or the run predates
+    this instrumentation)."""
     import json as _json
 
-    from ..diagnostics.reqtrace import render_tail_report, tail_report
+    from ..diagnostics.reqtrace import (
+        iteration_report,
+        render_iteration_report,
+        render_tail_report,
+        tail_report,
+    )
 
     if not os.path.isdir(args.logging_dir):
         print(f"trace tail: {args.logging_dir} is not a directory", file=sys.stderr)
         return 1
+    if getattr(args, "iterations", False):
+        try:
+            report = iteration_report(args.logging_dir, k=args.k)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"trace tail: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(_json.dumps(report, indent=2))
+        else:
+            print(render_iteration_report(report))
+        return 0 if report["iterations"] else 1
     try:
         report = tail_report(args.logging_dir, k=args.k, metric=args.metric)
     except (FileNotFoundError, ValueError) as e:
@@ -187,6 +214,11 @@ def add_parser(subparsers):
     tail.add_argument("-k", type=int, default=10, help="tail size (default 10)")
     tail.add_argument("--metric", choices=("ttft", "tpot"), default="ttft",
                       help="latency metric ranking the tail (default ttft)")
+    tail.add_argument("--iterations", action="store_true",
+                      help="rank engine iterations instead of requests: "
+                      "slowest-K by wall time with per-phase host-vs-device "
+                      "attribution from the flight recorder's serve/flight "
+                      "events")
     tail.add_argument("--json", action="store_true",
                       help="machine-readable report instead of the table")
     tail.set_defaults(func=trace_tail_command)

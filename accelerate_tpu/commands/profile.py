@@ -1,0 +1,165 @@
+"""``accelerate-tpu profile`` — trigger an on-demand profiler window on a
+live serving engine (or a whole routed fleet) from the outside.
+
+The serve front end exposes ``GET /profile?seconds=N``: the replica runs a
+``jax.profiler`` capture for N seconds *while it keeps serving* and dumps
+the flight-recorder iterations that landed inside the window, both under
+its ``logging_dir/profiles/``. This command is the client side:
+
+* ``accelerate-tpu profile http://127.0.0.1:8400 --seconds 2`` hits one
+  replica directly;
+* ``accelerate-tpu profile <logging_dir> --seconds 2`` reads the router's
+  fleet trail (``router/replicas.jsonl``) and fans the trigger out to
+  EVERY live replica concurrently — the captures share one wall-clock
+  window, so the per-replica timelines line up when compared.
+
+Artifacts are discovered afterwards by ``accelerate-tpu trace merge``
+(which lists ``profiles/profile_*`` directories beside the merged
+timeline). This module never imports jax — it runs from any host that can
+reach the replicas' HTTP ports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+#: replica states worth profiling — a `dead`/`terminated` row's base_url
+#: points at nothing, and `draining` replicas are on their way out
+_LIVE_STATES = frozenset(("ready", "starting", "draining"))
+
+
+def discover_replica_urls(logging_dir: str) -> list[str]:
+    """Live replicas' base URLs from the router's fleet trail — newest row
+    per replica identity wins (a respawned replica's fresh ``ready`` row
+    supersedes its predecessor's ``dead`` one)."""
+    trail = os.path.join(logging_dir, "router", "replicas.jsonl")
+    if not os.path.exists(trail):
+        return []
+    latest: dict[int, dict] = {}
+    try:
+        with open(trail) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                rid = row.get("replica_id")
+                if rid is None:  # aggregate kind="router" totals row
+                    continue
+                latest[rid] = row
+    except OSError:
+        return []
+    return [
+        str(row["base_url"]).rstrip("/")
+        for _rid, row in sorted(latest.items())
+        if row.get("base_url") and row.get("state") in _LIVE_STATES
+    ]
+
+
+def _profile_one(url: str, seconds: float, timeout: float) -> dict:
+    """One replica's ``GET /profile`` round trip; error dicts, never
+    raises (a fleet fan-out must report per-replica outcomes)."""
+    target = f"{url}/profile?seconds={seconds:g}"
+    try:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            return {"url": url, "ok": True, **json.loads(resp.read())}
+    except Exception as e:  # noqa: BLE001 — per-replica outcome, not fatal
+        return {"url": url, "ok": False, "error": str(e)}
+
+
+def profile_fleet(urls: list[str], seconds: float) -> list[dict]:
+    """Fan the capture out to every URL concurrently so all replicas
+    profile the SAME wall-clock window (sequential triggers would capture
+    disjoint slices of fleet time)."""
+    timeout = seconds + 30.0
+    results: list[dict | None] = [None] * len(urls)
+
+    def run(i: int, url: str):
+        results[i] = _profile_one(url, seconds, timeout)
+
+    threads = [
+        threading.Thread(target=run, args=(i, url), daemon=True)
+        for i, url in enumerate(urls)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30.0)
+    return [
+        r if r is not None else {"url": urls[i], "ok": False, "error": "timed out"}
+        for i, r in enumerate(results)
+    ]
+
+
+def profile_command(args) -> int:
+    target = args.target
+    if target.startswith(("http://", "https://")):
+        urls = [target.rstrip("/")]
+    else:
+        if not os.path.isdir(target):
+            print(f"profile: {target} is not a directory or URL", file=sys.stderr)
+            return 1
+        urls = discover_replica_urls(target)
+        if not urls:
+            print(
+                f"profile: no live replicas in {target}/router/replicas.jsonl "
+                "— is `accelerate-tpu route --logging-dir` running? (or pass "
+                "a replica URL directly)",
+                file=sys.stderr,
+            )
+            return 1
+    results = profile_fleet(urls, args.seconds)
+    if args.json:
+        print(json.dumps(results, indent=2))
+    else:
+        for r in results:
+            if r["ok"]:
+                print(
+                    f"{r['url']}: {r.get('flight_iterations', 0)} iteration(s) "
+                    f"in {r.get('seconds', 0.0):.2f}s window"
+                    + (
+                        f", host fraction {r['host_fraction']:.1%}"
+                        if r.get("host_fraction") is not None
+                        else ""
+                    )
+                    + f" -> {r.get('profile_dir')}"
+                )
+            else:
+                print(f"{r['url']}: FAILED — {r.get('error')}")
+    failed = sum(1 for r in results if not r["ok"])
+    if failed:
+        print(
+            f"profile: {failed}/{len(results)} replica(s) failed",
+            file=sys.stderr,
+        )
+    return 1 if failed == len(results) else 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "profile",
+        help="Trigger an on-demand jax-profiler + flight-recorder window on "
+        "a live serving engine (URL) or every replica of a routed fleet "
+        "(logging dir)",
+    )
+    p.add_argument(
+        "target",
+        help="a replica base URL (http://host:port) or a routed fleet's "
+        "logging dir (replicas discovered from router/replicas.jsonl)",
+    )
+    p.add_argument(
+        "--seconds", type=float, default=2.0,
+        help="capture window length (default 2.0; server clamps to "
+        "0.05-120)",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable per-replica manifests")
+    p.set_defaults(func=profile_command)
+    return p

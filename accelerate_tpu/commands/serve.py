@@ -234,9 +234,39 @@ def _make_engine(args):
             kv_dtype=args.kv_dtype,
             spec_k=args.spec_k,
             draft=args.draft,
+            flight_history=args.flight_history,
         ),
         mesh=mesh,
     )
+
+
+def _write_flight_drain(logging_dir, engine, k: int = 32) -> None:
+    """On a SIGTERM drain, persist the flight recorder's last-``k``
+    iterations beside the run's other artifacts — the post-mortem twin of
+    the watchdog's HANG_REPORT ``flight_tail``, for engines that exited
+    cleanly but slowly."""
+    if not logging_dir or engine is None:
+        return
+    fl = getattr(engine, "_flight", None)
+    if fl is None:
+        return
+    path = os.path.join(logging_dir, f"FLIGHT_DRAIN_{os.getpid()}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "type": "flight_drain",
+                    "pid": os.getpid(),
+                    "ts": time.time(),
+                    "current_phase": fl.current_phase,
+                    "iterations": fl.iterations,
+                    "host_fraction": fl.host_fraction(),
+                    "entries": fl.tail(k),
+                },
+                f, indent=2,
+            )
+    except OSError:
+        pass
 
 
 def _result_dict(req, req_id) -> dict:
@@ -414,7 +444,8 @@ def serve_command(args) -> int:
             try:
                 return _serve_http(build_engine, inbox, stop,
                                    args.http, health=health, handler=handler,
-                                   chaos=chaos, max_queue=args.max_queue)
+                                   chaos=chaos, max_queue=args.max_queue,
+                                   logging_dir=args.logging_dir)
             except _PreflightRefusal as e:
                 # SP004 pre-flight refusal (or invalid geometry): an error
                 # row + exit 2, the same contract as shard-check
@@ -459,6 +490,8 @@ def serve_command(args) -> int:
             pass
         stats = engine.stats()
         drained = " (drained on SIGTERM)" if health.draining else ""
+        if health.draining:
+            _write_flight_drain(args.logging_dir, engine)
         print(
             f"served {stats['completed']} requests, "
             f"{stats['tokens_emitted']} tokens "
@@ -472,13 +505,16 @@ def serve_command(args) -> int:
 
 
 def _serve_http(engine, inbox, stop, port, health=None, handler=None,
-                chaos=None, max_queue=None) -> int:
+                chaos=None, max_queue=None, logging_dir=None) -> int:
     """Minimal local HTTP front end: POST /generate blocks until the
     request completes (400 on a rejected one, 503 while starting or
     draining); GET /healthz answers the lifecycle state machine +
     queue/slot gauges; GET /stats returns engine health JSON; GET /metrics
     answers OpenMetrics text from the active registry (refreshed from
-    ``engine.stats()`` on each scrape).
+    ``engine.stats()`` on each scrape); GET /profile?seconds=N captures an
+    on-demand jax-profiler window + flight-recorder dump into
+    ``logging_dir/profiles/`` while the engine keeps serving (409 when a
+    capture is already running, 400 without a logging dir).
 
     ``chaos`` (a :class:`~accelerate_tpu.serving.chaos.ChaosInjector`)
     injects scheduled faults at this boundary: ``kill``/``stop``/``delay``
@@ -500,6 +536,10 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
     box = {"engine": None if callable(engine) else engine}
 
     class Handler(BaseHTTPRequestHandler):
+        #: one capture at a time — jax.profiler has a single global trace
+        #: session; a concurrent request gets an explicit 409, not a crash
+        profile_lock = threading.Lock()
+
         def log_message(self, *a):  # quiet
             pass
 
@@ -526,10 +566,14 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
             self.wfile.write(body)
 
         def do_GET(self):
-            # drop any query string (Prometheus scrape params, proxies)
-            path = self.path.split("?")[0].rstrip("/")
+            # split off the query string (Prometheus scrape params,
+            # /profile?seconds=N) instead of dropping it
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/")
             if path == "/metrics":
                 self._send_metrics()
+            elif path == "/profile":
+                self._handle_profile(query)
             elif path == "/healthz":
                 if chaos is not None and chaos.healthz_blackout():
                     # injected health blackout: tear the connection — the
@@ -543,6 +587,40 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
                            else {"state": health.state})
             else:
                 self._send(404, {"error": "unknown path"})
+
+        def _handle_profile(self, query: str):
+            """On-demand windowed capture: jax.profiler trace + the flight
+            iterations that land inside the window. Runs in this handler
+            thread — the engine loop keeps stepping underneath, which is
+            the point (profile the engine *while it serves*)."""
+            eng = box["engine"]
+            if eng is None or not health.ready:
+                self._send(503, {"error": f"engine not ready: {health.state}"})
+                return
+            if not logging_dir:
+                self._send(400, {"error": "profiling needs --logging-dir"})
+                return
+            from urllib.parse import parse_qs
+
+            try:
+                seconds = float((parse_qs(query).get("seconds") or ["2.0"])[0])
+            except (TypeError, ValueError):
+                self._send(400, {"error": "seconds must be a number"})
+                return
+            seconds = min(max(seconds, 0.05), 120.0)
+            if not Handler.profile_lock.acquire(blocking=False):
+                self._send(409, {"error": "a profile capture is already running"})
+                return
+            try:
+                from ..serving.flight import capture_profile_window
+
+                manifest = capture_profile_window(logging_dir, seconds, engine=eng)
+            except Exception as e:  # noqa: BLE001 — reported, never fatal
+                self._send(500, {"error": f"profile capture failed: {e}"})
+                return
+            finally:
+                Handler.profile_lock.release()
+            self._send(200, manifest)
 
         def do_POST(self):
             if self.path.rstrip("/") != "/generate":
@@ -605,6 +683,8 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
         # build failures (the pre-flight refusal) must also unbind the
         # port — a leaked server thread answers /healthz `starting` forever
         server.shutdown()
+        if health.draining:
+            _write_flight_drain(logging_dir, box["engine"])
     return 0
 
 
@@ -713,6 +793,23 @@ def add_parser(subparsers):
                    "target's own first N layers as the draft, sharing the "
                    "target's paged pool — no second cache, no extra "
                    "weights resident")
+    try:
+        flight_default = int(
+            os.environ.get("ACCELERATE_SERVE_FLIGHT_HISTORY", "256") or 256
+        )
+    except ValueError:
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_FLIGHT_HISTORY="
+            f"{os.environ['ACCELERATE_SERVE_FLIGHT_HISTORY']!r} (want an integer)",
+            file=sys.stderr,
+        )
+        flight_default = 256
+    p.add_argument("--flight-history", type=int, default=flight_default,
+                   help="per-iteration flight recorder ring size (default "
+                   "256; 0 disables; env ACCELERATE_SERVE_FLIGHT_HISTORY): "
+                   "host-vs-device phase attribution behind "
+                   "stats()['host_fraction'], `trace tail --iterations`, "
+                   "GET /profile, and HANG_REPORT flight tails")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
                    help="enable sampling at this temperature (default: greedy)")

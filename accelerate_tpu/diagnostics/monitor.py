@@ -239,6 +239,15 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "spec_accept_rate": last_step.get("spec_accept_rate"),
             "spec_drafted_tokens": last_step.get("spec_drafted_tokens"),
             "spec_accepted_tokens": last_step.get("spec_accepted_tokens"),
+            # flight-recorder iteration attribution + HBM watermarks
+            # (gauges riding the step rows — absent on flight_history=0)
+            "host_fraction": last_step.get("host_fraction"),
+            "iteration_p50_s": last_step.get("iteration_p50_s"),
+            "iteration_p99_s": last_step.get("iteration_p99_s"),
+            "flight_phase": last_step.get("flight_phase"),
+            "hbm_used_bytes": last_step.get("hbm_used_bytes"),
+            "hbm_headroom_bytes": last_step.get("hbm_headroom_bytes"),
+            "hbm_bytes_source": last_step.get("hbm_bytes_source"),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -283,6 +292,11 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
                     "stalled_phase": report.get("stalled_phase"),
                     "elapsed_s": report.get("elapsed_s"),
                     "ts": report.get("ts"),
+                    # serving hangs: the flight recorder names the exact
+                    # engine phase the iteration died in
+                    "flight_phase": (report.get("flight_tail") or {}).get(
+                        "current_phase"
+                    ),
                 }
             )
         except (OSError, json.JSONDecodeError):
@@ -395,6 +409,28 @@ def render_status(status: dict[str, Any]) -> str:
             f"p99 {_fmt(srv.get('ttft_p99_s'), '{:.2f}')}s)   "
             f"decode compiles {_fmt(srv['decode_compiles'], '{}')}"
         )
+        if srv.get("host_fraction") is not None:
+            hbm = ""
+            if srv.get("hbm_used_bytes") is not None:
+                hbm = (
+                    f"   hbm {srv['hbm_used_bytes'] / (1 << 30):.2f} GiB"
+                    + (
+                        f" (headroom {srv['hbm_headroom_bytes'] / (1 << 30):.2f})"
+                        if srv.get("hbm_headroom_bytes") is not None
+                        else ""
+                    )
+                    + (
+                        " [estimate]"
+                        if srv.get("hbm_bytes_source") == "estimate"
+                        else ""
+                    )
+                )
+            lines.append(
+                f"  iteration: host {_fmt(srv['host_fraction'], '{:.0%}')}   "
+                f"p50 {_fmt(srv.get('iteration_p50_s'), '{:.4f}')}s "
+                f"p99 {_fmt(srv.get('iteration_p99_s'), '{:.4f}')}s   "
+                f"phase {srv.get('flight_phase') or '?'}" + hbm
+            )
         if srv.get("kv_dtype"):
             lines.append(
                 f"  kv cache: {srv['kv_dtype']}   "
@@ -522,10 +558,13 @@ def render_status(status: dict[str, Any]) -> str:
     else:
         lines.append("  hosts: no heartbeat files (diagnostics off or run not started)")
     for r in status["hang_reports"]:
+        flight = (
+            f" (engine phase {r['flight_phase']})" if r.get("flight_phase") else ""
+        )
         lines.append(
             f"  !! HANG host {r.get('host')}: stalled in "
-            f"{r.get('stalled_phase') or '?'} after {_fmt(r.get('elapsed_s'), '{:.0f}')}s "
-            f"— {r['path']}"
+            f"{r.get('stalled_phase') or '?'} after {_fmt(r.get('elapsed_s'), '{:.0f}')}s"
+            f"{flight} — {r['path']}"
         )
     for r in status.get("race_reports") or []:
         cycle = " -> ".join(r.get("cycle") or []) or "?"

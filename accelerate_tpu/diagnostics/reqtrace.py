@@ -41,14 +41,23 @@ from .tracing import (
 
 __all__ = [
     "collect_request_flows",
+    "collect_iterations",
     "request_timeline",
     "tail_report",
+    "iteration_report",
     "render_tail_report",
+    "render_iteration_report",
     "tail_from_dir_throttled",
 ]
 
 #: TTFT phases in render order (highest-leverage first when equal)
 TTFT_PHASES = ("queued", "prefill", "swap_in", "preempted")
+
+#: the flight recorder's exclusive iteration phases, in stamp order —
+#: mirrors ``accelerate_tpu.serving.flight.ITERATION_PHASES`` (hardcoded
+#: so this reader imports without jax/the serving package; a test pins
+#: the two tuples against each other)
+ITERATION_PHASES = ("schedule", "prefill", "dispatch", "device_wait", "harvest")
 
 #: skip trails bigger than this (the monitor repaints; a multi-GB trace
 #: trail must not be re-parsed per refresh) — same contract as the goodput
@@ -104,6 +113,119 @@ def collect_request_flows(
     for events in flows.values():
         events.sort(key=lambda ev: ev["ts"])
     return flows
+
+
+def collect_iterations(
+    logging_dir: str | None = None, paths: list[str] | None = None
+) -> list[dict]:
+    """Every engine iteration's ``serve/flight`` instant under
+    ``logging_dir`` (all replicas), wall-corrected and sorted by
+    timestamp. Each dict carries ``role``/``ts`` (wall µs) plus the flight
+    entry's fields (``iteration``, ``wall_s``, ``<phase>_s``) — the same
+    numbers ``stats()`` aggregates, read back from the trace trail."""
+    if paths is None:
+        paths = discover_trace_files(logging_dir)
+    iterations: list[dict] = []
+    for path in paths:
+        role = os.path.basename(os.path.dirname(os.path.dirname(path))) or path
+        for e, offset_us in iter_offset_events(parse_trace_file(path)):
+            if e.get("ph") == "M":
+                args = e.get("args") or {}
+                if e.get("name") == "process_name" and args.get("name"):
+                    role = str(args["name"])
+                continue
+            if e.get("name") != "serve/flight" or e.get("ph") != "i":
+                continue
+            args = e.get("args") or {}
+            try:
+                ts = float(e.get("ts", 0.0)) + offset_us
+                wall = float(args["wall_s"])
+                phases = {p: float(args[f"{p}_s"]) for p in ITERATION_PHASES}
+            except (KeyError, TypeError, ValueError):
+                continue  # foreign/older trail row: skip, never raise
+            row = {"role": role, "ts": ts,
+                   "iteration": args.get("iteration"), "wall_s": wall}
+            for p in ITERATION_PHASES:
+                row[f"{p}_s"] = phases[p]
+            iterations.append(row)
+    iterations.sort(key=lambda r: r["ts"])
+    return iterations
+
+
+def iteration_report(
+    logging_dir: str | None = None,
+    paths: list[str] | None = None,
+    k: int = 10,
+) -> dict:
+    """The slowest-``k`` engine iterations by wall time with per-phase
+    attribution over that tail, plus the cumulative host-vs-device split
+    over *all* recorded iterations — computed exactly like the engine's
+    ``stats()['host_fraction']`` (1 − Σdevice_wait/Σwall), so the two
+    surfaces agree on the ROADMAP item-5 number by construction."""
+    rows = collect_iterations(logging_dir, paths=paths)
+    wall_total = sum(r["wall_s"] for r in rows)
+    phase_totals = {
+        p: sum(r[f"{p}_s"] for r in rows) for p in ITERATION_PHASES
+    }
+    host_fraction = (
+        1.0 - phase_totals["device_wait"] / wall_total if wall_total > 0 else 0.0
+    )
+    tail = sorted(rows, key=lambda r: -r["wall_s"])[: max(1, int(k))]
+    attribution: dict[str, float] = {}
+    tail_wall = sum(r["wall_s"] for r in tail)
+    if tail_wall > 0:
+        attribution = {
+            p: 100.0 * sum(r[f"{p}_s"] for r in tail) / tail_wall
+            for p in ITERATION_PHASES
+        }
+    return {
+        "iterations": len(rows),
+        "k": len(tail) if rows else 0,
+        "wall_total_s": wall_total,
+        "phase_totals_s": phase_totals,
+        "host_fraction": host_fraction,
+        "device_fraction": 1.0 - host_fraction,
+        "tail": tail if rows else [],
+        "attribution": attribution,
+    }
+
+
+def render_iteration_report(report: dict) -> str:
+    """Terminal table for ``accelerate-tpu trace tail --iterations`` —
+    the host-vs-device attribution the async-engine refactor is judged
+    against."""
+    lines = [
+        f"{report['iterations']} engine iteration(s) traced, "
+        f"{report['wall_total_s']:.4f}s wall: "
+        f"host {100.0 * report['host_fraction']:.1f}%  "
+        f"device {100.0 * report['device_fraction']:.1f}%"
+    ]
+    if report["attribution"]:
+        lines.append(
+            "slowest-tail attribution: "
+            + "   ".join(
+                f"{phase} {pct:.1f}%"
+                for phase, pct in sorted(
+                    report["attribution"].items(), key=lambda kv: -kv[1]
+                )
+            )
+        )
+    if report["tail"]:
+        lines.append(
+            f"  {'role':<12} {'iter':>6} {'wall_s':>9} "
+            + " ".join(f"{p:>11}" for p in ITERATION_PHASES)
+        )
+        for r in report["tail"]:
+            lines.append(
+                f"  {str(r['role'])[:12]:<12} "
+                f"{str(r.get('iteration') if r.get('iteration') is not None else '-'):>6} "
+                f"{r['wall_s']:>9.5f} "
+                + " ".join(f"{r[f'{p}_s']:>11.5f}" for p in ITERATION_PHASES)
+            )
+    else:
+        lines.append("  (no iteration events — is tracing armed and "
+                     "flight_history > 0?)")
+    return "\n".join(lines)
 
 
 def _first(events: list[dict], name: str) -> dict | None:

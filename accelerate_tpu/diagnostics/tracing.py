@@ -607,6 +607,26 @@ def discover_trace_files(logging_dir: str) -> list[str]:
     return seen
 
 
+def discover_profile_artifacts(logging_dir: str) -> list[str]:
+    """Every on-demand profiler capture directory a run (or fleet) left
+    under ``logging_dir`` — the ``profiles/profile_<stamp>_<pid>/`` dirs
+    :func:`accelerate_tpu.serving.flight.capture_profile_window` writes,
+    per replica for a fleet — so ``trace merge`` can point the operator
+    at the jax-profiler artifacts riding beside the merged timeline."""
+    import glob as _glob
+
+    pats = (
+        os.path.join(logging_dir, "profiles", "profile_*"),
+        os.path.join(logging_dir, "replica_*", "profiles", "profile_*"),
+    )
+    seen: list[str] = []
+    for pat in pats:
+        for path in sorted(_glob.glob(pat)):
+            if os.path.isdir(path) and path not in seen:
+                seen.append(path)
+    return seen
+
+
 def iter_offset_events(events):
     """Yield ``(event, offset_us)`` pairs where ``offset_us`` is the most
     recent ``clock_sync``'s wall-minus-monotonic offset — applied

@@ -103,6 +103,28 @@ def _device_memory() -> dict[str, Any] | None:
         return None
 
 
+def _flight_tail(k: int = 8) -> dict[str, Any] | None:
+    """The serving engine's last-``k`` flight-recorder iterations + the
+    phase it is in RIGHT NOW — a wedged engine's hang report names
+    whether it died scheduling, dispatching, or waiting on the device.
+    None outside a serving process (lazy import: the watchdog must not
+    drag the serving package — and jax — into training-only hosts)."""
+    try:
+        from ..serving.flight import get_active_flight_recorder
+
+        fl = get_active_flight_recorder()
+        if fl is None:
+            return None
+        return {
+            "current_phase": fl.current_phase,
+            "iterations": fl.iterations,
+            "host_fraction": fl.host_fraction(),
+            "entries": fl.tail(k),
+        }
+    except Exception:
+        return None
+
+
 class Watchdog:
     """Arms a progress deadline around the training loop; see module doc.
 
@@ -372,6 +394,7 @@ class Watchdog:
             "threads": _thread_stacks(),
             "telemetry_tail": tail,
             "device_memory": _device_memory(),
+            "flight_tail": _flight_tail(),
         }
 
     # -- heartbeats ----------------------------------------------------------

@@ -24,6 +24,7 @@ __all__ = [
     "observe_record",
     "observe_span",
     "observe_engine_stats",
+    "observe_flight",
     "observe_hang",
     "observe_router_row",
 ]
@@ -152,6 +153,40 @@ _SPEC_ACCEPT_GAUGE = (
     "spec_accept_rate", "serving_spec_accept_rate",
     "Accepted / drafted speculative tokens (0-1, run-cumulative)",
 )
+#: flight-recorder / device-memory gauges — one-table-two-surfaces again:
+#: telemetry step rows and ``observe_engine_stats`` both splice this in.
+#: Mirrors ``accelerate_tpu.serving.flight.ITERATION_PHASES`` semantics
+#: (hardcoded here so this module stays importable without the serving
+#: package; a test pins the tuple against the recorder's).
+_FLIGHT_PHASES = ("schedule", "prefill", "dispatch", "device_wait", "harvest")
+_FLIGHT_GAUGES = (
+    ("host_fraction", "serving_host_fraction",
+     "1 - device_wait/wall over recorded iterations (flight recorder)"),
+    ("iteration_p50_s", "serving_iteration_p50_seconds",
+     "Median engine iteration wall time over the flight ring"),
+    ("iteration_p99_s", "serving_iteration_p99_seconds",
+     "p99 engine iteration wall time over the flight ring"),
+    ("hbm_used_bytes", "serving_hbm_used_bytes",
+     "Device memory in use (memory_stats, else static params+pools estimate)"),
+    ("hbm_headroom_bytes", "serving_hbm_headroom_bytes",
+     "Device memory limit minus bytes in use (when a limit is known)"),
+)
+
+
+def observe_flight(registry, entry: dict) -> None:
+    """One flight-recorder iteration entry → the per-phase iteration
+    histogram (phase label vocabulary is the fixed
+    :data:`_FLIGHT_PHASES` + ``total``, so cardinality stays bounded)."""
+    hist = registry.histogram(
+        "serving_iteration_seconds",
+        "Engine iteration wall time decomposed by flight-recorder phase",
+        buckets=_LATENCY_BUCKETS,
+    )
+    if _num(entry.get("wall_s")) is not None:
+        hist.observe(entry["wall_s"], phase="total")
+    for p in _FLIGHT_PHASES:
+        if _num(entry.get(f"{p}_s")) is not None:
+            hist.observe(entry[f"{p}_s"], phase=p)
 
 
 def _observe_serving(registry, record: dict) -> None:
@@ -200,6 +235,7 @@ def _observe_serving(registry, record: dict) -> None:
             _PREFIX_HIT_GAUGE,
             *_KV_GAUGES,
             _SPEC_ACCEPT_GAUGE,
+            *_FLIGHT_GAUGES,
         ):
             if _num(record.get(field)) is not None:
                 registry.gauge(name, help).set(record[field])
@@ -311,7 +347,9 @@ def observe_engine_stats(registry, stats: dict) -> None:
         registry.counter("serving_iterations", "Engine scheduler iterations").set_total(
             stats["iterations"]
         )
-    for field, name, help in (_PREFIX_HIT_GAUGE, *_KV_GAUGES, _SPEC_ACCEPT_GAUGE):
+    for field, name, help in (
+        _PREFIX_HIT_GAUGE, *_KV_GAUGES, _SPEC_ACCEPT_GAUGE, *_FLIGHT_GAUGES
+    ):
         if _num(stats.get(field)) is not None:
             registry.gauge(name, help).set(stats[field])
     for field, name, help in (*_SHARING_COUNTERS, *_SPEC_COUNTERS):

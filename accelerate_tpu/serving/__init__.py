@@ -14,6 +14,12 @@ no accelerator, so those names are NOT imported here eagerly — use
 
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .engine import EngineConfig, InferenceEngine
+from .flight import (
+    ITERATION_PHASES,
+    FlightRecorder,
+    get_active_flight_recorder,
+    set_active_flight_recorder,
+)
 from .radix import RadixCache, SwapPool
 from .scheduler import PRIORITY_CLASSES, Request, RequestState, SlotScheduler
 from .spec import DraftSpec, parse_draft_spec
@@ -24,7 +30,11 @@ __all__ = [
     "blocks_needed",
     "DraftSpec",
     "EngineConfig",
+    "FlightRecorder",
+    "ITERATION_PHASES",
     "InferenceEngine",
+    "get_active_flight_recorder",
+    "set_active_flight_recorder",
     "PRIORITY_CLASSES",
     "RadixCache",
     "Request",

@@ -46,8 +46,11 @@ import numpy as np
 from ..analysis.sanitizer import get_active_sanitizer as _get_sanitizer
 from ..diagnostics.tracing import ensure_trace_id, get_tracer, trace_span, valid_trace_id
 from ..generation import _pick_traced
+from ..metrics.ingest import observe_flight
+from ..metrics.registry import get_active_registry
 from ..telemetry import get_active_recorder
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
+from .flight import FlightRecorder, set_active_flight_recorder
 from .radix import RadixCache, SwapPool
 from .scheduler import Request, RequestState, SlotScheduler, priority_rank
 
@@ -81,6 +84,15 @@ class EngineConfig:
     decode_burst: int = 8
     #: emit a telemetry "serving" row every N iterations (0 disables)
     stats_interval: int = 32
+    #: per-iteration flight recorder ring size (0 disables): every
+    #: iteration's wall time decomposed into exclusive phases (schedule /
+    #: prefill / dispatch / device_wait / harvest) whose durations are
+    #: asserted to sum to the measured wall time — the host-vs-device
+    #: attribution ``stats()['host_fraction']``, ``trace tail
+    #: --iterations``, ``/profile`` windows, and HANG_REPORT forensics
+    #: all read. Stamps are five perf_counter reads per iteration; the
+    #: disabled path is one ``is None`` check.
+    flight_history: int = 256
     #: finished :class:`Request` objects retained for ``stats()``
     #: percentiles — a *ring*, not a list: a long-lived serve process must
     #: not leak every completed request (nor rescan an unbounded history
@@ -330,6 +342,34 @@ class InferenceEngine:
         # counted in neither)
         self._spec_drafted = 0
         self._spec_accepted = 0
+        # per-iteration flight recorder (None = disabled: step() pays one
+        # `is None` check and nothing else). Registered process-globally
+        # so the watchdog's HANG_REPORT and the /profile dump can reach
+        # the ring without holding an engine reference.
+        self._flight = (
+            FlightRecorder(cfg.flight_history) if cfg.flight_history else None
+        )
+        if self._flight is not None:
+            set_active_flight_recorder(self._flight)
+        # mid-iteration stamps _decode_once/_spec_decode_dispatch set
+        # around the harvest device_get (the device-wait boundary); reset
+        # at the top of each iteration, None when no decode lanes ran
+        self._fl_dispatch_done: float | None = None
+        self._fl_wait_done: float | None = None
+        # static HBM model for the hbm watermark fallback: params + the
+        # paged pools (+ scales), the same inventory the PR 8 preflight
+        # prices — used verbatim when the backend has no memory_stats()
+        self._static_hbm_bytes = int(
+            sum(
+                np.size(x) * np.dtype(getattr(x, "dtype", np.float32)).itemsize
+                for x in jax.tree_util.tree_leaves(self._params)
+            )
+            + sum(
+                p.size * np.dtype(p.dtype).itemsize
+                for p in (self._kp, self._vp, self._ks, self._vs)
+                if p is not None
+            )
+        )
 
         self._decode_fn = (
             self._build_spec_decode_fn() if self._spec else self._build_decode_fn()
@@ -713,6 +753,15 @@ class InferenceEngine:
         sched = self.scheduler
         finished: list[Request] = []
 
+        # flight stamps telescope (each phase = diff of consecutive
+        # perf_counter reads) so they sum to the iteration wall exactly;
+        # disabled path is this single `is None` check
+        fl = self._flight
+        if fl is not None:
+            self._fl_dispatch_done = self._fl_wait_done = None
+            fl.current_phase = "schedule"
+            t0 = time.perf_counter()
+
         with trace_span("serve/schedule"):
             if sched.deadline_live:  # guarded: deadline-free = one int check
                 for req in sched.expire_deadlines():
@@ -723,6 +772,10 @@ class InferenceEngine:
             sched.evict_finished()
             self._admit_and_place()
 
+        if fl is not None:
+            fl.current_phase = "prefill"
+            t1 = time.perf_counter()
+
         with trace_span("serve/prefill"):
             # one chunk per PREFILLING SLOT per iteration: slot turnover is
             # never throttled to one admission per decode burst, while any
@@ -731,10 +784,25 @@ class InferenceEngine:
             for req in sched.active(RequestState.PREFILL):
                 self._prefill_one_chunk(req, finished)
 
+        if fl is not None:
+            fl.current_phase = "dispatch"
+            t2 = time.perf_counter()
+
         decoding = sched.active(RequestState.DECODE)
         if decoding:
             with trace_span("serve/decode", slots=len(decoding)):
                 self._decode_once(decoding, finished)
+
+        if fl is not None:
+            # _decode_once stamped the device_get boundary on self; an
+            # iteration with no decode lanes telescopes both phases to 0
+            t3 = self._fl_dispatch_done
+            if t3 is None:
+                t3 = time.perf_counter()
+            t4 = self._fl_wait_done
+            if t4 is None:
+                t4 = t3
+            fl.current_phase = "harvest"
 
         self._iterations += 1
         self._occupancy_sum += sched.occupancy
@@ -751,6 +819,27 @@ class InferenceEngine:
                     ttft_s=req.ttft_s, tpot_s=req.tpot_s,
                 )
         self._emit_telemetry(finished)
+        if fl is not None:
+            t5 = time.perf_counter()
+            entry = fl.record(
+                self._iterations, t0, t5 - t0,
+                schedule=t1 - t0, prefill=t2 - t1, dispatch=t3 - t2,
+                device_wait=t4 - t3, harvest=t5 - t4,
+            )
+            fl.current_phase = "idle"
+            reg = get_active_registry()
+            if reg:
+                observe_flight(reg, entry)
+            if self._tr is not None:
+                # host share over time as a Perfetto counter track, plus
+                # one instant per iteration carrying the phase breakdown
+                # (the wall-corrected reader behind `trace tail
+                # --iterations` consumes these)
+                self._tr.counter("serve/iteration", fl.host_fraction())
+                self._tr.instant(
+                    "serve/flight",
+                    **{k: v for k, v in entry.items() if k != "t_start"},
+                )
         return finished
 
     def run_until_idle(self, max_iterations: int | None = None) -> list[Request]:
@@ -808,6 +897,43 @@ class InferenceEngine:
         if self.radix is not None:
             self.radix.evicted_blocks = 0
             self.radix.inserted_blocks = 0
+        # the flight ring is measurement state like everything above: a
+        # warmup→reset→measure cycle must report post-reset iterations
+        # only, for stats()['host_fraction'] and the ring both
+        if self._flight is not None:
+            self._flight.reset()
+
+    def _hbm_watermarks(self) -> dict:
+        """Live device-memory watermarks where the backend exposes them
+        (``Device.memory_stats()`` — TPU/GPU runtimes), else the static
+        params+pools model the PR 8 preflight prices, labelled
+        ``"estimate"`` so a CPU reading is never mistaken for a real
+        high-water mark. Headroom appears when a limit is known (backend
+        ``bytes_limit`` or the configured ``hbm_budget_gb``)."""
+        used = peak = limit = None
+        source = "estimate"
+        try:
+            mem = jax.local_devices()[0].memory_stats()
+            if mem and "bytes_in_use" in mem:
+                used = int(mem["bytes_in_use"])
+                peak = int(mem.get("peak_bytes_in_use", used))
+                limit = int(mem["bytes_limit"]) if "bytes_limit" in mem else None
+                source = "memory_stats"
+        except Exception:
+            pass
+        if used is None:
+            used = peak = self._static_hbm_bytes
+        if limit is None and self.config.hbm_budget_gb is not None:
+            limit = int(self.config.hbm_budget_gb * (1 << 30))
+        out = {
+            "hbm_used_bytes": used,
+            "hbm_peak_bytes": peak,
+            "hbm_bytes_source": source,
+        }
+        if limit is not None:
+            out["hbm_limit_bytes"] = limit
+            out["hbm_headroom_bytes"] = limit - used
+        return out
 
     def _spec_stats(self) -> dict:
         """Speculative health fields (accept rate is the TPOT lever — each
@@ -879,6 +1005,11 @@ class InferenceEngine:
             "deadline_expired_total": self._deadline_expired,
         }
         out.update(self._spec_stats())
+        out.update(self._hbm_watermarks())
+        if self._flight is not None:
+            # host_fraction + iteration p50/p99 + per-phase breakdowns
+            # over the ring window (empty until an iteration records)
+            out.update(self._flight.summary())
         if self.radix is not None:
             out["radix_inserted_blocks"] = self.radix.inserted_blocks
             out["radix_evicted_blocks"] = self.radix.evicted_blocks
@@ -1273,7 +1404,15 @@ class InferenceEngine:
                 active, self._key, self._temp,
             )
         self._check_one_executable(decode_sig)
+        if self._flight is not None:
+            # dispatch handed off; the harvest device_get below is the one
+            # interval where the host provably waits on the device
+            self._fl_dispatch_done = time.perf_counter()
+            self._flight.current_phase = "device_wait"
         next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
+        if self._flight is not None:
+            self._fl_wait_done = time.perf_counter()
+            self._flight.current_phase = "harvest"
         if self._tr is not None:
             # request identity on the decode timeline WITHOUT per-token
             # spans: one instant per dispatch carries the whole slot batch
@@ -1312,8 +1451,14 @@ class InferenceEngine:
                 pos0, toks, active,
             )
         self._check_one_executable(decode_sig)
+        if self._flight is not None:
+            self._fl_dispatch_done = time.perf_counter()
+            self._flight.current_phase = "device_wait"
         tok_seq = np.asarray(jax.device_get(tok_seq))  # [num_slots, k+1]
         accept = np.asarray(jax.device_get(accept))    # [num_slots]
+        if self._flight is not None:
+            self._fl_wait_done = time.perf_counter()
+            self._flight.current_phase = "harvest"
         k = self.config.spec_k
         if self._tr is not None:
             self._tr.instant(
@@ -1447,4 +1592,10 @@ class InferenceEngine:
                 out_of_blocks_total=self._out_of_blocks_total,
                 deadline_expired_total=self._deadline_expired,
                 **self._spec_stats(),
+                **self._hbm_watermarks(),
+                **(
+                    self._flight.telemetry_fields()
+                    if self._flight is not None
+                    else {}
+                ),
             )

@@ -87,3 +87,6 @@ def reset_state():
     from accelerate_tpu.analysis.sanitizer import set_active_sanitizer
 
     set_active_sanitizer(None)
+    from accelerate_tpu.serving.flight import set_active_flight_recorder
+
+    set_active_flight_recorder(None)

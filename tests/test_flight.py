@@ -1,0 +1,515 @@
+# Copyright The HuggingFace Team. All rights reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+"""Per-iteration flight recorder: phase-sum == wall-time invariant, ring
+cap + reset_stats interaction, disabled path, ``trace tail --iterations``
+math, ``/profile`` round-trip on a live serve subprocess, HANG_REPORT
+flight tails, and the fleet profile fan-out."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from accelerate_tpu.serving.flight import (
+    ITERATION_PHASES,
+    FlightRecorder,
+    get_active_flight_recorder,
+    set_active_flight_recorder,
+)
+
+# ---------------------------------------------------------------------------
+# recorder unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def _entry_phases(i):
+    """Deterministic synthetic phase durations for iteration ``i``."""
+    phases = {
+        "schedule": 0.001, "prefill": 0.002 * (i % 3), "dispatch": 0.003,
+        "device_wait": 0.010 + 0.001 * i, "harvest": 0.0005,
+    }
+    return phases, sum(phases.values())
+
+
+def test_record_asserts_phase_sum_equals_wall():
+    fl = FlightRecorder(history=8)
+    phases, wall = _entry_phases(1)
+    entry = fl.record(1, t_start=100.0, wall_s=wall, **phases)
+    assert entry["wall_s"] == pytest.approx(wall)
+    # a dropped stamp (phases missing time) is an AssertionError, not a log
+    with pytest.raises(AssertionError):
+        fl.record(2, t_start=101.0, wall_s=wall + 0.5, **phases)
+    # a wrong phase vocabulary is refused outright
+    with pytest.raises(AssertionError):
+        fl.record(3, t_start=102.0, wall_s=0.001, schedule=0.001)
+
+
+def test_ring_caps_and_totals_stay_cumulative():
+    fl = FlightRecorder(history=4)
+    total_wall = 0.0
+    for i in range(10):
+        phases, wall = _entry_phases(i)
+        fl.record(i, t_start=float(i), wall_s=wall, **phases)
+        total_wall += wall
+    assert len(fl) == 4  # bounded ring
+    assert fl.iterations == 10  # cumulative count keeps counting past it
+    assert fl.wall_total_s == pytest.approx(total_wall)
+    # host fraction is cumulative (all 10), not ring-windowed
+    dev = sum(_entry_phases(i)[0]["device_wait"] for i in range(10))
+    assert fl.host_fraction() == pytest.approx(1.0 - dev / total_wall)
+    # tail is newest-last; window filters on the start stamp
+    assert [e["iteration"] for e in fl.tail(2)] == [8, 9]
+    assert [e["iteration"] for e in fl.window(8.0)] == [8, 9]
+    summary = fl.summary()
+    assert summary["flight_window"] == 4
+    assert set(summary["iteration_phases_s"]) == set(ITERATION_PHASES)
+    fl.reset()
+    assert len(fl) == 0 and fl.iterations == 0 and fl.summary() == {}
+    assert fl.current_phase == "idle"
+
+
+def test_phase_vocabulary_pinned_across_surfaces():
+    """The jax-free readers hardcode the phase tuple — they must never
+    drift from the recorder's."""
+    from accelerate_tpu.diagnostics import reqtrace
+    from accelerate_tpu.metrics import ingest
+
+    assert ingest._FLIGHT_PHASES == ITERATION_PHASES
+    assert reqtrace.ITERATION_PHASES == ITERATION_PHASES
+
+
+def test_observe_flight_feeds_per_phase_histogram():
+    from accelerate_tpu.metrics.ingest import observe_flight
+    from accelerate_tpu.metrics.openmetrics import render_openmetrics
+    from accelerate_tpu.metrics.registry import MetricsRegistry
+
+    registry = MetricsRegistry(gate_main_process=False)
+    fl = FlightRecorder(history=4)
+    phases, wall = _entry_phases(2)
+    entry = fl.record(1, t_start=0.0, wall_s=wall, **phases)
+    observe_flight(registry, entry)
+    text = render_openmetrics(registry)
+    assert 'serving_iteration_seconds' in text
+    assert 'phase="total"' in text and 'phase="device_wait"' in text
+
+
+# ---------------------------------------------------------------------------
+# engine integration (slow lane: compiles the tiny model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4, seq=96)
+    return LlamaForCausalLM.from_config(config, seed=0)
+
+
+def _tiny_engine(tiny_model, **overrides):
+    from accelerate_tpu.serving import EngineConfig, InferenceEngine
+
+    kw = dict(num_slots=2, block_size=8, max_seq_len=96, prefill_chunk=8,
+              decode_burst=2, stats_interval=0)
+    kw.update(overrides)
+    return InferenceEngine(tiny_model, EngineConfig(**kw))
+
+
+@pytest.mark.slow
+def test_engine_phases_sum_to_wall_and_reset_clears_ring(tiny_model):
+    engine = _tiny_engine(tiny_model, flight_history=16)
+    assert get_active_flight_recorder() is engine._flight
+    # warmup leg
+    engine.add_request([1, 2, 3], max_new_tokens=8)
+    engine.run_until_idle(max_iterations=100)
+    warm_iters = engine.stats()["iterations"]
+    assert warm_iters > 0 and len(engine._flight) == min(warm_iters, 16)
+    for e in engine._flight.tail(16):
+        # the invariant record() asserts, re-checked from the outside
+        assert sum(e[f"{p}_s"] for p in ITERATION_PHASES) == pytest.approx(
+            e["wall_s"], abs=1e-6
+        )
+    # warmup -> reset -> measure reports ONLY post-reset iterations for
+    # both stats() and the ring (the satellite-6 small fix)
+    engine.reset_stats()
+    assert len(engine._flight) == 0 and engine._flight.iterations == 0
+    assert "host_fraction" not in engine.stats()
+    engine.add_request([5, 6], max_new_tokens=4)
+    engine.run_until_idle(max_iterations=100)
+    stats = engine.stats()
+    assert stats["iterations"] == engine._flight.iterations == len(engine._flight)
+    assert 0.0 < stats["host_fraction"] <= 1.0
+    assert stats["flight_window"] == stats["iterations"]
+    assert set(stats["iteration_phases_s"]) == set(ITERATION_PHASES)
+    # hbm watermarks ride stats() (estimate-labelled on CPU: no
+    # memory_stats, so the static params+pools model answers)
+    assert stats["hbm_used_bytes"] > 0
+    assert stats["hbm_bytes_source"] in ("memory_stats", "estimate")
+    assert stats["decode_compiles"] == 1
+
+
+@pytest.mark.slow
+def test_flight_disabled_path(tiny_model):
+    set_active_flight_recorder(None)
+    engine = _tiny_engine(tiny_model, flight_history=0)
+    assert engine._flight is None
+    # a disabled engine must not arm the process-global recorder either
+    assert get_active_flight_recorder() is None
+    engine.add_request([1, 2, 3], max_new_tokens=4)
+    engine.run_until_idle(max_iterations=100)
+    stats = engine.stats()
+    for key in ("host_fraction", "iteration_p50_s", "flight_window"):
+        assert key not in stats
+    # the hbm watermarks are independent of the recorder
+    assert stats["hbm_used_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# trace tail --iterations math (synthetic traces — no engine, no jax time)
+# ---------------------------------------------------------------------------
+
+
+def _write_trace(path, pid, wall_minus_mono_s, events, name=None):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rows = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": name or f"host_{pid}"}},
+        {"name": "clock_sync", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"wall_minus_mono_s": wall_minus_mono_s}},
+    ]
+    with open(path, "w") as f:
+        f.write("[\n")
+        for row in rows + events:
+            f.write(json.dumps(row) + ",\n")
+
+
+def _flight_event(i, ts, wall_s, device_wait_s, pid=0):
+    args = {"iteration": i, "wall_s": wall_s,
+            "schedule_s": 0.0, "prefill_s": 0.0, "device_wait_s": device_wait_s,
+            "harvest_s": 0.0}
+    args["dispatch_s"] = wall_s - device_wait_s
+    return {"name": "serve/flight", "ph": "i", "s": "p", "ts": ts,
+            "pid": pid, "tid": 1, "args": args}
+
+
+def test_iteration_report_math_on_synthetic_fleet(tmp_path):
+    from accelerate_tpu.diagnostics.reqtrace import (
+        iteration_report,
+        render_iteration_report,
+    )
+
+    # two replicas with skewed clocks; 3 iterations each, known split:
+    # total wall 6.0s of which device_wait 1.5s -> host fraction 0.75
+    r0 = [_flight_event(i, 1_000_000.0 * (i + 1), 1.0, 0.25, pid=10)
+          for i in range(3)]
+    r1 = [_flight_event(i, 2_000_000.0 * (i + 1), 1.0, 0.25, pid=11)
+          for i in range(3)]
+    _write_trace(str(tmp_path / "replica_0" / "traces" / "host_10.trace.json"),
+                 10, 500.0, r0, name="replica_0")
+    _write_trace(str(tmp_path / "replica_1" / "traces" / "host_11.trace.json"),
+                 11, -500.0, r1, name="replica_1")
+    report = iteration_report(str(tmp_path), k=4)
+    assert report["iterations"] == 6
+    assert report["wall_total_s"] == pytest.approx(6.0)
+    assert report["host_fraction"] == pytest.approx(0.75)
+    assert report["device_fraction"] == pytest.approx(0.25)
+    assert report["phase_totals_s"]["device_wait"] == pytest.approx(1.5)
+    assert len(report["tail"]) == 4
+    assert sum(report["attribution"].values()) == pytest.approx(100.0)
+    assert report["attribution"]["device_wait"] == pytest.approx(25.0)
+    text = render_iteration_report(report)
+    assert "host 75.0%" in text and "device 25.0%" in text
+    assert "replica_0" in text or "replica_1" in text
+    # malformed/foreign rows are skipped, never fatal
+    _write_trace(str(tmp_path / "traces" / "host_1.trace.json"), 1, 0.0, [
+        {"name": "serve/flight", "ph": "i", "ts": 5.0, "pid": 1, "tid": 0,
+         "args": {"wall_s": "not-a-number"}},
+    ])
+    assert iteration_report(str(tmp_path), k=4)["iterations"] == 6
+
+
+def test_trace_tail_iterations_cli_empty_dir_exits_1(tmp_path):
+    from accelerate_tpu.commands import monitor as monitor_cmd
+
+    class Args:
+        logging_dir = str(tmp_path)
+        k = 5
+        metric = "ttft"
+        iterations = True
+        json = False
+
+    (tmp_path / "traces").mkdir()
+    _write_trace(str(tmp_path / "traces" / "host_0.trace.json"), 0, 0.0, [])
+    assert monitor_cmd.trace_tail_command(Args()) == 1
+
+
+# ---------------------------------------------------------------------------
+# HANG_REPORT flight_tail (wedged stub — no real hang needed)
+# ---------------------------------------------------------------------------
+
+
+def test_hang_report_embeds_flight_tail():
+    from accelerate_tpu.diagnostics.watchdog import Watchdog
+
+    fl = FlightRecorder(history=8)
+    for i in range(5):
+        phases, wall = _entry_phases(i)
+        fl.record(i, t_start=float(i), wall_s=wall, **phases)
+    fl.current_phase = "device_wait"  # wedged mid-harvest-sync
+    set_active_flight_recorder(fl)
+    try:
+        report = Watchdog(floor_seconds=1.0).build_report(elapsed=9.0, deadline=1.0)
+    finally:
+        set_active_flight_recorder(None)
+    tail = report["flight_tail"]
+    assert tail["current_phase"] == "device_wait"
+    assert tail["iterations"] == 5
+    assert [e["iteration"] for e in tail["entries"]] == [0, 1, 2, 3, 4]
+    assert 0.0 < tail["host_fraction"] < 1.0
+    # no recorder armed -> the section is None, not missing
+    report = Watchdog(floor_seconds=1.0).build_report(elapsed=9.0, deadline=1.0)
+    assert report["flight_tail"] is None
+
+
+def test_monitor_renders_iteration_line_and_hang_phase(tmp_path):
+    from accelerate_tpu.diagnostics.monitor import collect_status, render_status
+
+    tel_dir = tmp_path / "telemetry"
+    tel_dir.mkdir()
+    now = time.time()
+    with open(tel_dir / "telemetry.jsonl", "w") as f:
+        f.write(json.dumps({
+            "type": "serving", "kind": "step", "iteration": 64,
+            "tokens_per_sec": 500.0, "queue_depth": 1, "slot_occupancy": 0.5,
+            "free_blocks": 9, "decode_compiles": 1, "completed_total": 4,
+            "host_fraction": 0.82, "iteration_p50_s": 0.012,
+            "iteration_p99_s": 0.040, "flight_phase": "harvest",
+            "hbm_used_bytes": float(2 << 30), "hbm_headroom_bytes": float(1 << 30),
+            "hbm_bytes_source": "estimate", "ts": now,
+        }) + "\n")
+    (tmp_path / "HANG_REPORT_0.json").write_text(json.dumps({
+        "host": 0, "stalled_phase": "serve/decode", "elapsed_s": 42.0,
+        "ts": now, "flight_tail": {"current_phase": "device_wait",
+                                   "iterations": 9, "entries": []},
+    }))
+    status = collect_status(str(tmp_path), now=now)
+    srv = status["serving"]
+    assert srv["host_fraction"] == pytest.approx(0.82)
+    assert srv["flight_phase"] == "harvest"
+    assert status["hang_reports"][0]["flight_phase"] == "device_wait"
+    text = render_status(status)
+    assert "iteration: host 82%" in text
+    assert "hbm 2.00 GiB (headroom 1.00) [estimate]" in text
+    assert "engine phase device_wait" in text
+
+
+# ---------------------------------------------------------------------------
+# /profile round-trip on a real serve subprocess + fleet fan-out stubs
+# ---------------------------------------------------------------------------
+
+_TINY_ARGS = [
+    "--preset", "tiny", "--num-slots", "2", "--block-size", "8",
+    "--max-seq-len", "96", "--prefill-chunk", "8", "--decode-burst", "2",
+]
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("ACCELERATE_TELEMETRY", None)
+    return env
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_ready(port, proc, timeout=240):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"serve exited rc={proc.returncode}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2
+            ) as r:
+                if json.loads(r.read()).get("state") == "ready":
+                    return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.25)
+    raise RuntimeError("serve never became ready")
+
+
+def test_profile_roundtrip_on_live_serve(tmp_path):
+    """GET /profile?seconds=N on a serving engine: jax-profiler artifacts
+    + the flight window land under logging_dir/profiles/, the engine keeps
+    serving through the capture, and decode_compiles==1 still holds."""
+    port = _free_port()
+    logdir = str(tmp_path / "run")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "serve", *_TINY_ARGS, "--http", str(port), "--logging-dir", logdir],
+        env=_cli_env(), stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_ready(port, proc)
+
+        def gen(i):
+            body = json.dumps(
+                {"id": i, "prompt": [1, 2, 3, 1 + i % 5], "max_new_tokens": 16}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=180) as r:
+                return json.loads(r.read())
+
+        assert gen(0)["finish_reason"] == "length"
+        # traffic runs THROUGH the capture window
+        worker = threading.Thread(
+            target=lambda: [gen(i) for i in range(1, 5)], daemon=True
+        )
+        worker.start()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/profile?seconds=0.4", timeout=120
+        ) as r:
+            manifest = json.loads(r.read())
+        worker.join(timeout=180)
+        assert manifest["profile_dir"].startswith(
+            os.path.join(logdir, "profiles")
+        )
+        flight_window = os.path.join(manifest["profile_dir"], "flight_window.json")
+        assert os.path.isfile(flight_window)
+        with open(flight_window) as f:
+            window = json.load(f)
+        assert window["phases"] == list(ITERATION_PHASES)
+        for e in window["entries"]:
+            assert sum(e[f"{p}_s"] for p in ITERATION_PHASES) == pytest.approx(
+                e["wall_s"], abs=1e-6
+            )
+        assert os.path.isfile(os.path.join(manifest["profile_dir"], "manifest.json"))
+        # the engine survived the capture and never re-traced
+        assert gen(9)["finish_reason"] == "length"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["decode_compiles"] == 1
+        assert 0.0 < stats["host_fraction"] <= 1.0
+        # bad / missing-logging-dir inputs answer with codes, not crashes
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/profile?seconds=banana", timeout=10
+            )
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # merge discovers the capture beside the stitched timeline
+        from accelerate_tpu.diagnostics.tracing import discover_profile_artifacts
+
+        assert discover_profile_artifacts(logdir) == [manifest["profile_dir"]]
+        # the offline reader agrees with the engine about the host share
+        from accelerate_tpu.diagnostics.reqtrace import iteration_report
+
+        report = iteration_report(logdir, k=5)
+        assert report["iterations"] > 0
+        assert report["host_fraction"] == pytest.approx(
+            stats["host_fraction"], abs=0.05
+        )
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)
+
+
+class _StubProfileHandler:
+    """Factory for a stub replica HTTP server answering /profile."""
+
+    @staticmethod
+    def serve(received):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                received.append(self.path)
+                body = json.dumps({
+                    "profile_dir": f"/tmp/stub{self.server.server_port}",
+                    "seconds": 0.1, "flight_iterations": 3,
+                    "host_fraction": 0.5, "artifacts": [],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+
+def test_profile_fleet_fans_out_to_stub_replicas(tmp_path):
+    from accelerate_tpu.commands.profile import (
+        discover_replica_urls,
+        profile_fleet,
+    )
+
+    received_a, received_b = [], []
+    a = _StubProfileHandler.serve(received_a)
+    b = _StubProfileHandler.serve(received_b)
+    try:
+        # fleet trail: newest row per replica wins; dead replicas and the
+        # aggregate kind="router" totals row are skipped
+        router_dir = tmp_path / "router"
+        router_dir.mkdir()
+        rows = [
+            {"replica_id": 0, "state": "dead", "base_url": "http://127.0.0.1:1/"},
+            {"replica_id": 0, "state": "ready",
+             "base_url": f"http://127.0.0.1:{a.server_port}/"},
+            {"replica_id": 1, "state": "ready",
+             "base_url": f"http://127.0.0.1:{b.server_port}"},
+            {"replica_id": 2, "state": "dead", "base_url": "http://127.0.0.1:2"},
+            {"kind": "router", "replica_id": None, "state": None},
+        ]
+        with open(router_dir / "replicas.jsonl", "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        urls = discover_replica_urls(str(tmp_path))
+        assert urls == [
+            f"http://127.0.0.1:{a.server_port}",
+            f"http://127.0.0.1:{b.server_port}",
+        ]
+        results = profile_fleet(urls, seconds=0.1)
+        assert [r["ok"] for r in results] == [True, True]
+        assert all(r["flight_iterations"] == 3 for r in results)
+        assert received_a == ["/profile?seconds=0.1"]
+        assert received_b == ["/profile?seconds=0.1"]
+        # a dead URL reports per-replica failure without sinking the rest
+        results = profile_fleet(urls + ["http://127.0.0.1:1"], seconds=0.1)
+        assert [r["ok"] for r in results] == [True, True, False]
+    finally:
+        a.shutdown()
+        b.shutdown()
