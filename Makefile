@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke flight-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke flight-smoke openai-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -65,3 +65,6 @@ reqtrace-smoke:   ## request tracing: 2-replica routed fleet -> every request st
 
 flight-smoke:     ## flight recorder: live serve + mid-traffic /profile window -> phase sums == wall on every iteration, trace-tail host fraction agrees with stats(), artifacts land, decode_compiles stays 1
 	python benchmarks/flight_smoke.py
+
+openai-smoke:     ## OpenAI front door: 2-replica routed fleet, mixed greedy/sampled/schema trace -> schema-valid JSON, seeded determinism, exactly-once SSE, error objects, one decode executable per replica
+	python benchmarks/openai_smoke.py

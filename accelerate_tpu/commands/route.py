@@ -7,7 +7,10 @@ same JSONL request protocol as ``accelerate-tpu serve`` from stdin —
 plus an optional ``"session_id"`` field for sticky placement — and writes
 one JSON result line per request. Requests on a replica that dies
 mid-stream are requeued to a surviving replica; the caller still gets
-exactly one answer per request.
+exactly one answer per request. ``--http PORT`` additionally mounts the
+OpenAI-compatible door (``/v1/completions`` + ``/v1/chat/completions``,
+:mod:`accelerate_tpu.serving.openai_api`) on the router itself, so an
+unmodified OpenAI client drives the whole fleet.
 
 SIGTERM drains: admission stops (late submissions are *answered* with an
 error row, never dropped), in-flight requests finish, every spawned
@@ -36,6 +39,7 @@ _ENGINE_FLAGS = (
     ("--temperature", "temperature"), ("--seed", "seed"),
     ("--kv-dtype", "kv_dtype"), ("--chaos-spec", "chaos_spec"),
     ("--spec-k", "spec_k"), ("--draft", "draft"),
+    ("--logprobs-topn", "logprobs_topn"),
 )
 
 
@@ -48,6 +52,102 @@ def _serve_args(args) -> list[str]:
     if getattr(args, "mesh", False):
         tail.append("--mesh")
     return tail
+
+
+def _route_http_server(router, port: int):
+    """The router's OpenAI-compatible door: ``POST /v1/completions`` +
+    ``/v1/chat/completions`` translated onto ``router.submit`` (so an
+    unmodified OpenAI client speaks to the whole fleet), plus a
+    ``GET /healthz`` fleet summary. Replicas answer whole completions, so
+    SSE streams replay ``at_completion`` — same framing, one
+    ``data: [DONE]``, exactly-once."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..serving.openai_api import OPENAI_PATHS, OpenAIFrontend
+
+    frontend = OpenAIFrontend(
+        lambda payload, cb: router.submit(payload, callback=cb),
+        streaming="at_completion",
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/healthz":
+                stats = router.stats()
+                self._send(200, {
+                    "state": "ready",
+                    "replicas": stats.get("replicas"),
+                    "queue_depth": stats.get("queue_depth"),
+                })
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            path = self.path.rstrip("/")
+            try:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+            except ValueError:
+                n = 0
+            raw = self.rfile.read(n) if n else b""
+            if path not in OPENAI_PATHS:
+                self._send(404, {"error": {
+                    "message": "unknown path", "type": "invalid_request_error",
+                    "param": None, "code": None,
+                }})
+                return
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._send(400, {"error": {
+                    "message": f"bad JSON: {e}",
+                    "type": "invalid_request_error",
+                    "param": None, "code": None,
+                }})
+                return
+            kind, *rest = frontend.handle(path, body)
+            if kind == "sse":
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for event in rest[0]:
+                        data = event.encode()
+                        self.wfile.write(
+                            f"{len(data):X}\r\n".encode() + data + b"\r\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
+            else:
+                self._send(rest[0], rest[1])
+
+    class Server(ThreadingHTTPServer):
+        request_queue_size = 128
+
+    server = Server(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    print(
+        f"route: OpenAI endpoint on http://127.0.0.1:{port}/v1 "
+        "(POST /v1/completions, /v1/chat/completions)",
+        file=sys.stderr,
+    )
+    return server
 
 
 def route_command(args) -> int:
@@ -162,6 +262,7 @@ def route_command(args) -> int:
                     for r in replicas),
         file=sys.stderr,
     )
+    http_server = _route_http_server(router, args.http) if args.http else None
 
     # SIGTERM → drain (stop admission, answer in-flight, clean exit 0);
     # the handler only raises a flag — the loop below observes it between
@@ -224,6 +325,10 @@ def route_command(args) -> int:
 
     print(f"route: draining ({drain_reason})...", file=sys.stderr)
     clean = router.drain(timeout=args.drain_timeout)
+    if http_server is not None:
+        # after drain: in-flight OpenAI requests got their callbacks; a
+        # late POST would have been answered with an admission-stopped row
+        http_server.shutdown()
     # lines that arrived while drain() ran still get an answer (an
     # admission-stopped error row), never silence; a short quiet window
     # catches a producer mid-write before the process exits
@@ -320,6 +425,16 @@ def add_parser(subparsers):
     p.add_argument("--draft", default=None,
                    help="forwarded to every replica's serve --draft "
                    "(e.g. early_exit:2)")
+    p.add_argument("--logprobs-topn", type=int, default=None,
+                   help="forwarded to every replica's serve --logprobs-topn "
+                   "(the OpenAI 'logprobs' field needs it; the fleet must "
+                   "harvest identically)")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="mount the OpenAI-compatible endpoint "
+                   "(/v1/completions, /v1/chat/completions; SSE + "
+                   "non-streaming) on this port, translated onto the "
+                   "routed fleet — an unmodified OpenAI client talks to "
+                   "the whole fleet")
     p.add_argument("--mesh", action="store_true",
                    help="each replica shards its engine over the attached mesh "
                    "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")

@@ -3,7 +3,8 @@ JSONL on stdin or a local HTTP endpoint.
 
 Request protocol (one JSON object per line / per POST body):
 ``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>,
-"priority": "interactive"|"batch"?, "deadline_ms": <number?>}``;
+"priority": "interactive"|"batch"?, "deadline_ms": <number?>,
+"sampling": {...}?, "grammar": {...}?}``;
 each completion is written back as
 ``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``. ``priority``
 defaults to ``interactive``; under pool pressure the scheduler swaps
@@ -11,9 +12,18 @@ defaults to ``interactive``; under pool pressure the scheduler swaps
 ``deadline_ms`` is a relative budget: once it elapses the scheduler
 finishes the request with ``finish_reason="deadline_exceeded"`` (partial
 tokens kept, KV blocks freed the same iteration); a malformed value is
-answered with an error row, like an unknown ``priority``.
+answered with an error row, like an unknown ``priority``. ``sampling``
+carries per-request :class:`~accelerate_tpu.serving.SamplingParams`
+fields (temperature/top_k/top_p/seed/stop/...); ``grammar`` a
+constrained-decoding spec (:mod:`accelerate_tpu.serving.grammar`) —
+both ride the ONE compiled decode executable as lane inputs.
 Prompts are raw token ids — tokenization is deliberately out of scope (the
 engine is model-zoo-generic and this box ships no tokenizer assets).
+``--http`` additionally mounts the OpenAI-compatible door
+(``POST /v1/completions`` + ``/v1/chat/completions``, SSE streaming and
+non-streaming — :mod:`accelerate_tpu.serving.openai_api`), where string
+prompts byte-tokenize and ``response_format={"type": "json_schema"}``
+maps onto ``grammar``.
 
 The engine loop owns the main thread; stdin/HTTP submissions land in a
 thread-safe inbox the loop drains between iterations, so network/pipe
@@ -235,6 +245,7 @@ def _make_engine(args):
             spec_k=args.spec_k,
             draft=args.draft,
             flight_history=args.flight_history,
+            logprobs_topn=args.logprobs_topn,
         ),
         mesh=mesh,
     )
@@ -270,14 +281,18 @@ def _write_flight_drain(logging_dir, engine, k: int = 32) -> None:
 
 
 def _result_dict(req, req_id) -> dict:
-    return {
+    out = {
         "id": req_id,
         "trace_id": req.trace_id,
         "tokens": req.output_tokens,
+        "prompt_tokens": req.prompt_len,
         "ttft_s": req.ttft_s,
         "tpot_s": req.tpot_s,
         "finish_reason": req.finish_reason,
     }
+    if req.logprobs is not None:
+        out["logprobs"] = req.logprobs
+    return out
 
 
 def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
@@ -290,8 +305,16 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
     Exit conditions: ``stop`` (stdin EOF / server teardown) with nothing
     left in flight, or a drain (SIGTERM → ``health.draining``) once the
     engine has been idle for a short grace window — stragglers already in
-    the pipe still get answered."""
+    the pipe still get answered.
+
+    A payload may carry a ``_stream`` callable (the OpenAI SSE path):
+    it is called with each NEW token chunk as decode emits them. When the
+    request has stop sequences, streaming lags by ``max(len(stop)) - 1``
+    tokens so a delta can never over-send tokens a matched stop sequence
+    later truncates — the final result row is always authoritative and
+    exactly completes what was streamed."""
     pending = {}  # engine request_id -> (user id, per-request callback)
+    streams = {}  # engine request_id -> [stream_cb, req, served, holdback]
 
     def deliver(result, cb):
         emit(result)
@@ -340,18 +363,33 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
                             and health.replica_id is not None
                             and payload.get("trace_id") is not None
                         ),
+                        sampling=payload.get("sampling"),
+                        grammar=payload.get("grammar"),
                     )
                 except Exception as e:  # noqa: BLE001 — reported, not fatal
                     deliver({"id": req_id, "error": str(e)}, cb)
                     continue
                 pending[req.request_id] = (payload.get("id"), cb)
+                stream_cb = payload.get("_stream")
+                if stream_cb is not None:
+                    hold = 0
+                    if req.sampling is not None and req.sampling.stop:
+                        hold = max(len(s) for s in req.sampling.stop) - 1
+                    streams[req.request_id] = [stream_cb, req, 0, hold]
         except queue.Empty:
             pass
         if engine.scheduler.has_work():
             idle_since = None
             for req in engine.step():
                 req_id, cb = pending.pop(req.request_id, (None, None))
+                streams.pop(req.request_id, None)
                 deliver(_result_dict(req, req_id), cb)
+            for entry in streams.values():
+                stream_cb, req, served, hold = entry
+                avail = len(req.output_tokens) - hold
+                if avail > served:
+                    stream_cb(req.output_tokens[served:avail])
+                    entry[2] = avail
             continue
         if stop.is_set() and inbox.empty():
             return  # EOF/teardown: the pipe is closed, nothing more can arrive
@@ -531,11 +569,18 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
     from ..metrics.ingest import observe_engine_stats
     from ..metrics.openmetrics import CONTENT_TYPE, render_openmetrics
     from ..metrics.registry import get_active_registry
+    from ..serving.openai_api import OPENAI_PATHS, OpenAIFrontend
 
     health = health or ServeHealth()
     box = {"engine": None if callable(engine) else engine}
+    frontend = OpenAIFrontend(
+        lambda payload, cb: inbox.put((payload, cb)), streaming="delta"
+    )
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so SSE streams ride chunked transfer encoding (every
+        # non-stream answer already sends Content-Length)
+        protocol_version = "HTTP/1.1"
         #: one capture at a time — jax.profiler has a single global trace
         #: session; a concurrent request gets an explicit 409, not a crash
         profile_lock = threading.Lock()
@@ -622,8 +667,65 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
                 Handler.profile_lock.release()
             self._send(200, manifest)
 
+        def _send_sse(self, events):
+            """Stream SSE events as HTTP/1.1 chunked transfer frames; a
+            client hangup mid-stream is normal teardown, not an error."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for event in events:
+                    data = event.encode()
+                    self.wfile.write(
+                        f"{len(data):X}\r\n".encode() + data + b"\r\n"
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True
+
+        def _handle_openai(self, path: str, raw: bytes):
+            """The OpenAI-compatible door: same chaos/lifecycle gates as
+            /generate, OpenAI-shaped error objects on every refusal."""
+            def err(status, message, type_="invalid_request_error"):
+                self._send(status, {"error": {
+                    "message": message, "type": type_,
+                    "param": None, "code": None,
+                }})
+
+            if chaos is not None and chaos.on_generate() == "err503":
+                err(503, "chaos: injected 503 burst", "server_error")
+                return
+            if not health.ready:
+                err(503, f"not accepting requests: {health.state}",
+                    "server_error")
+                return
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                err(400, f"bad JSON: {e}")
+                return
+            kind, *rest = frontend.handle(path, body)
+            if kind == "sse":
+                self._send_sse(rest[0])
+            else:
+                self._send(rest[0], rest[1])
+
         def do_POST(self):
-            if self.path.rstrip("/") != "/generate":
+            path = self.path.rstrip("/")
+            # read the body up front: on a keep-alive connection an early
+            # refusal that skips the body would desync the next request
+            try:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+            except ValueError:
+                n = 0
+            raw = self.rfile.read(n) if n else b""
+            if path in OPENAI_PATHS:
+                self._handle_openai(path, raw)
+                return
+            if path != "/generate":
                 self._send(404, {"error": "unknown path"})
                 return
             if chaos is not None:
@@ -640,8 +742,7 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
                 self._send(503, {"error": f"not accepting requests: {health.state}"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(n))
+                payload = json.loads(raw)
                 if not isinstance(payload, dict):
                     raise ValueError("body must be a JSON object")
                 if not payload.get("prompt"):
@@ -669,7 +770,8 @@ def _serve_http(engine, inbox, stop, port, health=None, handler=None,
     server = Server(("127.0.0.1", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     print(f"serving on http://127.0.0.1:{port} "
-          f"(POST /generate, GET /healthz, GET /stats, GET /metrics)",
+          f"(POST /generate + /v1/completions + /v1/chat/completions, "
+          f"GET /healthz, GET /stats, GET /metrics)",
           file=sys.stderr)
     try:
         if box["engine"] is None:
@@ -782,10 +884,10 @@ def add_parser(subparsers):
                    help="speculative decoding: draft this many tokens per "
                    "slot per round and verify them in ONE [num_slots, k+1] "
                    "compiled forward (default 0 = off; env "
-                   "ACCELERATE_SERVE_SPEC_K). Greedy only — output stays "
-                   "token-identical to the non-speculative engine; a bad "
-                   "spec/draft combination is a startup refusal (error row, "
-                   "exit 2)")
+                   "ACCELERATE_SERVE_SPEC_K). Greedy requests stay "
+                   "token-identical to the non-speculative engine; sampled "
+                   "requests verify by rejection sampling. A bad spec/draft "
+                   "combination is a startup refusal (error row, exit 2)")
     p.add_argument("--draft", default=os.environ.get(
                        "ACCELERATE_SERVE_DRAFT", "early_exit:2"),
                    help="draft policy when --spec-k > 0 (env "
@@ -810,9 +912,28 @@ def add_parser(subparsers):
                    "host-vs-device phase attribution behind "
                    "stats()['host_fraction'], `trace tail --iterations`, "
                    "GET /profile, and HANG_REPORT flight tails")
+    try:
+        logprobs_default = int(
+            os.environ.get("ACCELERATE_SERVE_LOGPROBS_TOPN", "0") or 0
+        )
+    except ValueError:
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_LOGPROBS_TOPN="
+            f"{os.environ['ACCELERATE_SERVE_LOGPROBS_TOPN']!r} (want an integer)",
+            file=sys.stderr,
+        )
+        logprobs_default = 0
+    p.add_argument("--logprobs-topn", type=int, default=logprobs_default,
+                   help="top-N per-step logprobs harvest ceiling (default 0 "
+                   "= disabled; env ACCELERATE_SERVE_LOGPROBS_TOPN): the "
+                   "harvest shape is static engine geometry, so requests opt "
+                   "in UP TO this cap via the OpenAI 'logprobs' field; "
+                   "unsupported with --spec-k > 0")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
-                   help="enable sampling at this temperature (default: greedy)")
+                   help="default sampling temperature when a request sends no "
+                   "per-request params (default: greedy; per-request "
+                   "temperature always wins)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--mesh", action="store_true",
                    help="shard the engine over the attached mesh "

@@ -239,6 +239,12 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "spec_accept_rate": last_step.get("spec_accept_rate"),
             "spec_drafted_tokens": last_step.get("spec_drafted_tokens"),
             "spec_accepted_tokens": last_step.get("spec_accepted_tokens"),
+            # per-slot sampling + constrained decoding (cumulative step-row
+            # counters — absent on a per_slot_sampling=False engine)
+            "sampled_tokens_greedy": last_step.get("sampled_tokens_greedy"),
+            "sampled_tokens_sample": last_step.get("sampled_tokens_sample"),
+            "grammar_masked_steps": last_step.get("grammar_masked_steps"),
+            "rejection_accept_rate": last_step.get("rejection_accept_rate"),
             # flight-recorder iteration attribution + HBM watermarks
             # (gauges riding the step rows — absent on flight_history=0)
             "host_fraction": last_step.get("host_fraction"),
@@ -443,6 +449,19 @@ def render_status(status: dict[str, Any]) -> str:
                 f"accept {_fmt(srv.get('spec_accept_rate'), '{:.0%}')}   "
                 f"drafted {_fmt(srv.get('spec_drafted_tokens'), '{}')}   "
                 f"accepted {_fmt(srv.get('spec_accepted_tokens'), '{}')}"
+            )
+        if srv.get("sampled_tokens_greedy") is not None:
+            rej = (
+                f"   rejection accept "
+                f"{_fmt(srv.get('rejection_accept_rate'), '{:.0%}')}"
+                if srv.get("rejection_accept_rate") is not None
+                else ""
+            )
+            lines.append(
+                f"  sampling: greedy {_fmt(srv.get('sampled_tokens_greedy'), '{}')}   "
+                f"sampled {_fmt(srv.get('sampled_tokens_sample'), '{}')}   "
+                f"grammar-masked {_fmt(srv.get('grammar_masked_steps'), '{}')}"
+                + rej
             )
         if srv.get("prefix_hit_ratio") is not None or srv.get("preemptions"):
             lines.append(

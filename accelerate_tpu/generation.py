@@ -72,31 +72,33 @@ def _cache_backend(model):
     return None
 
 
-def _pick_next(logits, do_sample, temperature, key, finished, eos_token_id):
-    """Host-side decode-step semantics (sampling, eos masking) for the
-    full-forward and seq2seq loops. The cached path runs the SAME rule
-    inside its compiled scan via :func:`_pick_traced` — change both
-    together or the ``use_cache`` paths diverge."""
-    if do_sample:
-        key, sub = jax.random.split(key)
-        scaled = jnp.asarray(logits) / max(temperature, 1e-6)
-        next_tok = np.asarray(jax.random.categorical(sub, scaled, axis=-1))
-    else:
-        next_tok = logits.argmax(axis=-1)
-    if eos_token_id is not None:
-        next_tok = np.where(finished, eos_token_id, next_tok)
-        finished = finished | (next_tok == eos_token_id)
-    return next_tok, key, finished
+#: the temperature floor every sampling path divides by — ONE constant,
+#: so `generate()`, the serving engine, and the per-slot lane path can
+#: never disagree about what "temperature ~ 0" means
+TEMPERATURE_FLOOR = 1e-6
 
 
-def _pick_traced(logits, key, finished, eos_id, temperature, do_sample, has_eos):
-    """Traced twin of :func:`_pick_next` (same key-split order, same
-    temperature floor, same eos masking) for the compiled decode loop."""
+def scale_logits(logits, temperature):
+    """Temperature scaling with the shared floor. ``temperature`` may be a
+    scalar or a per-row array (the serving engine's per-slot lanes
+    broadcast a ``[num_slots, 1]`` column against ``[num_slots, vocab]``
+    logits) — the floor applies elementwise either way."""
+    return logits / jnp.maximum(temperature, TEMPERATURE_FLOOR)
+
+
+def pick_next_token(logits, key, finished, eos_id, temperature, do_sample, has_eos):
+    """THE decode-step token pick (temperature floor, categorical key-split
+    order, eos masking) — the single source of sampling semantics. Every
+    decode path calls it: ``generate()``'s compiled scan, the host-side
+    full-forward/seq2seq loops (via :func:`_pick_next`, which is now a thin
+    numpy shim over this), the serving engine's decode/prefill executables,
+    and the per-slot lane path in :mod:`~accelerate_tpu.serving.sampling`
+    (which reuses :func:`scale_logits` and this greedy branch, adding only
+    the per-slot key derivation and top-k/top-p filters on top). Change it
+    here or nowhere."""
     if do_sample:
         key, sub = jax.random.split(key)
-        tok = jax.random.categorical(
-            sub, logits / jnp.maximum(temperature, 1e-6), axis=-1
-        )
+        tok = jax.random.categorical(sub, scale_logits(logits, temperature), axis=-1)
     else:
         tok = jnp.argmax(logits, axis=-1)
     tok = tok.astype(jnp.int32)
@@ -104,6 +106,31 @@ def _pick_traced(logits, key, finished, eos_id, temperature, do_sample, has_eos)
         tok = jnp.where(finished, eos_id, tok)
         finished = finished | (tok == eos_id)
     return tok, key, finished
+
+
+#: legacy alias — the serving engine and the compiled scans imported the
+#: picker under this name before it was single-sourced
+_pick_traced = pick_next_token
+
+
+def _pick_next(logits, do_sample, temperature, key, finished, eos_token_id):
+    """Host-side shim over :func:`pick_next_token` for the full-forward and
+    seq2seq loops: same rule, numpy in/out. Delegating (instead of keeping
+    a host twin) is what makes the `use_cache` paths incapable of
+    diverging."""
+    logits = jnp.asarray(logits)
+    has_eos = eos_token_id is not None
+    if not has_eos:
+        tok, key, _ = pick_next_token(
+            logits, key, jnp.zeros(logits.shape[:-1], bool),
+            jnp.int32(0), temperature, do_sample, has_eos,
+        )
+        return np.asarray(tok), key, finished
+    tok, key, fin = pick_next_token(
+        logits, key, jnp.asarray(finished), jnp.int32(eos_token_id),
+        temperature, do_sample, has_eos,
+    )
+    return np.asarray(tok), key, np.asarray(fin)
 
 
 def _jitted_for(apply_fn, total: int):

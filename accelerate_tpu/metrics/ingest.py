@@ -153,6 +153,40 @@ _SPEC_ACCEPT_GAUGE = (
     "spec_accept_rate", "serving_spec_accept_rate",
     "Accepted / drafted speculative tokens (0-1, run-cumulative)",
 )
+#: per-slot sampling / constrained-decoding health — one-table-two-surfaces
+#: again. The sampled-tokens counter is mode-labeled (greedy vs sample),
+#: rendering as the documented ``serving_sampled_tokens_total{mode=...}``;
+#: the rejection accept rate is the sampled-slot analogue of
+#: ``serving_spec_accept_rate`` (rejection-sampling verify acceptance).
+_SAMPLING_MODE_FIELDS = (
+    ("sampled_tokens_greedy", "greedy"),
+    ("sampled_tokens_sample", "sample"),
+)
+_GRAMMAR_COUNTER = (
+    "grammar_masked_steps", "serving_grammar_masked_steps",
+    "Emitted tokens that passed through a grammar DFA allow-mask",
+)
+_REJECTION_GAUGE = (
+    "rejection_accept_rate", "serving_rejection_accept_rate",
+    "Accepted / drafted rejection-sampled draft tokens (0-1, run-cumulative)",
+)
+
+
+def _observe_sampling(registry, rec: dict) -> None:
+    """Sampling/grammar fields of a step row or a stats() dict → registry.
+    Shared by both export surfaces, like the tables above."""
+    for field, mode in _SAMPLING_MODE_FIELDS:
+        if _num(rec.get(field)) is not None:
+            registry.counter(
+                "serving_sampled_tokens",
+                "Tokens emitted by the engine per sampling mode",
+            ).set_total(rec[field], mode=mode)
+    field, name, help = _GRAMMAR_COUNTER
+    if _num(rec.get(field)) is not None:
+        registry.counter(name, help).set_total(rec[field])
+    field, name, help = _REJECTION_GAUGE
+    if _num(rec.get(field)) is not None:
+        registry.gauge(name, help).set(rec[field])
 #: flight-recorder / device-memory gauges — one-table-two-surfaces again:
 #: telemetry step rows and ``observe_engine_stats`` both splice this in.
 #: Mirrors ``accelerate_tpu.serving.flight.ITERATION_PHASES`` semantics
@@ -248,6 +282,7 @@ def _observe_serving(registry, record: dict) -> None:
         ):
             if _num(record.get(field)) is not None:
                 registry.counter(name, help).set_total(record[field])
+        _observe_sampling(registry, record)
 
 
 #: router-level robustness counters — fed from the fleet trail's aggregate
@@ -355,3 +390,4 @@ def observe_engine_stats(registry, stats: dict) -> None:
     for field, name, help in (*_SHARING_COUNTERS, *_SPEC_COUNTERS):
         if _num(stats.get(field)) is not None:
             registry.counter(name, help).set_total(stats[field])
+    _observe_sampling(registry, stats)

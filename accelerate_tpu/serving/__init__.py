@@ -14,6 +14,7 @@ no accelerator, so those names are NOT imported here eagerly — use
 
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .engine import EngineConfig, InferenceEngine
+from .grammar import Grammar, GrammarError, compile_grammar, validate_instance
 from .flight import (
     ITERATION_PHASES,
     FlightRecorder,
@@ -21,6 +22,7 @@ from .flight import (
     set_active_flight_recorder,
 )
 from .radix import RadixCache, SwapPool
+from .sampling import SamplingParams, resolve_sampling
 from .scheduler import PRIORITY_CLASSES, Request, RequestState, SlotScheduler
 from .spec import DraftSpec, parse_draft_spec
 
@@ -31,8 +33,14 @@ __all__ = [
     "DraftSpec",
     "EngineConfig",
     "FlightRecorder",
+    "Grammar",
+    "GrammarError",
     "ITERATION_PHASES",
     "InferenceEngine",
+    "SamplingParams",
+    "compile_grammar",
+    "resolve_sampling",
+    "validate_instance",
     "get_active_flight_recorder",
     "set_active_flight_recorder",
     "PRIORITY_CLASSES",

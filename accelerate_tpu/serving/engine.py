@@ -23,20 +23,28 @@ vLLM-style block-paged cache):
   pool's leading layers), ONE ``[num_slots, k+1]`` verify forward scores
   all drafts through the fused paged kernel, and the longest agreeing
   prefix + correction emit. Rollback is position bookkeeping only, so the
-  one-executable contract and greedy token parity both survive.
+  one-executable contract and greedy token parity both survive;
+* **per-slot sampling + constrained decoding** (``per_slot_sampling``,
+  the default): temperature / top-k / top-p / repetition penalty / seed /
+  grammar-DFA state ride as fixed-shape *lane inputs* of the same ONE
+  decode executable (:mod:`.sampling`, :mod:`.grammar`) — per-request
+  variation never recompiles, greedy slots take a ``lax.cond`` fast path
+  that is bit-identical argmax, and the spec verify round accepts sampled
+  slots by rejection sampling.
 
-Sampling/eos semantics reuse ``generation.py``'s traced pick helper
-(:func:`accelerate_tpu.generation._pick_traced`), so greedy engine output
-is token-for-token identical to ``generate(use_cache=True)`` — and the
-spec round's acceptance reuses
-:func:`accelerate_tpu.generation.spec_accept_tokens`, so the spec-armed
-engine stays token-identical to the non-spec engine.
+Sampling/eos semantics share one traced picker with ``generation.py``
+(:func:`accelerate_tpu.generation.pick_next_token`), so greedy engine
+output is token-for-token identical to ``generate(use_cache=True)`` — and
+the spec round's greedy acceptance reuses
+:func:`accelerate_tpu.generation.spec_accept_tokens`, so greedy slots of
+the spec-armed engine stay token-identical to the non-spec engine.
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
+import warnings
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 
 import jax
@@ -51,7 +59,24 @@ from ..metrics.registry import get_active_registry
 from ..telemetry import get_active_recorder
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
 from .flight import FlightRecorder, set_active_flight_recorder
+from .grammar import compile_grammar
 from .radix import RadixCache, SwapPool
+from .sampling import (
+    TAG_ACCEPT,
+    TAG_DRAFT,
+    SamplingParams,
+    apply_filters,
+    blank_lanes,
+    categorical_per_slot,
+    dist_logprobs,
+    match_stop,
+    pick_tokens,
+    rejection_accept,
+    resolve_sampling,
+    set_slot_lane,
+    slot_keys,
+    uniform_per_slot,
+)
 from .scheduler import Request, RequestState, SlotScheduler, priority_rank
 
 
@@ -142,7 +167,11 @@ class EngineConfig:
     #: each slot's valid prefix, so no pool edit beyond the normal scatter
     #: happens at any kv_dtype. ``decode_burst`` is ignored while armed —
     #: one spec round already amortises the host round trip over up to
-    #: ``spec_k + 1`` tokens. Greedy only: ``do_sample=True`` refuses.
+    #: ``spec_k + 1`` tokens. Sampled slots verify by rejection sampling
+    #: (accept draft token with prob min(1, p_target/p_draft), resample
+    #: the clamped residual otherwise) while greedy slots keep the exact
+    #: longest-agreeing-prefix path — so speculation composes with
+    #: ``do_sample`` when ``per_slot_sampling=True``.
     spec_k: int = 0
     #: draft policy when ``spec_k > 0`` (see :mod:`.spec`):
     #: ``"early_exit:N"`` runs the target's own first N layers (+ its final
@@ -151,6 +180,30 @@ class EngineConfig:
     #: strict subset of the target's, so prefix sharing, copy-on-write and
     #: swap preemption maintain the draft state with zero extra machinery.
     draft: str = "early_exit:2"
+    #: per-request sampling + constrained decoding (:mod:`.sampling`,
+    #: :mod:`.grammar`): temperature / top-k / top-p / repetition penalty /
+    #: seed / stop / min_tokens and a grammar DFA ride as fixed-shape
+    #: *traced lane inputs* of the ONE compiled decode executable, so
+    #: per-request variation never recompiles. ``False`` rebuilds the
+    #: pre-lane executables byte-for-byte (the ``bench.py sampling``
+    #: overhead baseline) and refuses per-request params at add_request.
+    per_slot_sampling: bool = True
+    #: top-N per-step logprobs harvested through the existing device_get
+    #: (0 disables — the harvest shape is static, so this is engine
+    #: geometry; requests opt in *up to* this cap). Unsupported with
+    #: ``spec_k > 0``.
+    logprobs_topn: int = 0
+    #: concurrent distinct grammars resident in the device mask/transition
+    #: tables (+1 internal row for the unconstrained sentinel). Rows are
+    #: refcounted per live request and LRU-cached when idle; admission
+    #: with every row held by a live request raises.
+    grammar_slots: int = 4
+    #: DFA state budget per grammar — sizes the device tables; a grammar
+    #: compiling to more states refuses at add_request
+    grammar_states: int = 64
+    #: repetition-penalty window: the last this-many generated tokens ride
+    #: the ``[num_slots, rep_window]`` ring lane
+    rep_window: int = 32
 
     @property
     def blocks_per_slot(self) -> int:
@@ -204,11 +257,18 @@ class InferenceEngine:
         if cfg.spec_k:
             if cfg.spec_k < 1:
                 raise ValueError("spec_k must be >= 1 (0 disables speculation)")
-            if cfg.do_sample:
+            if cfg.do_sample and not cfg.per_slot_sampling:
                 raise ValueError(
-                    "speculative decoding is greedy-only (generation.py's "
-                    "rule): rejection sampling for do_sample=True is not "
-                    "implemented — disable sampling or set spec_k=0"
+                    "spec_k with do_sample=True needs per_slot_sampling=True "
+                    "(the rejection-sampling verify path); the legacy "
+                    "per_slot_sampling=False executables are greedy-only"
+                )
+            if cfg.logprobs_topn:
+                raise ValueError(
+                    "logprobs_topn with spec_k > 0 is not supported: the "
+                    "verify round emits a variable accepted prefix, so there "
+                    "is no per-step harvest to ride — set spec_k=0 for "
+                    "logprobs"
                 )
             from .spec import parse_draft_spec
 
@@ -226,6 +286,44 @@ class InferenceEngine:
         #: the block-growth lookahead (a spec round writes k+1 positions;
         #: a plain dispatch writes decode_burst)
         self._decode_lookahead = (cfg.spec_k + 1) if self._spec else cfg.decode_burst
+
+        # per-slot sampling + grammar state (the tentpole lanes). The
+        # engine-wide do_sample/temperature survive as the DEFAULT
+        # SamplingParams a request inherits when it supplies none.
+        self._psampling = bool(cfg.per_slot_sampling)
+        if min(cfg.logprobs_topn, cfg.grammar_slots, cfg.rep_window - 1,
+               cfg.grammar_states - 1) < 0:
+            raise ValueError(
+                "logprobs_topn/grammar_slots must be >= 0; "
+                "rep_window/grammar_states must be >= 1"
+            )
+        self._default_sampling = SamplingParams(
+            do_sample=cfg.do_sample, temperature=cfg.temperature,
+            seed=cfg.seed,
+        ).validate()
+        if cfg.do_sample and self._psampling:
+            warnings.warn(
+                "EngineConfig(do_sample=True) + temperature are superseded by "
+                "per-request sampling params: they now only set the default "
+                "SamplingParams a request inherits when it supplies none "
+                "(sampled draws use the per-slot derived keys, not the "
+                "legacy threaded key)",
+                stacklevel=2,
+            )
+        self._vocab_size = int(mcfg.vocab_size)
+        self._sampled_greedy = 0
+        self._sampled_sample = 0
+        self._grammar_masked_steps = 0
+        self._rej_drafted = 0
+        self._rej_accepted = 0
+        # grammar row table: row 0 is the permanently-pinned unconstrained
+        # sentinel (mask all-True, transitions all-0); rows 1..G-1 are
+        # refcounted per live request, cached under their grammar hash
+        # when idle, LRU-evicted when a new grammar needs a row
+        self._grammar_rows: dict[str, int] = {}
+        self._row_refs = [0] * (cfg.grammar_slots + 1)
+        self._row_grammar: dict[int, object] = {}
+        self._row_lru: OrderedDict[str, int] = OrderedDict()
 
         self._mb = cfg.blocks_per_slot  # block-table width
         # explicit is-None test: an explicit num_blocks=0 must reach the
@@ -293,6 +391,24 @@ class InferenceEngine:
         self._vs = jnp.ones(scale_shape, jnp.float32) if quantized else None
         self._key = jax.random.PRNGKey(cfg.seed)
         self._temp = jnp.float32(cfg.temperature)
+        #: per-slot draw root: never split/threaded — every draw derives
+        #: from it by fold_in(tag, request seed, output position), which is
+        #: what makes (seed, prompt) reproducible across admission orders
+        #: and preempt/swap/resume (sampling.slot_keys)
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        if self._psampling:
+            g = cfg.grammar_slots + 1
+            self._gmask = jnp.ones((g, cfg.grammar_states, self._vocab_size), bool)
+            self._gtrans = jnp.zeros(
+                (g, cfg.grammar_states, self._vocab_size), jnp.int32
+            )
+        else:
+            self._gmask = self._gtrans = None
+        #: device-committed all-inert lane dict, built lazily: the
+        #: all-greedy dispatch fast path reuses these buffers verbatim, so
+        #: plain traffic never pays the per-iteration lane rebuild/upload
+        #: (the in-trace lax.cond already argmaxes without reading them)
+        self._lanes_idle = None
         self.mesh = mesh
         if mesh is not None:
             self._place_on_mesh(inner)
@@ -393,6 +509,30 @@ class InferenceEngine:
             lambda pool, ids, rows: pool.at[:, ids].set(rows),
             donate_argnums=(0,),
         )
+        # grammar-row install: one donated row-set per table, the row id a
+        # traced scalar so every grammar reuses one compile — same tiny-
+        # executable discipline as the block edits above (never touches
+        # the decode trace counter)
+        self._write_grammar_row_fn = jax.jit(
+            lambda tab, row, data: tab.at[row].set(data),
+            donate_argnums=(0,),
+        )
+        # first-token pick for the per-slot path: the prefill executable
+        # already returns the prompt-final logits, so the lane transform
+        # runs on them as a [1, vocab] slice of the SAME pick_tokens the
+        # decode scan uses — one tiny extra executable, zero extra model
+        # forwards, and exact key parity with decode (position 0)
+        if self._psampling:
+            eos_id = cfg.eos_token_id
+            topn = cfg.logprobs_topn
+
+            def first_pick(logits, lanes, gmask, base_key):
+                return pick_tokens(
+                    logits, lanes, lanes["dfa_state"], jnp.int32(0), gmask,
+                    base_key, eos_id=eos_id, logprobs_topn=topn,
+                )
+
+            self._first_pick_fn = jax.jit(first_pick)
 
     def _place_on_mesh(self, inner) -> None:
         """GSPMD placement over ``self.mesh``: every device-side input to
@@ -432,6 +572,31 @@ class InferenceEngine:
         rep = NamedSharding(mesh, PartitionSpec())
         self._key = jax.device_put(self._key, rep)
         self._temp = jax.device_put(self._temp, rep)
+        self._base_key = jax.device_put(self._base_key, rep)
+        if self._gmask is not None:
+            # grammar tables are read-gathered per slot — tiny, replicated
+            self._gmask = jax.device_put(self._gmask, rep)
+            self._gtrans = jax.device_put(self._gtrans, rep)
+
+    def _idle_lanes(self) -> dict:
+        """The cached device-committed blank lane dict for all-inert
+        dispatches. Every value is already a (replicated, on-mesh) jax
+        array, so handing it to the compiled step costs zero host work —
+        no per-iteration rebuild, no numpy→device transfer. Correct for
+        any all-inert batch because the traced ``lax.cond`` in
+        ``pick_tokens`` takes the bare-argmax branch without reading a
+        single lane value."""
+        if self._lanes_idle is None:
+            lanes = blank_lanes(self.config.num_slots, self.config.rep_window)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                lanes = {k: jax.device_put(v, rep) for k, v in lanes.items()}
+            else:
+                lanes = {k: jnp.asarray(v) for k, v in lanes.items()}
+            self._lanes_idle = lanes
+        return self._lanes_idle
 
     def _hbm_preflight(self, inner, pool_shape, pool_dtype, mesh) -> None:
         """shard-check's SP004 at the serving seam: predicted per-device
@@ -480,6 +645,8 @@ class InferenceEngine:
         return pages
 
     def _build_decode_fn(self):
+        if self._psampling:
+            return self._build_lane_decode_fn()
         apply_fn, cfg = self._apply_fn, self.config
         quantized = self._quantized
 
@@ -530,6 +697,73 @@ class InferenceEngine:
 
         return jax.jit(decode_plain, donate_argnums=donate)
 
+    def _build_lane_decode_fn(self):
+        """Per-slot twin of the legacy burst decode: the sampling lanes
+        (:func:`sampling.blank_lanes` schema), the grammar tables, and the
+        derived-key root ride as extra traced inputs of the SAME single
+        executable — their shapes/dtypes are engine geometry, so
+        per-request variation is data, never a retrace. Each burst step
+        runs :func:`sampling.pick_tokens` (which drops to a bare argmax
+        under ``lax.cond`` when every lane is inert — greedy parity with
+        the legacy executable is exact) and advances the per-slot DFA
+        state in-trace for mid-burst masking; the host re-derives the
+        authoritative state per emitted token, so discarded burst tails
+        never corrupt it.  The per-step top-N logprob harvest rides the
+        scan outputs through the one existing device_get."""
+        apply_fn, cfg = self._apply_fn, self.config
+        quantized = self._quantized
+        eos_id = cfg.eos_token_id
+        topn = cfg.logprobs_topn
+
+        def decode(params, kp, vp, ks, vs, block_tables, pos0, toks, active,
+                   lanes, gmask, gtrans, base_key):
+            self._decode_traces += 1  # traced-body side effect: cache misses only
+
+            def one_step(carry, t):
+                kp, vp, ks, vs, toks, pos, dfa = carry
+                out = apply_fn(
+                    params,
+                    input_ids=toks,
+                    paged_kv=self._paged_kv_dict(kp, vp, ks, vs),
+                    block_tables=block_tables,
+                    cache_positions=pos,
+                    paged_write_mask=active,  # PREFILL/free lanes must not scribble
+                )
+                logits = out["logits"][:, -1, :]
+                tok, logp_tok, top_vals, top_ids = pick_tokens(
+                    logits, lanes, dfa, t, gmask, base_key,
+                    eos_id=eos_id, logprobs_topn=topn,
+                )
+                dfa = gtrans[lanes["grammar_row"], dfa, tok]
+                pages = out["paged_kv"]
+                ks2 = pages.get("k_scale", ks)
+                vs2 = pages.get("v_scale", vs)
+                return (
+                    pages["k"], pages["v"], ks2, vs2, tok[:, None], pos + 1, dfa
+                ), (tok, logp_tok, top_vals, top_ids)
+
+            (kp, vp, ks, vs, _, _, _), (toks_out, logps, tvals, tids) = jax.lax.scan(
+                one_step,
+                (kp, vp, ks, vs, toks, pos0, lanes["dfa_state"]),
+                jnp.arange(cfg.decode_burst),
+            )
+            # toks_out: [burst, num_slots]; logprob outputs [burst, slots(, N)]
+            return kp, vp, ks, vs, toks_out, logps, tvals, tids
+
+        donate = (1, 2, 3, 4) if quantized else (1, 2)
+        if quantized:
+            return jax.jit(decode, donate_argnums=donate)
+
+        def decode_plain(params, kp, vp, block_tables, pos0, toks, active,
+                         lanes, gmask, gtrans, base_key):
+            kp, vp, _, _, toks_out, logps, tvals, tids = decode(
+                params, kp, vp, None, None, block_tables, pos0, toks, active,
+                lanes, gmask, gtrans, base_key,
+            )
+            return kp, vp, toks_out, logps, tvals, tids
+
+        return jax.jit(decode_plain, donate_argnums=donate)
+
     def _build_spec_decode_fn(self):
         """Speculative twin of ``_build_decode_fn`` — when ``spec_k`` is
         armed this IS the engine's one decode executable. One dispatch runs
@@ -557,6 +791,8 @@ class InferenceEngine:
         beyond the normal scatter ever happens. Donation discipline and the
         traced-body compile counter are identical to the plain decode fn,
         so ``decode_compiles == 1`` remains the asserted contract."""
+        if self._psampling:
+            return self._build_lane_spec_decode_fn()
         from ..generation import spec_accept_tokens
 
         apply_fn, cfg = self._apply_fn, self.config
@@ -636,6 +872,153 @@ class InferenceEngine:
 
         return jax.jit(spec_plain, donate_argnums=donate)
 
+    def _build_lane_spec_decode_fn(self):
+        """Per-slot spec round: the draft proposes through the SAME lane
+        transform the plain decode uses (grammar mask, filters, per-slot
+        derived keys — ``TAG_DRAFT``), the verify scores every position
+        through it again, and acceptance splits per slot:
+
+        * greedy slots keep the exact longest-agreeing-prefix path
+          (:func:`~accelerate_tpu.generation.spec_accept_tokens` over the
+          *filtered* target argmax — token-identical to the non-spec
+          engine, and the filter re-check is what keeps accepted drafts
+          inside a constrained slot's language);
+        * sampled slots run standard speculative rejection sampling
+          (:func:`sampling.rejection_accept`): accept draft ``d_j`` with
+          prob ``min(1, p_j(d_j)/q_j(d_j))``, resample the first rejection
+          from the clamped residual ``max(p - q, 0)``, bonus-sample from
+          ``p_k`` on full acceptance.  ``p`` and ``q`` come out of the one
+          shared :func:`sampling.dist_logprobs`, so both sides of the
+          ratio see identical temperature/top-k/top-p/grammar filtering —
+          an out-of-language draft has ``p = 0`` and is rejected with
+          certainty, and the residual stays in-language.
+
+        The repetition-penalty ring is held constant across the round (a
+        documented approximation — consistent between ``p`` and ``q``, so
+        the acceptance identity is unaffected).  Donation discipline and
+        the traced-body compile counter are identical to the plain lane
+        decode: ``decode_compiles == 1`` stays the asserted contract."""
+        from ..generation import spec_accept_tokens
+
+        apply_fn, cfg = self._apply_fn, self.config
+        draft_apply = self._draft_apply
+        dl = self._spec.layers
+        k = cfg.spec_k
+        quantized = self._quantized
+        eos_id = cfg.eos_token_id
+
+        def spec_decode(params, kp, vp, ks, vs, block_tables, pos0, toks, active,
+                        lanes, gmask, gtrans, base_key):
+            self._decode_traces += 1  # traced-body side effect: cache misses only
+            row = lanes["grammar_row"]
+
+            def dstep(carry, t):
+                dkp, dvp, dks, dvs, tok, pos, dfa = carry
+                pages_in = {"k": dkp, "v": dvp}
+                if quantized:
+                    pages_in["k_scale"], pages_in["v_scale"] = dks, dvs
+                out = draft_apply(
+                    params,
+                    input_ids=tok,
+                    paged_kv=pages_in,
+                    block_tables=block_tables,
+                    cache_positions=pos,
+                    paged_write_mask=active,  # PREFILL/free lanes must not scribble
+                )
+                pages = out["paged_kv"]
+                filt = apply_filters(
+                    out["logits"][:, -1, :], lanes, dfa, lanes["pos"] + t,
+                    gmask, eos_id,
+                )
+                greedy = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+                logq = dist_logprobs(filt, lanes)
+                keys = slot_keys(base_key, lanes["seed"], lanes["pos"] + t, TAG_DRAFT)
+                nxt = jnp.where(
+                    lanes["sample"], categorical_per_slot(keys, logq), greedy
+                ).astype(jnp.int32)
+                return (
+                    pages["k"], pages["v"],
+                    pages.get("k_scale", dks), pages.get("v_scale", dvs),
+                    nxt[:, None], pos + 1, gtrans[row, dfa, nxt],
+                ), (nxt, jnp.exp(logq))
+
+            d0 = (
+                kp[:dl], vp[:dl],
+                ks[:dl] if quantized else None,
+                vs[:dl] if quantized else None,
+                toks, pos0, lanes["dfa_state"],
+            )
+            _, (d, q) = jax.lax.scan(dstep, d0, jnp.arange(k))
+            d = d.T  # [num_slots, k] draft proposals; q: [k, slots, vocab]
+
+            chunk = jnp.concatenate([toks, d], axis=1)  # [num_slots, k+1]
+            vmask = jnp.broadcast_to(active, (cfg.num_slots, k + 1))
+            out = apply_fn(
+                params,
+                input_ids=chunk,
+                paged_kv=self._paged_kv_dict(kp, vp, ks, vs),
+                block_tables=block_tables,
+                cache_positions=pos0,
+                paged_write_mask=vmask,
+            )
+            pages = out["paged_kv"]
+            tlogits = out["logits"]  # [num_slots, k+1, vocab]
+
+            # DFA states along the draft path (k is small and static): the
+            # verify filters each position with the state its PREFIX put
+            # the automaton in — this is the mask re-check
+            states = [lanes["dfa_state"]]
+            for j in range(k):
+                states.append(gtrans[row, states[j], d[:, j]])
+            filts = [
+                apply_filters(
+                    tlogits[:, j, :], lanes, states[j], lanes["pos"] + j,
+                    gmask, eos_id,
+                )
+                for j in range(k + 1)
+            ]
+            preds = jnp.stack(
+                [jnp.argmax(f, axis=-1) for f in filts], axis=1
+            ).astype(jnp.int32)
+            accept_g, seq_g = spec_accept_tokens(d, preds)
+
+            p = jnp.stack([jnp.exp(dist_logprobs(f, lanes)) for f in filts], axis=0)
+            u = jnp.stack(
+                [
+                    uniform_per_slot(
+                        slot_keys(base_key, lanes["seed"], lanes["pos"] + j, TAG_ACCEPT)
+                    )
+                    for j in range(k)
+                ],
+                axis=1,
+            )  # [num_slots, k]
+            accept_s, seq_s = rejection_accept(
+                d, p, q, u, base_key, lanes["seed"], lanes["pos"]
+            )
+
+            sample = lanes["sample"]
+            accept = jnp.where(sample, accept_s, accept_g).astype(jnp.int32)
+            tok_seq = jnp.where(sample[:, None], seq_s, seq_g).astype(jnp.int32)
+            return (
+                pages["k"], pages["v"],
+                pages.get("k_scale", ks), pages.get("v_scale", vs),
+                tok_seq, accept,
+            )
+
+        donate = (1, 2, 3, 4) if quantized else (1, 2)
+        if quantized:
+            return jax.jit(spec_decode, donate_argnums=donate)
+
+        def spec_plain(params, kp, vp, block_tables, pos0, toks, active,
+                       lanes, gmask, gtrans, base_key):
+            kp, vp, _, _, tok_seq, accept = spec_decode(
+                params, kp, vp, None, None, block_tables, pos0, toks, active,
+                lanes, gmask, gtrans, base_key,
+            )
+            return kp, vp, tok_seq, accept
+
+        return jax.jit(spec_plain, donate_argnums=donate)
+
     def _build_prefill_fn(self):
         apply_fn, cfg = self._apply_fn, self.config
         quantized = self._quantized
@@ -685,6 +1068,8 @@ class InferenceEngine:
         deadline_ms: float | None = None,
         trace_id: str | None = None,
         upstream_hop: bool = False,
+        sampling=None,
+        grammar: dict | None = None,
     ) -> Request:
         """Enqueue one request. ``deadline_ms`` is a *relative* budget from
         now: once it elapses the scheduler finishes the request with
@@ -701,7 +1086,20 @@ class InferenceEngine:
         request (and emitted the flow arrow's tail) — the engine then
         lands the arrow's head at arrival. A standalone engine must leave
         it False even for client-supplied ids, or every request counts as
-        an orphaned flow in the merged timeline."""
+        an orphaned flow in the merged timeline.
+
+        ``sampling`` is a :class:`SamplingParams` (or a dict of its
+        fields) scoped to THIS request; ``None`` inherits the engine-wide
+        defaults. ``grammar`` is a constrained-decoding spec
+        (``{"type": "regex", ...}`` or ``{"type": "json_schema", ...}``)
+        compiled here — admission fails loudly on an unsupported grammar
+        or when every grammar row is held by a live request, never
+        mid-decode."""
+        if not self._psampling and (sampling is not None or grammar is not None):
+            raise ValueError(
+                "per-request sampling/grammar need per_slot_sampling=True "
+                "(this engine was built with the lanes disabled)"
+            )
         upstream = upstream_hop and valid_trace_id(trace_id)
         req = Request(
             prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
@@ -724,7 +1122,30 @@ class InferenceEngine:
                     "number of milliseconds"
                 )
             req.deadline = time.perf_counter() + budget_ms / 1000.0
-        self.scheduler.submit(req)
+        if self._psampling:
+            params = resolve_sampling(sampling, self._default_sampling)
+            if params.logprobs > self.config.logprobs_topn:
+                raise ValueError(
+                    f"request wants logprobs={params.logprobs} but the engine "
+                    f"compiled logprobs_topn={self.config.logprobs_topn}; raise "
+                    "EngineConfig.logprobs_topn (a traced-shape choice, so it "
+                    "is per-engine, not per-request)"
+                )
+            req.sampling = params
+            if grammar is not None:
+                g = compile_grammar(
+                    grammar, self._vocab_size,
+                    eos_id=self.config.eos_token_id,
+                    max_states=self.config.grammar_states,
+                )
+                req.grammar_row = self._acquire_grammar_row(g)
+                req.dfa_state = g.start
+        try:
+            self.scheduler.submit(req)
+        except BaseException:
+            if req.grammar_row:
+                self._release_grammar_row(req)
+            raise
         tr = get_tracer()
         if tr:
             # the engine-side async span opens at ARRIVAL (stamped with the
@@ -806,6 +1227,9 @@ class InferenceEngine:
 
         self._iterations += 1
         self._occupancy_sum += sched.occupancy
+        for req in finished:
+            if req.grammar_row:
+                self._release_grammar_row(req)
         self._completed.extend(finished)
         self._completed_total += len(finished)
         if self._tr is not None:
@@ -889,6 +1313,11 @@ class InferenceEngine:
         self._deadline_expired = 0
         self._spec_drafted = 0
         self._spec_accepted = 0
+        self._sampled_greedy = 0
+        self._sampled_sample = 0
+        self._grammar_masked_steps = 0
+        self._rej_drafted = 0
+        self._rej_accepted = 0
         # hit accounting restarts with the measurement window; the trie and
         # its cached blocks deliberately stay warm (steady-state behaviour
         # is what a warmed bench leg measures)
@@ -954,6 +1383,29 @@ class InferenceEngine:
             ),
         }
 
+    def _sampling_stats(self) -> dict:
+        """Per-slot sampling/grammar health fields. Like ``_spec_stats``,
+        the SINGLE source for both ``stats()`` and the telemetry step
+        rows; empty when the lanes are disabled. The rejection counters
+        only appear with speculation armed — they are the sampled-slot
+        analogue of the greedy accept rate."""
+        if not self._psampling:
+            return {}
+        out = {
+            "sampled_tokens_greedy": self._sampled_greedy,
+            "sampled_tokens_sample": self._sampled_sample,
+            "grammar_masked_steps": self._grammar_masked_steps,
+            "grammar_rows_live": sum(1 for r in self._row_refs if r > 0),
+        }
+        if self._spec is not None:
+            out["rejection_drafted_tokens"] = self._rej_drafted
+            out["rejection_accepted_tokens"] = self._rej_accepted
+            out["rejection_accept_rate"] = (
+                self._rej_accepted / self._rej_drafted
+                if self._rej_drafted else 0.0
+            )
+        return out
+
     def stats(self) -> dict:
         """Aggregate serving health: goodput, TTFT/TPOT percentiles over
         completed requests, mean slot occupancy, and the compile counters
@@ -1005,6 +1457,7 @@ class InferenceEngine:
             "deadline_expired_total": self._deadline_expired,
         }
         out.update(self._spec_stats())
+        out.update(self._sampling_stats())
         out.update(self._hbm_watermarks())
         if self._flight is not None:
             # host_fraction + iteration p50/p99 + per-phase breakdowns
@@ -1290,7 +1743,14 @@ class InferenceEngine:
                 # into the prefix trie (refcount+1 = the cache's reference)
                 # so later admissions with the same leading tokens map them
                 self.radix.insert(req.prompt, req.blocks)
-            self._emit_token(req, int(tok), finished)
+            lp_entry = None
+            if self._psampling:
+                # re-pick from the returned prompt-final logits through the
+                # SAME lane transform decode uses (position 0 of the
+                # request's derived key stream); on an inert request this
+                # is the same argmax the executable's own pick took
+                tok, lp_entry = self._first_token_pick(req, _logits)
+            self._emit_token(req, int(tok), finished, lp_entry)
             if req.state is not RequestState.FINISHED:
                 req.state = RequestState.DECODE
 
@@ -1367,6 +1827,36 @@ class InferenceEngine:
         if not live:
             return
 
+        # per-slot lanes: rebuilt from the live requests on EVERY dispatch
+        # (pos/ring/DFA state re-derived from the request, so preemption,
+        # swap, and slot reassignment can never desynchronise them); the
+        # shapes/dtypes are engine geometry — one abstract signature forever.
+        # When every live request is inert the cached device-resident blank
+        # dict stands in — the traced lax.cond argmaxes without reading a
+        # single lane value, so stale contents cannot matter
+        lanes = None
+        if self._psampling:
+            if all(
+                (req.sampling or self._default_sampling).inert
+                and not req.grammar_row
+                for req in live
+            ):
+                lanes = self._idle_lanes()
+            else:
+                lanes = blank_lanes(cfg.num_slots, cfg.rep_window)
+                for req in live:
+                    params = req.sampling or self._default_sampling
+                    set_slot_lane(
+                        lanes, req.slot, params,
+                        pos=len(req.output_tokens),
+                        grammar_row=req.grammar_row, dfa_state=req.dfa_state,
+                        recent=(
+                            req.prompt + req.output_tokens
+                            if params.repetition_penalty != 1.0
+                            else ()
+                        ),
+                    )
+
         # signature capture costs ~8 shape/dtype formats per dispatch, so it
         # rides the same armed-instrumentation gate as every other hot-path
         # site (one global read each when disabled); the retrace *counter*
@@ -1378,7 +1868,13 @@ class InferenceEngine:
                 ("block_tables", self._block_tables), ("pos0", pos0),
                 ("toks", toks), ("active", active),
             ]
-            if self._spec is None:  # the spec round is greedy: no key/temp
+            if self._psampling:
+                args += sorted(lanes.items())
+                args += [
+                    ("gmask", self._gmask), ("gtrans", self._gtrans),
+                    ("base_key", self._base_key),
+                ]
+            elif self._spec is None:  # legacy spec round is greedy: no key/temp
                 args += [("key", self._key), ("temp", self._temp)]
             if self._quantized:
                 args[2:2] = [("ks", self._ks), ("vs", self._vs)]
@@ -1389,10 +1885,25 @@ class InferenceEngine:
 
         if self._spec is not None:
             self._spec_decode_dispatch(
-                pos0, toks, active, live, finished, decode_sig
+                pos0, toks, active, lanes, live, finished, decode_sig
             )
             return
-        if self._quantized:
+        logps = tvals = tids = None
+        if self._psampling:
+            lane_args = (lanes, self._gmask, self._gtrans, self._base_key)
+            if self._quantized:
+                (self._kp, self._vp, self._ks, self._vs, next_toks,
+                 logps, tvals, tids) = self._decode_fn(
+                    self._params, self._kp, self._vp, self._ks, self._vs,
+                    self._block_tables, pos0, toks, active, *lane_args,
+                )
+            else:
+                (self._kp, self._vp, next_toks, logps, tvals,
+                 tids) = self._decode_fn(
+                    self._params, self._kp, self._vp, self._block_tables,
+                    pos0, toks, active, *lane_args,
+                )
+        elif self._quantized:
             (self._kp, self._vp, self._ks, self._vs, next_toks,
              self._key) = self._decode_fn(
                 self._params, self._kp, self._vp, self._ks, self._vs,
@@ -1409,7 +1920,18 @@ class InferenceEngine:
             # interval where the host provably waits on the device
             self._fl_dispatch_done = time.perf_counter()
             self._flight.current_phase = "device_wait"
-        next_toks = np.asarray(jax.device_get(next_toks))  # [burst, num_slots]
+        harvest_lp = self.config.logprobs_topn > 0 and any(
+            r.sampling is not None and r.sampling.logprobs for r in live
+        )
+        if harvest_lp:
+            # the logprob surfaces ride the SAME device_get — no second
+            # dispatch, no extra sync point
+            next_toks, logps, tvals, tids = (
+                np.asarray(x)
+                for x in jax.device_get((next_toks, logps, tvals, tids))
+            )
+        else:
+            next_toks = np.asarray(jax.device_get(next_toks))  # [burst, slots]
         if self._flight is not None:
             self._fl_wait_done = time.perf_counter()
             self._flight.current_phase = "harvest"
@@ -1422,13 +1944,22 @@ class InferenceEngine:
                 trace_ids=[r.trace_id for r in live],
             )
         for req in live:
+            want_lp = (
+                harvest_lp and req.sampling is not None and req.sampling.logprobs
+            )
             for t in range(cfg.decode_burst):
                 if req.state is RequestState.FINISHED:
                     break  # mid-burst eos/length: the tail lane-steps are waste
-                self._emit_token(req, int(next_toks[t, req.slot]), finished)
+                entry = None
+                if want_lp:
+                    entry = self._logprob_entry(
+                        req.sampling, float(logps[t, req.slot]),
+                        tvals[t, req.slot], tids[t, req.slot],
+                    )
+                self._emit_token(req, int(next_toks[t, req.slot]), finished, entry)
 
     def _spec_decode_dispatch(
-        self, pos0, toks, active, live: list[Request],
+        self, pos0, toks, active, lanes, live: list[Request],
         finished: list[Request], decode_sig: tuple | None,
     ) -> None:
         """One speculative round: dispatch the single compiled
@@ -1439,16 +1970,21 @@ class InferenceEngine:
         not re-implemented). Rollback is implicit: a slot advances by
         ``accept+1`` positions; the rejected rows beyond that are
         re-scattered by the next round before anything can attend them."""
+        lane_args = (
+            (lanes, self._gmask, self._gtrans, self._base_key)
+            if self._psampling
+            else ()
+        )
         if self._quantized:
             (self._kp, self._vp, self._ks, self._vs, tok_seq,
              accept) = self._decode_fn(
                 self._params, self._kp, self._vp, self._ks, self._vs,
-                self._block_tables, pos0, toks, active,
+                self._block_tables, pos0, toks, active, *lane_args,
             )
         else:
             self._kp, self._vp, tok_seq, accept = self._decode_fn(
                 self._params, self._kp, self._vp, self._block_tables,
-                pos0, toks, active,
+                pos0, toks, active, *lane_args,
             )
         self._check_one_executable(decode_sig)
         if self._flight is not None:
@@ -1470,6 +2006,11 @@ class InferenceEngine:
             a = int(accept[req.slot])
             self._spec_drafted += k
             self._spec_accepted += a
+            if req.sampling is not None and req.sampling.do_sample:
+                # rejection-sampling health, counted over sampled slots
+                # only (greedy slots use exact-prefix acceptance)
+                self._rej_drafted += k
+                self._rej_accepted += a
             for t in range(a + 1):
                 if req.state is RequestState.FINISHED:
                     break  # mid-round eos/length: the tail of the run is waste
@@ -1517,11 +2058,115 @@ class InferenceEngine:
         if _get_sanitizer():
             raise RuntimeError(message)
 
-    def _emit_token(self, req: Request, tok: int, finished: list[Request]) -> None:
+    def _first_token_pick(self, req: Request, logits):
+        """Per-slot first-token pick from the prompt-final logits the
+        prefill executable already returns: one ``[1, vocab]`` run of the
+        shared :func:`sampling.pick_tokens` at output position 0 — exact
+        key parity with the decode lanes, so a preempted-and-restarted
+        request reproduces its first token too."""
+        params = req.sampling or self._default_sampling
+        lanes = blank_lanes(1, self.config.rep_window)
+        set_slot_lane(
+            lanes, 0, params, pos=0, grammar_row=req.grammar_row,
+            dfa_state=req.dfa_state,
+            recent=req.prompt if params.repetition_penalty != 1.0 else (),
+        )
+        tok, logp, tvals, tids = self._first_pick_fn(
+            logits[None], lanes, self._gmask, self._base_key
+        )
+        entry = None
+        if params.logprobs:
+            entry = self._logprob_entry(
+                params, float(logp[0]), np.asarray(tvals[0]), np.asarray(tids[0])
+            )
+        return int(tok[0]), entry
+
+    @staticmethod
+    def _logprob_entry(params, logp: float, top_vals, top_ids) -> dict:
+        n = int(params.logprobs)
+        return {
+            "logprob": logp,
+            "top": [
+                [int(i), float(v)]
+                for i, v in zip(top_ids[:n], top_vals[:n])
+            ],
+        }
+
+    # -- grammar row lifecycle ------------------------------------------------
+
+    def _acquire_grammar_row(self, g) -> int:
+        """Pin one row of the device-resident grammar tables for a live
+        request. Rows are refcounted by grammar hash — concurrent requests
+        with the same schema share one row (and one upload). A fully-idle
+        row keeps its compiled tables cached LRU-style, so the common
+        serve pattern (many requests, few schemas) uploads each grammar
+        once; eviction only happens when a NEW grammar needs a row and
+        every free row is someone's cache entry. Runs at admission, so
+        exhaustion (every row pinned by a live request) fails the
+        add_request loudly instead of wedging a slot mid-decode."""
+        row = self._grammar_rows.get(g.hash)
+        if row is not None:
+            self._row_lru.pop(g.hash, None)
+            self._row_refs[row] += 1
+            return row
+        row = next(
+            (
+                r
+                for r in range(1, self.config.grammar_slots + 1)
+                if self._row_refs[r] == 0 and r not in self._row_grammar
+            ),
+            None,
+        )
+        if row is None:
+            if not self._row_lru:
+                raise ValueError(
+                    f"all {self.config.grammar_slots} grammar rows are held by "
+                    "live requests; raise EngineConfig.grammar_slots or retry "
+                    "after a constrained request finishes"
+                )
+            old_hash, row = self._row_lru.popitem(last=False)
+            del self._grammar_rows[old_hash]
+        allow, trans = g.padded_tables(self.config.grammar_states)
+        self._gmask = self._write_grammar_row_fn(
+            self._gmask, jnp.int32(row), jnp.asarray(allow)
+        )
+        self._gtrans = self._write_grammar_row_fn(
+            self._gtrans, jnp.int32(row), jnp.asarray(trans)
+        )
+        self._grammar_rows[g.hash] = row
+        self._row_grammar[row] = g
+        self._row_refs[row] += 1
+        return row
+
+    def _release_grammar_row(self, req: Request) -> None:
+        row = req.grammar_row
+        if not row:
+            return
+        req.grammar_row = 0
+        self._row_refs[row] -= 1
+        if self._row_refs[row] == 0:
+            # idle: keep the uploaded tables as an LRU cache entry so the
+            # next request with this schema skips the host→device write
+            self._row_lru[self._row_grammar[row].hash] = row
+
+    def _emit_token(
+        self, req: Request, tok: int, finished: list[Request], lp_entry=None
+    ) -> None:
         now = time.perf_counter()
         req.output_tokens.append(tok)
         self._pending_tok[req.slot] = tok
         self._tokens_emitted += 1
+        params = req.sampling
+        if self._psampling:
+            if params is not None and params.do_sample:
+                self._sampled_sample += 1
+            else:
+                self._sampled_greedy += 1
+        if lp_entry is not None:
+            lp_entry["token"] = tok
+            if req.logprobs is None:
+                req.logprobs = []
+            req.logprobs.append(lp_entry)
         if req.first_token_time is None:
             req.first_token_time = now
             if self._tr is not None:
@@ -1534,6 +2179,25 @@ class InferenceEngine:
             req.finish_reason = "eos"
         elif len(req.output_tokens) >= req.max_new_tokens:
             req.finish_reason = "length"
+        if req.grammar_row:
+            # advance the AUTHORITATIVE automaton state host-side (the
+            # in-trace advance only fed mid-burst masking); entering a
+            # state with no live continuation means the match is complete
+            self._grammar_masked_steps += 1
+            g = self._row_grammar[req.grammar_row]
+            req.dfa_state = g.advance(req.dfa_state, tok)
+            if req.finish_reason is None and g.final[req.dfa_state]:
+                req.finish_reason = "stop"
+        if (
+            req.finish_reason is None
+            and params is not None
+            and params.stop
+        ):
+            n = match_stop(req.output_tokens, params.stop)
+            if n:
+                # the matched stop sequence is not part of the answer
+                del req.output_tokens[-n:]
+                req.finish_reason = "stop"
         if req.finish_reason is not None:
             req.finish_time = now
             req.state = RequestState.FINISHED
@@ -1592,6 +2256,7 @@ class InferenceEngine:
                 out_of_blocks_total=self._out_of_blocks_total,
                 deadline_expired_total=self._deadline_expired,
                 **self._spec_stats(),
+                **self._sampling_stats(),
                 **self._hbm_watermarks(),
                 **(
                     self._flight.telemetry_fields()

@@ -93,8 +93,21 @@ class Request:
     state: RequestState = RequestState.QUEUED
     output_tokens: list[int] = field(default_factory=list)
     finish_reason: str | None = None
-    # "eos" | "length" | "out_of_blocks" | "deadline_exceeded"
+    # "eos" | "length" | "stop" | "out_of_blocks" | "deadline_exceeded"
     slot: int | None = None
+    #: resolved :class:`~.sampling.SamplingParams` (None on a
+    #: per_slot_sampling=False engine). Lives on the request — not the
+    #: slot — so preemption/swap/re-admission carries it for free and the
+    #: lanes are rebuilt from it on every dispatch.
+    sampling: object = None
+    #: grammar table row this request holds a reference on (0 = the
+    #: unconstrained sentinel row) and its authoritative DFA state — the
+    #: host advances it per emitted token; in-trace advances only feed
+    #: mid-burst masking and are discarded with the burst tail
+    grammar_row: int = 0
+    dfa_state: int = 0
+    #: per-token logprob dicts when the request asked for them
+    logprobs: list | None = None
     blocks: list[int] = field(default_factory=list)
     prefill_pos: int = 0  # prompt tokens whose K/V are already cached
     first_token_time: float | None = None
