@@ -43,7 +43,8 @@ def monitor_command(args) -> int:
     breach, and scripts can rely on the ordering.
     """
     from ..diagnostics.monitor import collect_status, render_status
-    from ..metrics.alerts import EXIT_SLO_VIOLATION, evaluate_alerts, write_alerts
+    from ..metrics.alerts import EXIT_SLO_VIOLATION
+    from ..metrics.slo import evaluate_from_dir, write_slo_alerts
 
     logging_dir = args.logging_dir
     if not os.path.isdir(logging_dir):
@@ -54,21 +55,25 @@ def monitor_command(args) -> int:
             status = collect_status(logging_dir)
             text = render_status(status)
             if args.once:
-                goodput = status.get("goodput") or {}
-                serving = status.get("serving") or {}
-                firing = evaluate_alerts(
-                    {
-                        "goodput_pct": goodput.get("goodput_pct"),
-                        "ttft_p99_s": serving.get("ttft_p99_s"),
-                        "recompiles_per_hour": status.get("recompiles_per_hour"),
-                    }
+                # windowed burn-rate evaluation (metrics/slo.py) over the
+                # run's own trails — the verdict lands in ALERTS.json
+                # (schema 2) exactly as the exporter would write it
+                verdict = evaluate_from_dir(logging_dir)
+                firing = verdict["firing"]
+                write_slo_alerts(
+                    logging_dir, firing, verdict["objectives"],
+                    snapshot=verdict["snapshot"],
                 )
-                write_alerts(logging_dir, firing)
                 for alert in firing:
+                    observed = alert.get("observed")
+                    extra = f", burn {alert['burn_rate']:.2f}x"
+                    if alert.get("dominant_phase"):
+                        extra += f", phase {alert['dominant_phase']}"
                     text += (
                         f"\n  !! SLO {alert['rule']}: observed "
-                        f"{alert['observed']:.4g} vs threshold "
-                        f"{alert['threshold']:.4g} ({alert['env']})"
+                        f"{observed if observed is None else format(observed, '.4g')}"
+                        f" vs threshold "
+                        f"{alert['threshold']:.4g} ({alert['env']}{extra})"
                     )
                 print(text)
                 if (

@@ -154,6 +154,27 @@ def route_command(args) -> int:
     from ..resilience.preemption import PreemptionHandler
     from ..serving.replica import ReplicaHandle, spawn_replica, wait_until_ready
     from ..serving.router import Router
+    from ..serving.workload import (
+        TraceSpecError,
+        WorkloadRecorder,
+        generate_schedule,
+        parse_trace_spec,
+        run_schedule,
+        write_workload_manifest,
+    )
+
+    # seeded replayable workload: parsed before anything spawns — a
+    # malformed spec is a bring-up refusal (exit 2), the --chaos-spec
+    # contract, not a fleet brought up to replay nothing
+    trace_spec = trace_schedule = None
+    if args.trace:
+        try:
+            trace_spec = parse_trace_spec(args.trace)
+            trace_schedule = generate_schedule(trace_spec)
+        except TraceSpecError as e:
+            print(json.dumps({"error": str(e)}))
+            print(f"route: refusing to start: {e}", file=sys.stderr)
+            return 2
 
     if args.logging_dir:
         os.makedirs(args.logging_dir, exist_ok=True)
@@ -217,6 +238,31 @@ def route_command(args) -> int:
         else:
             from ..serving.supervisor import ReplicaSupervisor, SupervisorConfig
 
+            # SLO-driven scaling: when the fleet has a logging dir and any
+            # ACCELERATE_SLO_* objective is armed, the supervisor's policy
+            # reads the windowed verdict (throttled — evaluation is file
+            # reads over the fleet's own trails)
+            slo_fn = None
+            if args.logging_dir:
+                from ..metrics.slo import configured_objectives, evaluate_from_dir
+
+                if configured_objectives():
+                    slo_cache = {"ts": 0.0, "verdict": None}
+                    slo_dir = args.logging_dir
+
+                    def slo_fn():
+                        now = time.monotonic()
+                        if now - slo_cache["ts"] >= 2.0:
+                            slo_cache["ts"] = now
+                            slo_cache["verdict"] = evaluate_from_dir(slo_dir)
+                        return slo_cache["verdict"]
+
+                    print(
+                        "route: SLO scaling policy armed "
+                        f"({', '.join(configured_objectives())})",
+                        file=sys.stderr,
+                    )
+
             # explicit is-None tests: --min-replicas 0 (scale-to-zero floor)
             # must not be rewritten to --replicas
             min_replicas = (
@@ -234,6 +280,7 @@ def route_command(args) -> int:
                     ready_timeout=args.ready_timeout,
                     seed=args.seed,
                 ),
+                slo_fn=slo_fn,
             )
     print(
         f"route: waiting for {len(replicas)} replica(s) to report ready...",
@@ -279,6 +326,20 @@ def route_command(args) -> int:
     inbox: queue.Queue = queue.Queue()
     eof = threading.Event()
 
+    # --trace-record: capture live arrivals into the replayable schedule
+    # format (workload/recorded.jsonl) — replay later with
+    # --trace replay:<path>
+    recorder = None
+    if getattr(args, "trace_record", False):
+        if args.logging_dir:
+            recorder = WorkloadRecorder(args.logging_dir)
+            print(f"route: recording workload to {recorder.path}", file=sys.stderr)
+        else:
+            print(
+                "route: --trace-record needs --logging-dir — ignoring",
+                file=sys.stderr,
+            )
+
     def read_stdin():
         for line in sys.stdin:
             line = line.strip()
@@ -289,10 +350,30 @@ def route_command(args) -> int:
             except json.JSONDecodeError as e:
                 emit({"error": f"bad JSON: {e}"})
                 continue
+            if recorder is not None:
+                recorder.observe(payload)
             inbox.put(payload)
         eof.set()
 
-    threading.Thread(target=read_stdin, daemon=True).start()
+    if trace_schedule is not None:
+        if args.logging_dir:
+            write_workload_manifest(args.logging_dir, trace_spec, trace_schedule)
+        print(
+            f"route: replaying workload {trace_spec.as_text()} "
+            f"({len(trace_schedule)} requests)", file=sys.stderr,
+        )
+
+        def feed_trace():
+            run_schedule(
+                trace_schedule,
+                inbox.put,
+                should_stop=lambda: handler.preemption_requested,
+            )
+            eof.set()
+
+        threading.Thread(target=feed_trace, daemon=True).start()
+    else:
+        threading.Thread(target=read_stdin, daemon=True).start()
 
     drain_reason = "eof"
     try:
@@ -340,6 +421,12 @@ def route_command(args) -> int:
             continue
     while not inbox.empty():
         router.submit(inbox.get_nowait(), callback=emit)
+    if recorder is not None:
+        recorder.close()
+        print(
+            f"route: recorded {recorder.recorded} request(s) to {recorder.path}",
+            file=sys.stderr,
+        )
     stats = router.stats()
     sup = stats.get("supervisor") or {}
     sup_text = (
@@ -442,5 +529,17 @@ def add_parser(subparsers):
                    help="forwarded to every replica's serve --chaos-spec "
                    "(entries scoped rN: fire only on replica N) — the "
                    "fault-injection harness benchmarks/chaos_smoke.py drives")
+    # replayable workload suite (serving/workload.py)
+    p.add_argument("--trace", default=None, metavar="SPEC",
+                   help="drive the fleet from a seeded replayable workload "
+                   "instead of stdin: 'name:seed:duration:rps' with name in "
+                   "bursty-diurnal|longctx-flood|agentic|overbudget-storm, "
+                   "or 'replay:<path>' for a recorded schedule (same seed = "
+                   "byte-identical schedule, manifest in WORKLOAD.json; "
+                   "malformed spec = exit 2)")
+    p.add_argument("--trace-record", action="store_true",
+                   help="capture live stdin arrivals into the replayable "
+                   "schedule format under <logging-dir>/workload/ — replay "
+                   "with --trace replay:<path>")
     p.set_defaults(func=route_command)
     return p

@@ -107,6 +107,14 @@ class ServeHealth:
                 out["queue_depth"] = int(engine.scheduler.queue_depth)
                 out["active_slots"] = len(engine.scheduler.active())
                 out["num_slots"] = int(engine.config.num_slots)
+                # cumulative engine-side deadline evictions: expiries happen
+                # in the replica (the slot is evicted, the partial answer
+                # still delivered), so without this the fleet totals — and
+                # the windowed error-rate objective reading them — would
+                # only ever see *router-queue* expiries
+                out["deadline_expired"] = int(
+                    getattr(engine, "_deadline_expired", 0)
+                )
             except Exception:
                 pass
         return out
@@ -466,6 +474,37 @@ def serve_command(args) -> int:
                 "stdin mode ignores the spec", file=sys.stderr,
             )
 
+    # seeded replayable workload (serving/workload.py): same contract as
+    # --chaos-spec — a malformed spec is a bring-up refusal (exit 2), not
+    # a silent empty run
+    from ..serving.workload import (
+        TraceSpecError,
+        generate_schedule,
+        parse_trace_spec,
+        run_schedule,
+        write_workload_manifest,
+    )
+
+    trace_spec = trace_schedule = None
+    if args.trace:
+        try:
+            trace_spec = parse_trace_spec(args.trace)
+            trace_schedule = generate_schedule(trace_spec)
+        except TraceSpecError as e:
+            emit({"error": str(e)})
+            print(f"serve: refusing to start: {e}", file=sys.stderr)
+            handler.uninstall()
+            return 2
+        if args.http:
+            # the HTTP door has external clients driving it; a workload
+            # generator feeding the same inbox would interleave with them
+            print(
+                "serve: --trace drives the stdin/JSONL loop — HTTP mode "
+                "ignores the spec (route --trace drives a fleet)",
+                file=sys.stderr,
+            )
+            trace_spec = trace_schedule = None
+
     try:
         if args.http:
             # factory form: the server binds FIRST (so /healthz answers
@@ -520,7 +559,25 @@ def serve_command(args) -> int:
                 inbox.put((payload, None))
             stop.set()
 
-        threading.Thread(target=read_stdin, daemon=True).start()
+        if trace_schedule is not None:
+            if args.logging_dir:
+                write_workload_manifest(args.logging_dir, trace_spec, trace_schedule)
+            print(
+                f"serve: replaying workload {trace_spec.as_text()} "
+                f"({len(trace_schedule)} requests)", file=sys.stderr,
+            )
+
+            def feed_trace():
+                run_schedule(
+                    trace_schedule,
+                    lambda payload: inbox.put((payload, None)),
+                    should_stop=lambda: health.draining or stop.is_set(),
+                )
+                stop.set()
+
+            threading.Thread(target=feed_trace, daemon=True).start()
+        else:
+            threading.Thread(target=read_stdin, daemon=True).start()
         try:
             _engine_loop(engine, inbox, emit, stop, health=health,
                          handler=handler, max_queue=args.max_queue)
@@ -824,6 +881,12 @@ def add_parser(subparsers):
                    help="bounded admission: shed (error row) any request "
                    "arriving while this many are already waiting for a slot "
                    "(default: unbounded, the pre-robustness behaviour)")
+    p.add_argument("--trace", default=None, metavar="SPEC",
+                   help="drive the engine from a seeded replayable workload "
+                   "instead of stdin: 'name:seed:duration:rps' with name in "
+                   "bursty-diurnal|longctx-flood|agentic|overbudget-storm, "
+                   "or 'replay:<path>' for a recorded schedule (same seed = "
+                   "byte-identical schedule; malformed spec = exit 2)")
     p.add_argument("--chaos-spec", default=None,
                    help="fault-injection schedule for chaos testing (env "
                    "ACCELERATE_CHAOS_SPEC; seed via ACCELERATE_CHAOS_SEED): "

@@ -141,6 +141,8 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
         "fleet": [],
         "fleet_dead": [],
         "router": None,
+        "slo": None,
+        "scale_decisions": [],
     }
 
     # -- telemetry tail ------------------------------------------------------
@@ -341,6 +343,9 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             elif row.get("kind") == "router":
                 # aggregate supervisor/admission totals, one row per tick
                 status["router"] = row
+            elif row.get("kind") == "scale_decision":
+                # the supervisor's SLO-policy verdicts (append-ordered)
+                status["scale_decisions"].append(row)
         for rid in sorted(latest):
             row = dict(latest[rid])
             row["row_age_s"] = (
@@ -383,6 +388,20 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
     from .reqtrace import tail_from_dir_throttled
 
     status["request_tail"] = tail_from_dir_throttled(logging_dir)
+
+    # -- SLO verdict (ALERTS.json, written by the exporter / monitor --once /
+    # metrics export — schema 2 carries the full windowed scorecard) ---------
+    from ..metrics.alerts import ALERTS_FILENAME
+
+    alerts_path = os.path.join(logging_dir, ALERTS_FILENAME)
+    if os.path.exists(alerts_path):
+        try:
+            with open(alerts_path) as f:
+                slo = json.load(f)
+            if isinstance(slo, dict):
+                status["slo"] = slo
+        except (OSError, json.JSONDecodeError):
+            pass
     return status
 
 
@@ -552,6 +571,48 @@ def render_status(status: dict[str, Any]) -> str:
             f"{goodput['elapsed_s']:.0f}s wall "
             f"({goodput.get('hosts', 1)} host(s))"
             + (f"   lost: {lost_text}" if lost_text else "")
+        )
+    slo = status.get("slo")
+    if isinstance(slo, dict) and (slo.get("objectives") or slo.get("firing")):
+        firing_names = {
+            f.get("rule") for f in (slo.get("firing") or []) if isinstance(f, dict)
+        }
+        objectives = slo.get("objectives") or {}
+        if objectives:
+            lines.append("  slo:")
+            for name, o in objectives.items():
+                if not isinstance(o, dict):
+                    continue
+                phase = o.get("dominant_phase")
+                lines.append(
+                    f"    {name:<24} burn {_fmt(o.get('burn_rate'), '{:.2f}')}x "
+                    f"(long {_fmt(o.get('burn_rate_long'), '{:.2f}')}x)  "
+                    f"budget {_fmt(o.get('budget_remaining'), '{:.2f}')}  "
+                    f"observed {_fmt(o.get('observed'), '{:.4g}')}"
+                    + (f"  phase {phase}" if phase else "")
+                    + ("  [FIRING]" if name in firing_names else "")
+                )
+        elif firing_names:  # pre-windowed (schema 1) ALERTS.json
+            lines.append("  slo: firing " + ", ".join(sorted(firing_names)))
+    decisions = status.get("scale_decisions")
+    if decisions:
+        last = decisions[-1]
+        evidence = ""
+        if last.get("objective"):
+            evidence = (
+                f"  [{last['objective']} burn "
+                f"{_fmt(last.get('burn_rate'), '{:.2f}')}x, phase "
+                f"{last.get('dominant_phase') or '?'}]"
+            )
+        lines.append(
+            f"  scale: {last.get('action')} ({last.get('reason')})  "
+            f"queue {_fmt(last.get('queue_depth'), '{}')}  "
+            f"ready {_fmt(last.get('ready_replicas'), '{}')}"
+            + evidence
+            + (
+                f"  ({len(decisions)} decision(s) in trail tail)"
+                if len(decisions) > 1 else ""
+            )
         )
     if status.get("skipped_unknown_schema"):
         lines.append(

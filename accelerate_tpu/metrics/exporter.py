@@ -27,11 +27,11 @@ import time
 from collections import deque
 
 from ..logging import get_logger
-from .alerts import evaluate_alerts, write_alerts
 from .goodput import BUCKETS, ledger_from_dir_throttled
 from .ingest import observe_record, observe_router_row
 from .openmetrics import CONTENT_TYPE, render_openmetrics
 from .registry import MetricsRegistry
+from .slo import SloEngine, publish_gauges, write_slo_alerts
 
 logger = get_logger(__name__)
 
@@ -81,6 +81,11 @@ class LoggingDirExporter:
         self._compile_rows = 0
         self._row_ts_min: float | None = None
         self._row_ts_max: float | None = None
+        # windowed SLO engine (metrics/slo.py): fed incrementally from the
+        # same row stream, evaluated on every refresh — ALERTS.json carries
+        # burn rates instead of lifetime-total verdicts
+        self.slo = SloEngine()
+        self._router_prev: tuple | None = None
         self.last_goodput: dict | None = None
         self.last_firing: list[dict] = []
         self.last_refresh: float | None = None
@@ -112,9 +117,15 @@ class LoggingDirExporter:
             self._row_ts_max = ts if self._row_ts_max is None else max(self._row_ts_max, ts)
         if row.get("type") == "compile":
             self._compile_rows += 1
+            if isinstance(ts, (int, float)):
+                self.slo.observe_recompile(ts)
         elif row.get("type") == "serving" and row.get("kind") == "request":
             if isinstance(row.get("ttft_s"), (int, float)):
                 self._ttfts.append(float(row["ttft_s"]))
+            if isinstance(ts, (int, float)):
+                self.slo.observe_request(
+                    ts, ttft_s=row.get("ttft_s"), tpot_s=row.get("tpot_s")
+                )
 
     def _tail_jsonl(self, path: str, on_row) -> None:
         """Rotation-proof incremental tail shared by every trail this
@@ -167,9 +178,33 @@ class LoggingDirExporter:
         path = os.path.join(self.logging_dir, "router", "replicas.jsonl")
         if not os.path.exists(path):
             return
-        self._tail_jsonl(
-            path, lambda row: observe_router_row(self.registry, row)
-        )
+        self._tail_jsonl(path, self._consume_router_row)
+
+    def _consume_router_row(self, row: dict) -> None:
+        observe_router_row(self.registry, row)
+        # totals-row cumulative counters → ok/error outcome deltas for the
+        # windowed error-rate objective, stamped at each row's own ts
+        if row.get("kind") != "router":
+            return
+        ts = row.get("ts")
+        delivered, shed = row.get("delivered"), row.get("shed")
+        # fleet-wide expiry counter (router queue + engine-side evictions)
+        # when the trail carries it; router-queue-only view otherwise
+        expired = row.get("fleet_deadline_expired")
+        if not isinstance(expired, (int, float)):
+            expired = row.get("deadline_expired")
+        if not isinstance(ts, (int, float)) or not all(
+            isinstance(v, (int, float)) for v in (delivered, shed, expired)
+        ):
+            return
+        if self._router_prev is not None:
+            d_ok = delivered - self._router_prev[0]
+            d_err = (shed - self._router_prev[1]) + (expired - self._router_prev[2])
+            # negative deltas mean a router restart reset the counters —
+            # skip the seam rather than counting time running backwards
+            if d_ok >= 0 and d_err >= 0:
+                self.slo.observe_outcomes(ts, ok=d_ok, errors=d_err)
+        self._router_prev = (delivered, shed, expired)
 
     # -- heartbeats / goodput / alerts ---------------------------------------
 
@@ -198,13 +233,16 @@ class LoggingDirExporter:
                 "host_watchdog_fired", "1 when the host's watchdog has fired"
             ).set(1.0 if hb.get("fired") else 0.0, host=host)
 
-    def _observe_goodput(self) -> None:
+    def _observe_goodput(self, now: float) -> None:
         # throttled: a per-second scrape must not re-parse the trace trails
         # continuously (shared cache with the monitor's repaint loop)
         ledger = ledger_from_dir_throttled(self.logging_dir)
         self.last_goodput = ledger
         if ledger is None:
             return
+        # the ledger is cumulative; stamped "now" it ages out of the SLO
+        # window once the trails stop being refreshed
+        self.slo.observe_goodput(now, ledger.get("goodput_pct"))
         self.registry.gauge(
             "goodput_ratio", "Productive-step fraction of elapsed wall-clock (0-1)"
         ).set(ledger["goodput_pct"] / 100.0)
@@ -242,32 +280,43 @@ class LoggingDirExporter:
 
     def refresh(self, now: float | None = None) -> list[dict]:
         """One scan: new telemetry rows → registry, goodput recomputed from
-        traces, heartbeats re-read, SLO rules evaluated (and ``ALERTS.json``
-        rewritten when any rule is armed). Returns the firing alerts."""
+        traces, heartbeats re-read, the windowed SLO objectives evaluated
+        as multi-window burn rates (and ``ALERTS.json`` schema 2 rewritten
+        when any objective is armed). Returns the firing breaches."""
         now = time.time() if now is None else now
         for path in self._segments():
             self._tail_segment(path)
         self._tail_router_trail()
         self._observe_heartbeats(now)
-        self._observe_goodput()
+        self._observe_goodput(now)
         if self._skipped_schema:
             self.registry.counter(
                 "rows_skipped_unknown_schema",
                 "Telemetry rows skipped for an unknown schema version",
             ).set_total(self._skipped_schema)
         snap = self.snapshot()
-        firing = evaluate_alerts(snap)
-        self.last_firing = firing
-        write_alerts(self.logging_dir, firing, snapshot=snap)
-        alert_gauge = self.registry.gauge(
-            "slo_violation", "1 while the named SLO rule is firing"
-        )
-        from .alerts import configured_rules
+        # dominant tail phase rides along on every breach row (throttled —
+        # shares the monitor's request-trace tail cache)
+        from ..diagnostics.reqtrace import tail_from_dir_throttled
 
-        for rule in configured_rules():
-            alert_gauge.set(
-                1.0 if any(f["rule"] == rule for f in firing) else 0.0, rule=rule
+        tail = tail_from_dir_throttled(self.logging_dir)
+        attribution = (tail or {}).get("attribution") or {}
+        if attribution:
+            self.slo.observe_phases(now, attribution)
+        report = self.slo.report(now)
+        firing = self.slo.evaluate(now)
+        self.last_firing = firing
+        write_slo_alerts(self.logging_dir, firing, report, snapshot=snap)
+        if report:
+            publish_gauges(self.registry, report)
+            alert_gauge = self.registry.gauge(
+                "slo_violation", "1 while the named SLO rule is firing"
             )
+            for rule in report:
+                alert_gauge.set(
+                    1.0 if any(f["rule"] == rule for f in firing) else 0.0,
+                    rule=rule,
+                )
         self.last_refresh = now
         return firing
 

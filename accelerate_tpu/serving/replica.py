@@ -62,6 +62,9 @@ class ReplicaHandle:
         self.queue_depth = 0
         self.active_slots = 0
         self.num_slots: int | None = None
+        # engine-side deadline evictions (cumulative, from /healthz): the
+        # router folds the fleet's sum into its totals row for the SLO feed
+        self.deadline_expired = 0
         self.last_heartbeat: float | None = None
         self.consecutive_failures = 0
         self.dispatched = 0
@@ -107,7 +110,9 @@ class ReplicaHandle:
                 self.process is not None and self.state in ("dead", "terminated")
             ):
                 self.state = payload["state"]
-            for field in ("queue_depth", "active_slots", "num_slots"):
+            for field in (
+                "queue_depth", "active_slots", "num_slots", "deadline_expired"
+            ):
                 if isinstance(payload.get(field), int):
                     setattr(self, field, payload[field])
             return payload

@@ -777,6 +777,7 @@ class Router:
                     "in_flight": r.in_flight,
                     "dispatched": r.dispatched,
                     "completed": r.completed,
+                    "deadline_expired": r.deadline_expired,
                     "sessions": len(r.sessions),
                     "restarts": r.restarts,
                     "probation": r.probation,
@@ -804,6 +805,17 @@ class Router:
                 "rejected": self._rejected,
                 "shed": self._shed,
                 "deadline_expired": self._deadline_expired,
+                # fleet-wide expiry view: deadlines mostly expire *inside*
+                # the replicas (slot evicted, partial answer still delivered)
+                # because dispatch is uncapped and the router queue rarely
+                # builds — the SLO error-rate feed reads this key so those
+                # expiries count as breach evidence too. Replica counters
+                # reset on restart; readers treat a negative delta as a seam.
+                "fleet_deadline_expired": self._deadline_expired
+                + sum(r.deadline_expired for r in self.replicas),
+                # summed engine admission backlog: the "queued" pressure
+                # signal when the router queue itself is empty
+                "replica_queue_depth": sum(r.queue_depth for r in self.replicas),
             }
         if self.supervisor is not None:
             sup = self.supervisor
@@ -827,6 +839,33 @@ class Router:
                 for row in rows:
                     # tpu-lint: ignore[RC003] — serializing this file IS the lock's job; leaf lock, nothing acquired under it
                     trail.write(json.dumps(row) + "\n")
+                trail.flush()  # tpu-lint: ignore[RC003] — same leaf-lock rationale
+            except (OSError, ValueError):
+                pass
+
+    def write_decision_row(self, fields: dict) -> None:
+        """Append a ``kind:"scale_decision"`` row to the fleet trail — the
+        supervisor's SLO policy logs every verdict (scale_up / scale_down /
+        hold, with the breached objective, burn rate, and dominant phase as
+        evidence) so a scaling action is auditable next to the fleet state
+        it reacted to. Same None-field convention as the totals rows, same
+        leaf-lock discipline."""
+        row = {
+            "schema": ROUTER_SCHEMA,
+            "ts": time.time(),
+            "kind": "scale_decision",
+            "replica_id": None,
+            "state": None,
+            "pid": None,
+            **fields,
+        }
+        with self._trail_lock:
+            trail = self._trail  # _shutdown nulls it under this same lock
+            if trail is None:
+                return
+            try:
+                # tpu-lint: ignore[RC003] — leaf lock, serializing this file is its job
+                trail.write(json.dumps(row, default=str) + "\n")
                 trail.flush()  # tpu-lint: ignore[RC003] — same leaf-lock rationale
             except (OSError, ValueError):
                 pass

@@ -27,6 +27,13 @@ alerts/metrics machinery closing its loop: sustained queue depth above
 ``scale_up_queue_per_replica`` per ready replica spawns a new member;
 a sustained idle fleet above ``min_replicas`` drains its highest-numbered
 member (SIGTERM → the serve front end's own drain path → ``terminated``).
+With an ``slo_fn`` wired (``route`` does this whenever a logging dir and
+armed ``ACCELERATE_SLO_*`` objectives exist), scaling becomes
+**SLO-driven**: a firing breach whose dominant tail phase is ``queued``
+scales up, a device-/swap-bound breach holds with a ``WRONG_REMEDY``
+decision row (capacity is not the fix), and scale-down requires the error
+budget to be intact — with every verdict logged as a
+``kind:"scale_decision"`` fleet-trail row carrying the evidence.
 
 Pure stdlib and jax-free like the rest of the router side. Disabled
 (``Router(supervisor=None)``, the default) the router behaves exactly as
@@ -42,6 +49,7 @@ from dataclasses import dataclass
 
 from ..analysis.lockwatch import maybe_watch
 from ..logging import get_logger
+from ..metrics.slo import NON_SCALABLE_PHASES
 
 logger = get_logger(__name__)
 
@@ -96,11 +104,22 @@ class ReplicaSupervisor:
             serve process with the fleet's engine arguments (the route CLI
             builds this closure; tests inject stubs).
         config: :class:`SupervisorConfig`.
+        slo_fn: optional ``() -> {"firing": [...], "objectives": {...}}``
+            (the :func:`~accelerate_tpu.metrics.slo.evaluate_from_dir`
+            shape) — arms the SLO scaling policy: scale up on a breach
+            whose dominant tail phase is ``queued``, hold with a
+            ``WRONG_REMEDY`` decision row when it is device- or swap-bound
+            (more replicas would not help), and scale down only while the
+            error budget is intact. Every verdict lands in the fleet trail
+            as a ``kind:"scale_decision"`` row with the evidence attached.
     """
 
-    def __init__(self, spawn_fn, config: SupervisorConfig | None = None):
+    def __init__(
+        self, spawn_fn, config: SupervisorConfig | None = None, slo_fn=None
+    ):
         self.spawn_fn = spawn_fn
         self.cfg = config or SupervisorConfig()
+        self.slo_fn = slo_fn
         self._rng = random.Random(self.cfg.seed)
         self._router = None
         self._lock = maybe_watch(threading.Lock(), "ReplicaSupervisor._lock")
@@ -113,9 +132,11 @@ class ReplicaSupervisor:
         self._pending: dict[int, float] = {}  # replica_id -> respawn_at
         self._idle_ticks = 0
         self._last_scale = 0.0
+        self._last_decision_sig: tuple | None = None
         self.respawns = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        self.decisions = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -229,6 +250,7 @@ class ReplicaSupervisor:
                 ),
                 "min_replicas": self.cfg.min_replicas,
                 "max_replicas": self.cfg.max_replicas,
+                "scale_decisions": self.decisions,
             }
 
     # -- internals -----------------------------------------------------------
@@ -344,6 +366,91 @@ class ReplicaSupervisor:
             )
             router._mark_dead(r)  # kills the process and calls notify_death
 
+    # -- SLO scaling policy ---------------------------------------------------
+
+    def _read_slo(self) -> dict | None:
+        """One throttled SLO verdict from ``slo_fn`` (route wires a
+        windowed :func:`~accelerate_tpu.metrics.slo.evaluate_from_dir`
+        closure; tests inject synthetic streams). Errors degrade to None —
+        a broken trail must never stall the supervision loop."""
+        if self.slo_fn is None:
+            return None
+        try:
+            verdict = self.slo_fn()
+        except Exception:
+            logger.warning("supervisor: slo_fn failed", exc_info=True)
+            return None
+        return verdict if isinstance(verdict, dict) else None
+
+    def _decide(
+        self, action, reason, breach=None, queue_depth=0, ready_count=0
+    ) -> None:
+        """Log one scaling verdict to the fleet trail. Holds are throttled
+        on their (action, reason, objective) signature — a steady-state
+        verdict lands once, not once per scale tick — while actual scale
+        actions always land."""
+        breach = breach or {}
+        sig = (action, reason, breach.get("objective"))
+        if action == "hold" and sig == self._last_decision_sig:
+            return
+        self._last_decision_sig = sig
+        router = self._router
+        writer = getattr(router, "write_decision_row", None)
+        with self._lock:
+            self.decisions += 1
+        if writer is None:
+            return
+        writer(
+            {
+                "action": action,
+                "reason": reason,
+                "objective": breach.get("objective"),
+                "burn_rate": breach.get("burn_rate"),
+                "dominant_phase": breach.get("dominant_phase"),
+                "budget_remaining": breach.get("budget_remaining"),
+                "queue_depth": queue_depth,
+                "ready_replicas": ready_count,
+            }
+        )
+
+    def _budget_intact(self, slo: dict | None) -> bool:
+        """True when no objective is firing and every armed objective with
+        evidence still has budget left — the only state scale-down is
+        allowed in when the SLO policy is armed."""
+        if not slo:
+            return True
+        if slo.get("firing"):
+            return False
+        for row in (slo.get("objectives") or {}).values():
+            remaining = row.get("budget_remaining")
+            if isinstance(remaining, (int, float)) and remaining <= 0:
+                return False
+        return True
+
+    def _scale_up(self, next_id, queue_depth, ready_count, reason, breach=None):
+        router = self._router
+        self._idle_ticks = 0
+        try:
+            handle = self.spawn_fn(next_id)
+        except Exception:
+            logger.warning("supervisor: scale-up spawn failed", exc_info=True)
+            return
+        with self._lock:
+            meta = self._fresh_meta(time.monotonic())
+            meta["supervised_spawn"] = True  # this bring-up is ours to deadline
+            self._meta[next_id] = meta
+            self.scale_ups += 1
+        with router._lock:
+            router.replicas.append(handle)
+        self._decide(
+            "scale_up", reason, breach=breach,
+            queue_depth=queue_depth, ready_count=ready_count,
+        )
+        logger.info(
+            "supervisor: scaled up — replica %d spawned (%s; queue %d over %d ready)",
+            next_id, reason, queue_depth, ready_count,
+        )
+
     def _autoscale(self) -> None:
         cfg = self.cfg
         router = self._router
@@ -358,32 +465,56 @@ class ReplicaSupervisor:
             next_id = 1 + max((r.replica_id for r in router.replicas), default=-1)
         with self._lock:
             planned = len(live) + len(self._pending)
+        slo = self._read_slo()
+        breach = (slo or {}).get("firing") or None
+        if breach:
+            # evaluate() sorts worst-first: act on the breach burning
+            # budget fastest, and let its dominant tail phase pick the
+            # remedy — capacity only fixes *queueing*
+            worst = breach[0]
+            phase = worst.get("dominant_phase")
+            self._idle_ticks = 0
+            if phase == "queued":
+                if planned < cfg.max_replicas:
+                    self._scale_up(
+                        next_id, queue_depth, len(ready), "slo_breach", worst
+                    )
+                else:
+                    self._decide(
+                        "hold", "at_max_replicas", breach=worst,
+                        queue_depth=queue_depth, ready_count=len(ready),
+                    )
+            elif phase in NON_SCALABLE_PHASES:
+                # the tail is device- or HBM-bound: another replica is
+                # another waiting device — say so instead of scaling
+                self._decide(
+                    "hold", "WRONG_REMEDY", breach=worst,
+                    queue_depth=queue_depth, ready_count=len(ready),
+                )
+            else:
+                self._decide(
+                    "hold", f"phase_{phase or 'unattributed'}", breach=worst,
+                    queue_depth=queue_depth, ready_count=len(ready),
+                )
+            return
         # scale up: sustained congestion per ready member
         if (
             cfg.scale_up_queue_per_replica > 0
             and planned < cfg.max_replicas
             and queue_depth > cfg.scale_up_queue_per_replica * max(len(ready), 1)
         ):
-            self._idle_ticks = 0
-            try:
-                handle = self.spawn_fn(next_id)
-            except Exception:
-                logger.warning("supervisor: scale-up spawn failed", exc_info=True)
-                return
-            with self._lock:
-                meta = self._fresh_meta(time.monotonic())
-                meta["supervised_spawn"] = True  # this bring-up is ours to deadline
-                self._meta[next_id] = meta
-                self.scale_ups += 1
-            with router._lock:
-                router.replicas.append(handle)
-            logger.info(
-                "supervisor: scaled up — replica %d spawned (queue %d over %d ready)",
-                next_id, queue_depth, len(ready),
-            )
+            self._scale_up(next_id, queue_depth, len(ready), "queue_depth")
             return
-        # scale down: sustained idleness above the floor
+        # scale down: sustained idleness above the floor — and, when the
+        # SLO policy is armed, only with the error budget intact
         if queue_depth == 0 and outstanding == 0 and len(ready) > cfg.min_replicas:
+            if not self._budget_intact(slo):
+                self._idle_ticks = 0
+                self._decide(
+                    "hold", "budget_spent",
+                    queue_depth=queue_depth, ready_count=len(ready),
+                )
+                return
             self._idle_ticks += 1
             if self._idle_ticks >= cfg.scale_down_idle_ticks:
                 self._idle_ticks = 0
@@ -401,6 +532,11 @@ class ReplicaSupervisor:
                 with self._lock:
                     self.scale_downs += 1
                 victim.drain()  # SIGTERM → serve's own drain → exit 0
+                self._decide(
+                    "scale_down",
+                    "budget_intact_idle" if self.slo_fn is not None else "idle",
+                    queue_depth=queue_depth, ready_count=len(ready),
+                )
                 logger.info(
                     "supervisor: scaled down — replica %d draining (idle fleet "
                     "above min_replicas=%d)", victim.replica_id, cfg.min_replicas,

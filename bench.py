@@ -630,6 +630,70 @@ def _mode_chaos(platform: str) -> None:
     )
 
 
+def _mode_fleet(platform: str) -> None:
+    """SLO closed-loop row: the seeded ``overbudget-storm`` workload on a
+    real supervised 2-replica fleet, twice (benchmarks/slo_smoke.py —
+    byte-identical schedules, breach-driven scale decisions with evidence,
+    scorecard/gauge agreement, exactly-once delivery, decode_compiles==1),
+    plus the slo-engine DISABLED-path guard as a timeit micro-benchmark
+    over a toy train step (the ``slo_overhead_pct`` bar: <1%). Fleet-leg
+    figures are counts/flags only, per the timing-noise rule."""
+    import os
+    import timeit
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.slo_smoke import run as fleet_run
+
+    r = fleet_run(platform)
+
+    # disabled-path guard: with nothing armed every observe_* call in the
+    # exporter's engine is a single `self.armed` attribute check — the only
+    # cost an SLO-off process pays per telemetry/router row
+    from accelerate_tpu.metrics.slo import SloEngine
+
+    engine = SloEngine(objectives={})
+    n = 50_000
+    guard_s = min(
+        timeit.repeat(
+            lambda: engine.observe_request(0.0, ttft_s=0.01, tpot_s=0.001),
+            number=n, repeat=5,
+        )
+    ) / n
+
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils import RegressionModel
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator()
+    model, opt = accelerator.prepare(RegressionModel(a=0.0, b=0.0), optax.sgd(0.1))
+    x = np.linspace(-1, 1, 64).astype(np.float32)
+    batch = {"x": x, "y": (2 * x + 3).astype(np.float32)}
+
+    def step():
+        out = model(**batch)
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        return out.loss.force()
+
+    step()  # compile outside the timing
+    step_s = min(timeit.repeat(step, number=20, repeat=5)) / 20
+
+    print(
+        f"BENCH_FLEET {guard_s:.12f} {step_s:.9f} "
+        f"{1 if r['schedules_identical'] else 0} "
+        f"{max(r['scale_decisions'])} {r['n_requests']} "
+        f"{max(r['expired_or_shed'])} "
+        f"{r['decode_compiles'][0]} {r['decode_compiles'][1]} "
+        f"{1 if r['slo_gauges_agree'] else 0}"
+    )
+
+
 def _mode_spec(platform: str) -> None:
     """Speculative-decode row (VERDICT r5 #2): a 2-layer early-exit draft
     (the target's first two layers + its embeddings/norm/head — the
@@ -1437,6 +1501,128 @@ def _seq_row(platform: str, device_kind: str, n_dev: int, seq: int) -> dict | No
     }
 
 
+#: headline keys comparable across commits: only ratios travel between
+#: hosts (absolute tokens/s moves with the machine). Suffix-matched.
+_RATIO_SUFFIXES = ("_ratio", "_pct", "_mfu", "_speedup", "_rate")
+#: among those, overhead percentages regress by going UP
+_LOWER_IS_BETTER = ("_overhead_pct",)
+
+
+def _persist_run(headline, extra_rows):
+    """Write ``BENCH_<git-sha>_<n>.json`` next to this script — one file
+    per run so ``bench.py compare`` can flag regressions across commits.
+    Best-effort: a read-only checkout must not fail the bench."""
+    import os
+
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "nogit"
+        except Exception:
+            sha = "nogit"
+        n = 0
+        while os.path.exists(os.path.join(here, f"BENCH_{sha}_{n}.json")):
+            n += 1
+        path = os.path.join(here, f"BENCH_{sha}_{n}.json")
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "ts": time.time(),
+                    "git_sha": sha,
+                    "headline": headline,
+                    "extra_rows": extra_rows,
+                },
+                f, indent=2, sort_keys=True,
+            )
+            f.write("\n")
+        print(f"bench: persisted {os.path.basename(path)}", file=sys.stderr)
+    except Exception:
+        pass
+
+
+def _mode_compare(argv):
+    """``bench.py compare [--against FILE]``: newest persisted run vs the
+    previous one (or FILE), ratio-suffix headline keys only — absolute
+    throughputs are host-dependent and never compared. A >10% regression
+    on any ratio key exits 1."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    runs = sorted(
+        glob.glob(os.path.join(here, "BENCH_*.json")), key=os.path.getmtime
+    )
+    cur_path = runs[-1] if runs else None
+    if "--against" in argv:
+        base_path = argv[argv.index("--against") + 1]
+    else:
+        base_path = runs[-2] if len(runs) >= 2 else None
+    if not base_path or not cur_path:
+        print(
+            "compare: need two persisted BENCH_*.json runs (or --against "
+            "FILE); run `python bench.py` first"
+        )
+        return 2
+
+    def _headline(path):
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            return {}
+        if isinstance(data.get("headline"), dict):
+            return data["headline"]
+        # driver artifacts ({"cmd", "rc", "tail"}): the headline JSON is
+        # the last {...} line of the captured stdout tail — printed last
+        # exactly so it survives tail truncation
+        tail = data.get("tail")
+        if isinstance(tail, str):
+            for line in reversed(tail.splitlines()):
+                line = line.strip()
+                if line.startswith("{") and line.endswith("}"):
+                    try:
+                        parsed = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(parsed, dict):
+                        return parsed
+        return {}
+
+    base, cur = _headline(base_path), _headline(cur_path)
+    rows, regressions = [], []
+    for key in sorted(set(base) & set(cur)):
+        if not key.endswith(_RATIO_SUFFIXES):
+            continue
+        b, c = base.get(key), cur.get(key)
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)) or not b:
+            continue
+        delta = (c - b) / abs(b)
+        regressed = (
+            delta > 0.10 if key.endswith(_LOWER_IS_BETTER) else delta < -0.10
+        )
+        rows.append((key, b, c, delta, regressed))
+        if regressed:
+            regressions.append(key)
+    print(
+        f"compare: {os.path.basename(base_path)} -> {os.path.basename(cur_path)}"
+    )
+    for key, b, c, delta, regressed in rows:
+        flag = "  REGRESSION" if regressed else ""
+        print(f"  {key:42s} {b:>12.4f} -> {c:>12.4f}  ({delta:+7.1%}){flag}")
+    if not rows:
+        print("  no comparable ratio keys in common")
+    if regressions:
+        print(
+            f"compare: {len(regressions)} regression(s) >10%: "
+            + ", ".join(regressions)
+        )
+        return 1
+    print("compare: OK (no ratio key regressed >10%)")
+    return 0
+
+
 def main():
     probe = _run_subprocess("probe", "unknown")
     platform = probe["BENCH_PLATFORM"][0]
@@ -1728,6 +1914,40 @@ def main():
                 "(recovery_ratio 1.0 = fully healed). Ratios only — on "
                 "CPU both legs are dispatch-bound and this box's clock "
                 "swings ±5x; the credible ratio is a real multi-chip host",
+            }
+        )
+    except Exception:
+        pass
+    try:
+        flt = _run_subprocess("fleet", platform, attempts=2)
+        (sl_guard, sl_step, sl_ident, sl_dec, sl_req, sl_err, sl_c0, sl_c1,
+         sl_agree) = (float(v) for v in flt["BENCH_FLEET"])
+        extra_rows.append(
+            {
+                "metric": "slo_overhead_pct",
+                "value": (
+                    round(sl_guard / sl_step * 100.0, 6) if sl_step else None
+                ),
+                "unit": "%",
+                "disabled_guard_s_per_call": sl_guard,
+                "toy_step_s": sl_step,
+                "workload_schedules_identical": bool(sl_ident),
+                "scale_decisions": int(sl_dec),
+                "fleet_requests_per_leg": int(sl_req),
+                "shed_or_expired_per_leg": int(sl_err),
+                "decode_compiles": [int(sl_c0), int(sl_c1)],
+                "slo_gauges_agree_with_report": bool(sl_agree),
+                "note": "SLO closed loop (benchmarks/slo_smoke.py): the "
+                "seeded overbudget-storm workload replayed twice on a real "
+                "supervised 2-replica fleet — byte-identical schedules, "
+                "windowed breach fired, supervisor logged scale_decision "
+                "rows with the evidence, slo report verdicts round-trip "
+                "--json and agree with the /metrics slo_* gauges, "
+                "exactly-once delivery and decode_compiles==1 preserved. "
+                "The headline is the slo-engine DISABLED path — one "
+                "`self.armed` check per observe_* call with nothing armed "
+                "— as a fraction of a toy train step (timeit min-of-5; "
+                "bar: <1%)",
             }
         )
     except Exception:
@@ -2246,6 +2466,7 @@ def main():
         "request_trace_overhead_pct": ("request_trace_overhead_pct", "value"),
         "flight_overhead_pct": ("flight_overhead_pct", "value"),
         "sampling_overhead_pct": ("sampling_overhead_pct", "value"),
+        "slo_overhead_pct": ("slo_overhead_pct", "value"),
         "sanitize_overhead_pct": ("sanitize_overhead_pct", "value"),
         "lockwatch_overhead_pct": ("lockwatch_overhead_pct", "value"),
         "shard_check_seconds": ("shard_check_s", "value"),
@@ -2308,14 +2529,17 @@ def main():
             headline[f"offload_{tag}_gb_per_s"] = row.get("value")
             headline["disk_raw_gb_per_s"] = row.get("disk_raw_gb_per_s")
     print(json.dumps(headline))
+    _persist_run(headline, extra_rows)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "compare":
+        sys.exit(_mode_compare(sys.argv[2:]))
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
         "decode", "telemetry", "watchdog", "metrics", "sanitize", "race",
         "shard", "goodput", "ckpt", "serve", "spec", "spec-serve", "route",
-        "radix", "kv", "chaos", "reqtrace", "flight", "sampling",
+        "radix", "kv", "chaos", "reqtrace", "flight", "sampling", "fleet",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -2346,6 +2570,7 @@ if __name__ == "__main__":
             "reqtrace": _mode_reqtrace,
             "flight": _mode_flight,
             "sampling": _mode_sampling,
+            "fleet": _mode_fleet,
         }
         dispatch[mode](platform)
         sys.stdout.flush()

@@ -1,0 +1,492 @@
+"""SLO closed-loop tests: the replayable workload suite (spec parsing,
+byte-identical seeded schedules), the windowed burn-rate engine (multi-
+window firing, abstention, the recompile-storm aging regression), the
+file-fed evaluation path (router totals deltas, the fleet-wide expiry
+counter, queued-phase fallback), the supervisor's SLO scaling policy
+(scale up only for queued breaches, WRONG_REMEDY for device-bound tails,
+scale down only with budget intact — every verdict an evidenced
+``scale_decision`` row), schema-2 ``ALERTS.json``, and the ``slo report``
+scorecard."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from accelerate_tpu.metrics.slo import (
+    ALERTS_SCHEMA,
+    LONG_WINDOW_FACTOR,
+    NON_SCALABLE_PHASES,
+    SloEngine,
+    configured_objectives,
+    evaluate_from_dir,
+    write_slo_alerts,
+)
+from accelerate_tpu.serving.supervisor import ReplicaSupervisor, SupervisorConfig
+from accelerate_tpu.serving.workload import (
+    SCENARIOS,
+    TraceSpecError,
+    generate_schedule,
+    parse_trace_spec,
+    schedule_bytes,
+    schedule_digest,
+    write_workload_manifest,
+)
+
+NOW = 1_700_000_000.0  # fixed evaluation instant: no test reads the clock
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_slo(monkeypatch):
+    """Objectives arm from ``ACCELERATE_SLO_*`` — strip any ambient config
+    so each test arms exactly what it sets."""
+    for key in list(os.environ):
+        if key.startswith("ACCELERATE_SLO_"):
+            monkeypatch.delenv(key)
+
+
+# ---------------------------------------------------------------------------
+# workload suite: spec parsing + seeded determinism
+# ---------------------------------------------------------------------------
+
+
+def test_parse_trace_spec_roundtrip():
+    spec = parse_trace_spec("bursty-diurnal:7:30:4")
+    assert (spec.name, spec.seed, spec.duration_s, spec.rps) == (
+        "bursty-diurnal", 7, 30.0, 4.0,
+    )
+    assert parse_trace_spec(spec.as_text()) == spec
+    replay = parse_trace_spec("replay:/tmp/some/schedule.jsonl")
+    assert replay.name == "replay" and replay.path == "/tmp/some/schedule.jsonl"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "   ",
+        "nope:1:2:3",              # unknown scenario
+        "bursty-diurnal",          # missing fields
+        "bursty-diurnal:1:2",      # wrong arity
+        "bursty-diurnal:1:2:3:4",  # wrong arity
+        "bursty-diurnal:-1:2:3",   # negative seed
+        "bursty-diurnal:x:2:3",    # non-integer seed
+        "bursty-diurnal:1:0:3",    # non-positive duration
+        "bursty-diurnal:1:2:nan",  # NaN rps
+        "replay",                  # replay without a path
+    ],
+)
+def test_parse_trace_spec_rejects(bad):
+    with pytest.raises(TraceSpecError):
+        parse_trace_spec(bad)
+
+
+def test_bursty_diurnal_7_schedule_is_byte_identical():
+    """The acceptance determinism case: two independent parses of the same
+    spec yield the same bytes (and therefore the same digest)."""
+    a = generate_schedule(parse_trace_spec("bursty-diurnal:7:30:4"))
+    b = generate_schedule(parse_trace_spec("bursty-diurnal:7:30:4"))
+    assert schedule_bytes(a) == schedule_bytes(b)
+    assert schedule_digest(a) == schedule_digest(b)
+    assert a, "seeded schedule came out empty"
+
+
+@pytest.mark.parametrize("name", SCENARIOS)
+def test_every_scenario_is_deterministic_and_ordered(name):
+    spec = parse_trace_spec(f"{name}:3:10:4")
+    a, b = generate_schedule(spec), generate_schedule(spec)
+    assert schedule_digest(a) == schedule_digest(b)
+    arrivals = [r["t"] for r in a]
+    assert arrivals == sorted(arrivals)
+    for row in a:
+        payload = row["payload"]
+        assert isinstance(payload["id"], str) and payload["prompt"]
+        assert payload["max_new_tokens"] > 0
+
+
+def test_different_seed_different_schedule():
+    a = generate_schedule(parse_trace_spec("agentic:1:10:4"))
+    b = generate_schedule(parse_trace_spec("agentic:2:10:4"))
+    assert schedule_digest(a) != schedule_digest(b)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_engine_is_inert():
+    engine = SloEngine(objectives={})
+    assert not engine.armed
+    engine.observe_request(NOW, ttft_s=9.0, tpot_s=9.0, error=True)
+    engine.observe_recompile(NOW, n=100)
+    engine.observe_goodput(NOW, 0.0)
+    assert engine.evaluate(NOW) == []
+    assert engine.report(NOW) == {}
+    assert not engine._outcomes and not engine._recompiles
+
+
+def test_error_rate_fires_only_with_evidence_in_both_windows(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    engine = SloEngine()
+    # violations only in the long window (older than 60 s): the short
+    # window abstains, so the multi-window construction must NOT fire
+    engine.observe_outcomes(NOW - 120, ok=10, errors=10)
+    assert engine.evaluate(NOW) == []
+    # fresh violations too → both windows burn > 1 → fires, worst evidence
+    engine.observe_outcomes(NOW - 5, ok=10, errors=10)
+    (breach,) = engine.evaluate(NOW)
+    assert breach["rule"] == breach["objective"] == "max_error_rate"
+    assert breach["env"] == "ACCELERATE_SLO_MAX_ERROR_RATE"
+    assert breach["burn_rate"] > 1.0 and breach["burn_rate_long"] > 1.0
+    assert breach["observed"] == pytest.approx(0.5)
+    assert breach["budget_remaining"] == 0.0
+
+
+def test_recompile_storm_ages_out_of_the_window(monkeypatch):
+    """Regression for the lifetime-total bug: a recompile storm that ended
+    more than two windows ago must not keep the alert firing forever."""
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_RECOMPILES_PER_HOUR", "10")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_RECOMPILES_PER_HOUR_WINDOW_S", "60")
+    engine = SloEngine()
+    engine.observe_recompile(NOW - 190, n=50)  # >2 windows old
+    assert all(f["rule"] != "max_recompiles_per_hour" for f in engine.evaluate(NOW))
+    fresh = SloEngine()
+    fresh.observe_recompile(NOW - 5, n=50)
+    (breach,) = fresh.evaluate(NOW)
+    assert breach["rule"] == "max_recompiles_per_hour"
+    assert breach["burn_rate"] > 1.0
+
+
+def test_old_events_are_pruned_past_the_long_window(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    engine = SloEngine()
+    engine.observe_outcomes(NOW - 60 * LONG_WINDOW_FACTOR - 10, ok=1, errors=1)
+    engine.report(NOW)
+    assert not engine._outcomes, "event survived past the long-window horizon"
+
+
+def test_goodput_threshold_at_or_above_100_still_fires(monkeypatch):
+    """The clamp: a target that leaves zero badness allowance (the smoke
+    arms 101 to force a breach) must still produce a finite burn > 1."""
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "101")
+    engine = SloEngine()
+    engine.observe_goodput(NOW - 1, 99.0)
+    (breach,) = engine.evaluate(NOW)
+    assert breach["rule"] == "min_goodput_pct"
+    assert breach["observed"] == pytest.approx(99.0)
+    assert breach["burn_rate"] > 1.0
+
+
+def test_ttft_p99_burn_is_violating_fraction_over_budget(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S", "0.1")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S_WINDOW_S", "60")
+    engine = SloEngine()
+    for i in range(95):
+        engine.observe_request(NOW - 10, ttft_s=0.01)
+    for i in range(5):
+        engine.observe_request(NOW - 10, ttft_s=0.5)
+    report = engine.report(NOW)["max_ttft_p99_s"]
+    # 5% of requests violate against a 1% budget → burn 5.0
+    assert report["burn_rate"] == pytest.approx(5.0)
+    assert report["firing"] is True
+    assert report["observed"] == pytest.approx(0.5)  # the windowed p99
+
+
+def test_abstention_on_no_evidence(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S", "0.1")
+    engine = SloEngine()
+    report = engine.report(NOW)["max_ttft_p99_s"]
+    assert report["burn_rate"] is None and report["firing"] is False
+
+
+def test_breach_carries_dominant_phase_and_sorts_worst_first(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.1")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    monkeypatch.setenv("ACCELERATE_SLO_MIN_GOODPUT_PCT", "99")
+    engine = SloEngine()
+    engine.observe_outcomes(NOW - 5, ok=0, errors=10)   # burn = 1/0.1 = 10
+    engine.observe_goodput(NOW - 5, 97.0)               # burn = 3
+    engine.observe_phases(NOW - 5, {"queued": 80.0, "device_wait": 20.0})
+    firing = engine.evaluate(NOW)
+    assert [f["rule"] for f in firing] == ["max_error_rate", "min_goodput_pct"]
+    assert all(f["dominant_phase"] == "queued" for f in firing)
+
+
+def test_window_and_budget_env_overrides(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S", "0.1")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S_WINDOW_S", "42")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_TTFT_P99_S_BUDGET", "0.05")
+    obj = configured_objectives()["max_ttft_p99_s"]
+    assert obj["window_s"] == 42.0 and obj["budget"] == 0.05
+
+
+# ---------------------------------------------------------------------------
+# file-fed evaluation: router totals deltas + the fleet-wide expiry counter
+# ---------------------------------------------------------------------------
+
+
+def _write_totals_rows(logging_dir, rows):
+    os.makedirs(os.path.join(logging_dir, "router"), exist_ok=True)
+    with open(os.path.join(logging_dir, "router", "replicas.jsonl"), "w") as f:
+        for row in rows:
+            f.write(json.dumps({"schema": 1, "kind": "router", **row}) + "\n")
+
+
+def test_evaluate_from_dir_prefers_fleet_expiry_counter(tmp_path, monkeypatch):
+    """Engine-side deadline evictions reach the totals row only via
+    ``fleet_deadline_expired`` — the error-rate objective must count them
+    even while the router-queue counter (``deadline_expired``) stays 0."""
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    logdir = str(tmp_path)
+    _write_totals_rows(
+        logdir,
+        [
+            {"ts": NOW - 20, "delivered": 0, "shed": 0,
+             "deadline_expired": 0, "fleet_deadline_expired": 0,
+             "queue_depth": 0, "replica_queue_depth": 0},
+            {"ts": NOW - 5, "delivered": 15, "shed": 0,
+             "deadline_expired": 0, "fleet_deadline_expired": 5,
+             "queue_depth": 0, "replica_queue_depth": 3},
+        ],
+    )
+    verdict = evaluate_from_dir(logdir, now=NOW)
+    (breach,) = verdict["firing"]
+    assert breach["rule"] == "max_error_rate"
+    assert breach["observed"] == pytest.approx(5 / 20)
+    # no traced tail exists, but the summed *replica* backlog is > 0 —
+    # the fallback attributes the breach to queueing (the scalable phase)
+    assert breach["dominant_phase"] == "queued"
+
+
+def test_evaluate_from_dir_skips_counter_reset_seam(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    logdir = str(tmp_path)
+    _write_totals_rows(
+        logdir,
+        [
+            {"ts": NOW - 30, "delivered": 100, "shed": 0,
+             "deadline_expired": 0, "fleet_deadline_expired": 40},
+            # router restarted: counters reset — the negative delta is a
+            # seam, not 40 fresh errors
+            {"ts": NOW - 10, "delivered": 5, "shed": 0,
+             "deadline_expired": 0, "fleet_deadline_expired": 0},
+        ],
+    )
+    assert evaluate_from_dir(logdir, now=NOW)["firing"] == []
+
+
+def test_write_slo_alerts_schema2_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    engine = SloEngine()
+    engine.observe_outcomes(NOW - 5, ok=0, errors=10)
+    objectives = engine.report(NOW)
+    path = write_slo_alerts(str(tmp_path), engine.evaluate(NOW), objectives)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["schema"] == ALERTS_SCHEMA
+    assert payload["rules"] == {"max_error_rate": 0.01}
+    assert payload["firing"][0]["rule"] == "max_error_rate"
+    assert payload["objectives"]["max_error_rate"]["firing"] is True
+    # a resolved breach rewrites the file with an empty firing list
+    # rather than leaving a stale page
+    write_slo_alerts(str(tmp_path), [], objectives)
+    with open(path) as f:
+        assert json.load(f)["firing"] == []
+
+
+# ---------------------------------------------------------------------------
+# supervisor SLO policy — synthetic verdicts, no processes
+# ---------------------------------------------------------------------------
+
+
+class _FakeProcess:
+    def poll(self):
+        return None
+
+
+class _FakeHandle:
+    def __init__(self, replica_id, state="ready"):
+        self.replica_id = replica_id
+        self.state = state
+        self.in_flight = 0
+        self.process = _FakeProcess()
+        self.drained = False
+
+    def drain(self):
+        self.drained = True
+
+
+class _FakeRouter:
+    def __init__(self, n_ready=2):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._outstanding = 0
+        self.replicas = [_FakeHandle(i) for i in range(n_ready)]
+        self.decision_rows = []
+
+    def write_decision_row(self, fields):
+        self.decision_rows.append(dict(fields))
+
+
+def _breach(phase, objective="max_error_rate", burn=12.5):
+    row = {
+        "objective": objective,
+        "rule": objective,
+        "burn_rate": burn,
+        "burn_rate_long": burn,
+        "budget_remaining": 0.0,
+        "dominant_phase": phase,
+    }
+    return {"firing": [row], "objectives": {objective: row}}
+
+
+def _supervisor(router, slo, **cfg_kwargs):
+    cfg = SupervisorConfig(
+        min_replicas=1, max_replicas=3, scale_down_idle_ticks=1, **cfg_kwargs
+    )
+    spawned = []
+
+    def spawn_fn(replica_id):
+        handle = _FakeHandle(replica_id, state="starting")
+        spawned.append(handle)
+        return handle
+
+    sup = ReplicaSupervisor(spawn_fn, cfg, slo_fn=lambda: slo)
+    sup._router = router  # bind() would start the loop thread; drive by hand
+    return sup, spawned
+
+
+def test_queued_breach_scales_up_with_evidence():
+    """The acceptance case: a queued-dominated breach ⇒ one spawn and a
+    ``scale_decision`` row citing the objective, burn rate, and phase."""
+    router = _FakeRouter()
+    sup, spawned = _supervisor(router, _breach("queued"))
+    sup._autoscale()
+    assert len(spawned) == 1 and spawned[0].replica_id == 2
+    assert spawned[0] in router.replicas
+    (row,) = router.decision_rows
+    assert row["action"] == "scale_up" and row["reason"] == "slo_breach"
+    assert row["objective"] == "max_error_rate"
+    assert row["burn_rate"] == pytest.approx(12.5)
+    assert row["dominant_phase"] == "queued"
+
+
+def test_queued_breach_at_max_replicas_holds():
+    router = _FakeRouter(n_ready=3)
+    sup, spawned = _supervisor(router, _breach("queued"))
+    sup._autoscale()
+    assert not spawned
+    (row,) = router.decision_rows
+    assert (row["action"], row["reason"]) == ("hold", "at_max_replicas")
+    assert row["objective"] == "max_error_rate"
+
+
+@pytest.mark.parametrize("phase", NON_SCALABLE_PHASES)
+def test_device_bound_breach_holds_wrong_remedy(phase):
+    router = _FakeRouter()
+    sup, spawned = _supervisor(router, _breach(phase))
+    sup._autoscale()
+    assert not spawned, f"scaled up for a {phase}-bound breach"
+    (row,) = router.decision_rows
+    assert (row["action"], row["reason"]) == ("hold", "WRONG_REMEDY")
+    assert row["dominant_phase"] == phase
+    assert row["burn_rate"] == pytest.approx(12.5)
+
+
+def test_unattributed_breach_holds_without_scaling():
+    router = _FakeRouter()
+    sup, spawned = _supervisor(router, _breach(None))
+    sup._autoscale()
+    assert not spawned
+    (row,) = router.decision_rows
+    assert (row["action"], row["reason"]) == ("hold", "phase_unattributed")
+
+
+def test_holds_are_throttled_scale_ups_are_not():
+    router = _FakeRouter()
+    sup, _ = _supervisor(router, _breach("device_wait"))
+    sup._autoscale()
+    sup._autoscale()
+    assert len(router.decision_rows) == 1, "steady-state hold logged twice"
+
+
+def test_budget_intact_idle_scales_down():
+    router = _FakeRouter(n_ready=2)
+    intact = {"firing": [], "objectives": {
+        "max_error_rate": {"budget_remaining": 0.8, "firing": False},
+    }}
+    sup, _ = _supervisor(router, intact)
+    sup._autoscale()
+    victim = router.replicas[1]  # highest replica_id above the floor
+    assert victim.drained and victim.state == "draining"
+    (row,) = router.decision_rows
+    assert (row["action"], row["reason"]) == ("scale_down", "budget_intact_idle")
+
+
+def test_spent_budget_blocks_scale_down():
+    router = _FakeRouter(n_ready=2)
+    spent = {"firing": [], "objectives": {
+        "max_error_rate": {"budget_remaining": 0.0, "firing": False},
+    }}
+    sup, _ = _supervisor(router, spent)
+    sup._autoscale()
+    assert not any(r.drained for r in router.replicas)
+    (row,) = router.decision_rows
+    assert (row["action"], row["reason"]) == ("hold", "budget_spent")
+
+
+# ---------------------------------------------------------------------------
+# slo report scorecard
+# ---------------------------------------------------------------------------
+
+
+def _traced_run(tmp_path, monkeypatch, with_breach):
+    import time
+
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE", "0.01")
+    monkeypatch.setenv("ACCELERATE_SLO_MAX_ERROR_RATE_WINDOW_S", "60")
+    logdir = str(tmp_path)
+    spec = parse_trace_spec("overbudget-storm:7:4:8")
+    write_workload_manifest(logdir, spec, generate_schedule(spec))
+    errors = 5 if with_breach else 0
+    now = time.time()  # the report command evaluates at wall time
+    _write_totals_rows(
+        logdir,
+        [
+            {"ts": now - 20, "delivered": 0, "shed": 0, "deadline_expired": 0,
+             "fleet_deadline_expired": 0},
+            {"ts": now - 5, "delivered": 20, "shed": 0, "deadline_expired": 0,
+             "fleet_deadline_expired": errors},
+        ],
+    )
+    return logdir
+
+
+def test_slo_report_fail_roundtrips_json(tmp_path, monkeypatch):
+    from accelerate_tpu.commands.slo import build_report, render_report
+
+    logdir = _traced_run(tmp_path, monkeypatch, with_breach=True)
+    report = build_report(logdir)
+    card = report["scenarios"][0]
+    assert card["verdict"] == "fail" and report["pass"] is False
+    assert card["spec"].startswith("overbudget-storm")
+    roundtrip = json.loads(json.dumps(report, default=str))
+    assert roundtrip["scenarios"][0]["verdict"] == "fail"
+    text = render_report(report)
+    assert "overbudget-storm" in text and "overall: FAIL" in text
+
+
+def test_slo_report_pass_when_nothing_fires(tmp_path, monkeypatch):
+    from accelerate_tpu.commands.slo import build_report, render_report
+
+    logdir = _traced_run(tmp_path, monkeypatch, with_breach=False)
+    report = build_report(logdir)
+    assert report["scenarios"][0]["verdict"] == "pass"
+    assert report["pass"] is True
+    assert "overall: PASS" in render_report(report)
