@@ -51,6 +51,8 @@ def _serve_args(args) -> list[str]:
             tail += [flag, str(value)]
     if getattr(args, "mesh", False):
         tail.append("--mesh")
+    if getattr(args, "sync_engine", False):
+        tail.append("--sync-engine")
     return tail
 
 
@@ -525,6 +527,11 @@ def add_parser(subparsers):
     p.add_argument("--mesh", action="store_true",
                    help="each replica shards its engine over the attached mesh "
                    "(forwards serve's --mesh; MeshPlugin reads ACCELERATE_MESH_*)")
+    p.add_argument(
+        "--sync-engine", action="store_true",
+        default=os.environ.get("ACCELERATE_SYNC_ENGINE", "") not in ("", "0"),
+        help="every replica runs the synchronous step loop (forwards "
+        "serve's --sync-engine; env ACCELERATE_SYNC_ENGINE=1)")
     p.add_argument("--chaos-spec", default=None,
                    help="forwarded to every replica's serve --chaos-spec "
                    "(entries scoped rN: fire only on replica N) — the "

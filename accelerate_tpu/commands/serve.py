@@ -254,6 +254,7 @@ def _make_engine(args):
             draft=args.draft,
             flight_history=args.flight_history,
             logprobs_topn=args.logprobs_topn,
+            async_dispatch=not getattr(args, "sync_engine", False),
         ),
         mesh=mesh,
     )
@@ -992,6 +993,14 @@ def add_parser(subparsers):
                    "harvest shape is static engine geometry, so requests opt "
                    "in UP TO this cap via the OpenAI 'logprobs' field; "
                    "unsupported with --spec-k > 0")
+    p.add_argument(
+        "--sync-engine", action="store_true",
+        default=os.environ.get("ACCELERATE_SYNC_ENGINE", "") not in ("", "0"),
+        help="disable double-buffered dispatch and run the synchronous "
+        "step loop (schedule, dispatch, blocking harvest every "
+        "iteration; env ACCELERATE_SYNC_ENGINE=1): escape hatch for "
+        "A/B timing and for triaging suspected overlap bugs — tokens "
+        "are identical either way, only the host-hiding differs")
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--temperature", type=float, default=None,
                    help="default sampling temperature when a request sends no "

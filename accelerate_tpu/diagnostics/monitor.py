@@ -250,6 +250,7 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             # flight-recorder iteration attribution + HBM watermarks
             # (gauges riding the step rows — absent on flight_history=0)
             "host_fraction": last_step.get("host_fraction"),
+            "overlap_hidden_s": last_step.get("overlap_hidden_s"),
             "iteration_p50_s": last_step.get("iteration_p50_s"),
             "iteration_p99_s": last_step.get("iteration_p99_s"),
             "flight_phase": last_step.get("flight_phase"),
@@ -450,11 +451,16 @@ def render_status(status: dict[str, Any]) -> str:
                         else ""
                     )
                 )
+            # overlap only when the double-buffered engine actually hid
+            # host work — sync engines keep the exact legacy line
+            overlap = ""
+            if srv.get("overlap_hidden_s"):
+                overlap = f"   overlap {srv['overlap_hidden_s']:.4f}s hidden"
             lines.append(
                 f"  iteration: host {_fmt(srv['host_fraction'], '{:.0%}')}   "
                 f"p50 {_fmt(srv.get('iteration_p50_s'), '{:.4f}')}s "
                 f"p99 {_fmt(srv.get('iteration_p99_s'), '{:.4f}')}s   "
-                f"phase {srv.get('flight_phase') or '?'}" + hbm
+                f"phase {srv.get('flight_phase') or '?'}" + overlap + hbm
             )
         if srv.get("kv_dtype"):
             lines.append(

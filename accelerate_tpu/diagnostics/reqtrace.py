@@ -143,8 +143,16 @@ def collect_iterations(
                 phases = {p: float(args[f"{p}_s"]) for p in ITERATION_PHASES}
             except (KeyError, TypeError, ValueError):
                 continue  # foreign/older trail row: skip, never raise
+            # optional (absent on pre-async and synchronous-engine rows):
+            # host time run under an in-flight dispatch — parsed with a
+            # 0.0 default OUTSIDE the skip guard so old trails keep reading
+            try:
+                overlap = float(args.get("overlap_hidden_s", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                overlap = 0.0
             row = {"role": role, "ts": ts,
-                   "iteration": args.get("iteration"), "wall_s": wall}
+                   "iteration": args.get("iteration"), "wall_s": wall,
+                   "overlap_hidden_s": overlap}
             for p in ITERATION_PHASES:
                 row[f"{p}_s"] = phases[p]
             iterations.append(row)
@@ -160,15 +168,19 @@ def iteration_report(
     """The slowest-``k`` engine iterations by wall time with per-phase
     attribution over that tail, plus the cumulative host-vs-device split
     over *all* recorded iterations — computed exactly like the engine's
-    ``stats()['host_fraction']`` (1 − Σdevice_wait/Σwall), so the two
-    surfaces agree on the ROADMAP item-5 number by construction."""
+    ``stats()['host_fraction']`` (1 − (Σdevice_wait + Σoverlap_hidden) /
+    Σwall; the overlap term is 0 on synchronous-engine and pre-async
+    trails), so the two surfaces agree on the ROADMAP item-5 number by
+    construction."""
     rows = collect_iterations(logging_dir, paths=paths)
     wall_total = sum(r["wall_s"] for r in rows)
     phase_totals = {
         p: sum(r[f"{p}_s"] for r in rows) for p in ITERATION_PHASES
     }
+    overlap_total = sum(r.get("overlap_hidden_s", 0.0) for r in rows)
     host_fraction = (
-        1.0 - phase_totals["device_wait"] / wall_total if wall_total > 0 else 0.0
+        max(0.0, 1.0 - (phase_totals["device_wait"] + overlap_total) / wall_total)
+        if wall_total > 0 else 0.0
     )
     tail = sorted(rows, key=lambda r: -r["wall_s"])[: max(1, int(k))]
     attribution: dict[str, float] = {}
@@ -183,6 +195,7 @@ def iteration_report(
         "k": len(tail) if rows else 0,
         "wall_total_s": wall_total,
         "phase_totals_s": phase_totals,
+        "overlap_hidden_total_s": overlap_total,
         "host_fraction": host_fraction,
         "device_fraction": 1.0 - host_fraction,
         "tail": tail if rows else [],
@@ -200,6 +213,12 @@ def render_iteration_report(report: dict) -> str:
         f"host {100.0 * report['host_fraction']:.1f}%  "
         f"device {100.0 * report['device_fraction']:.1f}%"
     ]
+    if report.get("overlap_hidden_total_s"):
+        lines.append(
+            f"overlap hidden: {report['overlap_hidden_total_s']:.4f}s host "
+            "work run under an in-flight dispatch (off the critical path; "
+            "counted as device time above)"
+        )
     if report["attribution"]:
         lines.append(
             "slowest-tail attribution: "

@@ -195,7 +195,11 @@ def _observe_sampling(registry, rec: dict) -> None:
 _FLIGHT_PHASES = ("schedule", "prefill", "dispatch", "device_wait", "harvest")
 _FLIGHT_GAUGES = (
     ("host_fraction", "serving_host_fraction",
-     "1 - device_wait/wall over recorded iterations (flight recorder)"),
+     "1 - (device_wait + overlap_hidden)/wall over recorded iterations "
+     "(flight recorder)"),
+    ("overlap_hidden_s", "serving_overlap_hidden_seconds",
+     "Cumulative host time run under an in-flight dispatch (double-"
+     "buffered engine; 0 with --sync-engine)"),
     ("iteration_p50_s", "serving_iteration_p50_seconds",
      "Median engine iteration wall time over the flight ring"),
     ("iteration_p99_s", "serving_iteration_p99_seconds",
@@ -221,6 +225,10 @@ def observe_flight(registry, entry: dict) -> None:
     for p in _FLIGHT_PHASES:
         if _num(entry.get(f"{p}_s")) is not None:
             hist.observe(entry[f"{p}_s"], phase=p)
+    # not a sixth phase: re-counts host time hidden under an in-flight
+    # dispatch, so the exclusive-phase sum still telescopes to `total`
+    if _num(entry.get("overlap_hidden_s")) is not None:
+        hist.observe(entry["overlap_hidden_s"], phase="overlap_hidden")
 
 
 def _observe_serving(registry, record: dict) -> None:

@@ -24,6 +24,14 @@ vLLM-style block-paged cache):
   all drafts through the fused paged kernel, and the longest agreeing
   prefix + correction emit. Rollback is position bookkeeping only, so the
   one-executable contract and greedy token parity both survive;
+* **double-buffered dispatch** (``async_dispatch``, the default): the
+  decode round handed off at iteration *i* is harvested at iteration
+  *i+1*, so admission, block growth, radix lookups, deadline sweeps, and
+  lane edits run WHILE the device computes — the host leaves the
+  per-token critical path (ROADMAP item 5) and ``device_wait`` shrinks to
+  the residual sync the host could not hide. Dispatch *i+1* still happens
+  strictly after harvest *i*, so output is token-identical to the
+  synchronous loop (``async_dispatch=False`` / ``serve --sync-engine``);
 * **per-slot sampling + constrained decoding** (``per_slot_sampling``,
   the default): temperature / top-k / top-p / repetition penalty / seed /
   grammar-DFA state ride as fixed-shape *lane inputs* of the same ONE
@@ -58,7 +66,7 @@ from ..metrics.ingest import observe_flight
 from ..metrics.registry import get_active_registry
 from ..telemetry import get_active_recorder
 from .blocks import NULL_BLOCK, BlockAllocator, blocks_needed
-from .flight import FlightRecorder, set_active_flight_recorder
+from .flight import ITERATION_PHASES, FlightRecorder, set_active_flight_recorder
 from .grammar import compile_grammar
 from .radix import RadixCache, SwapPool
 from .sampling import (
@@ -107,6 +115,20 @@ class EngineConfig:
     #: request finishing mid-burst wastes at most ``decode_burst - 1``
     #: lane-steps. 1 = schedule every token.
     decode_burst: int = 8
+    #: double-buffered dispatch (ROADMAP item 5, the async engine core):
+    #: ``step()`` hands round *i* to the device and returns WITHOUT
+    #: waiting; round *i*'s tokens are harvested at iteration *i+1*'s
+    #: harvest point, AFTER the host has already run iteration *i+1*'s
+    #: scheduling work (admission, block growth/CoW, radix lookups,
+    #: deadline sweeps, sampling-lane edits) under the in-flight round.
+    #: Output stays token-identical to the synchronous loop — dispatch
+    #: *i+1* still happens strictly after harvest *i*, so every decode
+    #: input (fed token, position, lanes, DFA rows) is byte-identical;
+    #: only the host's position relative to the device moves. ``False``
+    #: restores the fully synchronous loop (``serve --sync-engine`` /
+    #: ``ACCELERATE_SYNC_ENGINE=1``) — the escape hatch and the baseline
+    #: ``benchmarks/async_smoke.py`` compares against.
+    async_dispatch: bool = True
     #: emit a telemetry "serving" row every N iterations (0 disables)
     stats_interval: int = 32
     #: per-iteration flight recorder ring size (0 disables): every
@@ -208,6 +230,27 @@ class EngineConfig:
     @property
     def blocks_per_slot(self) -> int:
         return blocks_needed(self.max_seq_len, self.block_size)
+
+
+@dataclass
+class _InFlightRound:
+    """One dispatched-but-unharvested decode round (double-buffered
+    dispatch). Holds the device *futures* the dispatch returned — nothing
+    here has been device_get: the harvest's single blocking transfer is
+    deferred until the next iteration's harvest point (or a fence). The
+    ``live`` list is the dispatch-order request batch; slots cannot be
+    reassigned while a round is in flight (eviction only touches FINISHED
+    requests, and members only finish at harvest), so ``req.slot`` still
+    indexes the result arrays when the harvest lands."""
+
+    kind: str  # "burst" | "spec"
+    live: list
+    toks: object  # [burst, slots] next-token future, or [slots, k+1] spec
+    accept: object = None  # [slots] accepted-prefix lengths (spec only)
+    logps: object = None
+    tvals: object = None
+    tids: object = None
+    harvest_lp: bool = False
 
 
 class InferenceEngine:
@@ -467,11 +510,28 @@ class InferenceEngine:
         )
         if self._flight is not None:
             set_active_flight_recorder(self._flight)
-        # mid-iteration stamps _decode_once/_spec_decode_dispatch set
-        # around the harvest device_get (the device-wait boundary); reset
-        # at the top of each iteration, None when no decode lanes ran
-        self._fl_dispatch_done: float | None = None
-        self._fl_wait_done: float | None = None
+        # double-buffered dispatch state: the round handed to the device
+        # last iteration and not yet harvested (None = nothing in flight),
+        # plus the parking list a mid-schedule fence (swap-out) harvests
+        # into — drained into the SAME step's finished list at its harvest
+        # point, so a fenced finish is still returned exactly once
+        self._inflight: _InFlightRound | None = None
+        self._harvest_backlog: list[Request] = []
+        # flight phase accumulator (replaces fixed telescoping stamps —
+        # the async loop re-enters phases, e.g. "harvest" both at the
+        # harvest point and for end-of-step bookkeeping): _fl_switch
+        # closes the open interval into its phase bucket; an interval
+        # additionally accrues into overlap_hidden when it OPENED with a
+        # round in flight — the device was busy under the whole interval,
+        # so that host time is off the critical path. The open-time rule
+        # makes sync-mode overlap exactly 0.0 (dispatch opens with
+        # nothing in flight) and keeps device_wait pure residual sync.
+        self._fl_t0 = 0.0
+        self._fl_last = 0.0
+        self._fl_cur = "idle"
+        self._fl_phases: dict | None = None
+        self._fl_overlap = 0.0
+        self._fl_hidden = False
         # static HBM model for the hbm watermark fallback: params + the
         # paged pools (+ scales), the same inventory the PR 8 preflight
         # prices — used verbatim when the backend has no memory_stats()
@@ -683,7 +743,7 @@ class InferenceEngine:
 
         # scale arrays are donated pool operands exactly like the pools —
         # at kv_dtype="auto"/"bf16"/"f32" they are None-free placeholders
-        # that never reach the jit (see _decode_once)
+        # that never reach the jit (see _dispatch_decode)
         donate = (1, 2, 3, 4) if quantized else (1, 2)
         if quantized:
             return jax.jit(decode, donate_argnums=donate)
@@ -1164,8 +1224,18 @@ class InferenceEngine:
 
     def step(self) -> list[Request]:
         """One engine iteration: evict finished → admit queued → one
-        prefill chunk → one decode step over every slot. Returns requests
-        that finished during this iteration."""
+        prefill chunk → harvest the in-flight round → one decode dispatch
+        over every slot. Returns requests that finished this iteration.
+
+        With ``async_dispatch`` (the default) the decode dispatch is
+        double-buffered: the round handed off at the end of iteration *i*
+        is harvested at iteration *i+1*'s harvest point, so the schedule
+        and prefill work above it runs WHILE the device computes. Every
+        dispatch still happens strictly after the previous round's
+        harvest, so the decode inputs — and therefore the emitted tokens —
+        are identical to the synchronous loop; tokens simply surface one
+        ``step()`` call later, and ``run_until_idle()``/``stream()`` keep
+        stepping until the drain flush lands them."""
         if self._start_time is None:
             self._start_time = self._last_stats_t = time.perf_counter()
         # ONE global read per iteration when tracing is disabled — every
@@ -1174,29 +1244,35 @@ class InferenceEngine:
         sched = self.scheduler
         finished: list[Request] = []
 
-        # flight stamps telescope (each phase = diff of consecutive
-        # perf_counter reads) so they sum to the iteration wall exactly;
-        # disabled path is this single `is None` check
         fl = self._flight
-        if fl is not None:
-            self._fl_dispatch_done = self._fl_wait_done = None
-            fl.current_phase = "schedule"
-            t0 = time.perf_counter()
+        self._fl_begin()
 
+        deferred_deadline: list[Request] = []
         with trace_span("serve/schedule"):
             if sched.deadline_live:  # guarded: deadline-free = one int check
-                for req in sched.expire_deadlines():
+                now = time.perf_counter()
+                inflight_slots = None
+                if self._inflight is not None:
+                    # an expired member of the in-flight round still has a
+                    # token landing at this step's harvest — the token the
+                    # synchronous engine emitted LAST step. Defer its
+                    # expiry to just after the harvest point so the two
+                    # loops stay token-identical.
+                    inflight_slots = {r.slot for r in self._inflight.live}
+                for req in sched.expire_deadlines(now, skip_slots=inflight_slots):
                     if req.slot is None:
                         self._release_expired_queued(req)
                     self._deadline_expired += 1
                     finished.append(req)
+                if inflight_slots:
+                    deferred_deadline = [
+                        r for r in self._inflight.live
+                        if r.deadline is not None and now > r.deadline
+                    ]
             sched.evict_finished()
             self._admit_and_place()
 
-        if fl is not None:
-            fl.current_phase = "prefill"
-            t1 = time.perf_counter()
-
+        self._fl_switch("prefill")
         with trace_span("serve/prefill"):
             # one chunk per PREFILLING SLOT per iteration: slot turnover is
             # never throttled to one admission per decode burst, while any
@@ -1205,26 +1281,36 @@ class InferenceEngine:
             for req in sched.active(RequestState.PREFILL):
                 self._prefill_one_chunk(req, finished)
 
-        if fl is not None:
-            fl.current_phase = "dispatch"
-            t2 = time.perf_counter()
+        # harvest point: the previous iteration's round lands here,
+        # exactly one iteration late. Backlog entries were force-harvested
+        # by a mid-schedule fence (swap-out) and drain into THIS step's
+        # finished list — a fenced finish is still returned exactly once.
+        if self._harvest_backlog:
+            finished.extend(self._harvest_backlog)
+            self._harvest_backlog.clear()
+        self._harvest_inflight(finished)
+        for req in deferred_deadline:
+            # the member's in-flight token has now been emitted (exactly
+            # the output the synchronous engine had at its sweep) — expire
+            # it before the next dispatch; blocks free at the next evict
+            if req.state is RequestState.DECODE:
+                req.finish_reason = "deadline_exceeded"
+                req.finish_time = time.perf_counter()
+                req.state = RequestState.FINISHED
+                self._deadline_expired += 1
+                finished.append(req)
 
+        self._fl_switch("dispatch")
         decoding = sched.active(RequestState.DECODE)
         if decoding:
             with trace_span("serve/decode", slots=len(decoding)):
-                self._decode_once(decoding, finished)
+                self._dispatch_decode(decoding, finished)
+        if not self.config.async_dispatch:
+            # synchronous escape hatch: harvest the round we just
+            # dispatched before leaving the iteration (the pre-item-5 loop)
+            self._harvest_inflight(finished)
 
-        if fl is not None:
-            # _decode_once stamped the device_get boundary on self; an
-            # iteration with no decode lanes telescopes both phases to 0
-            t3 = self._fl_dispatch_done
-            if t3 is None:
-                t3 = time.perf_counter()
-            t4 = self._fl_wait_done
-            if t4 is None:
-                t4 = t3
-            fl.current_phase = "harvest"
-
+        self._fl_switch("harvest")
         self._iterations += 1
         self._occupancy_sum += sched.occupancy
         for req in finished:
@@ -1243,12 +1329,12 @@ class InferenceEngine:
                     ttft_s=req.ttft_s, tpot_s=req.tpot_s,
                 )
         self._emit_telemetry(finished)
-        if fl is not None:
-            t5 = time.perf_counter()
+        rec = self._fl_finish()
+        if rec is not None:
+            t0, wall, phases, overlap = rec
             entry = fl.record(
-                self._iterations, t0, t5 - t0,
-                schedule=t1 - t0, prefill=t2 - t1, dispatch=t3 - t2,
-                device_wait=t4 - t3, harvest=t5 - t4,
+                self._iterations, t0, wall,
+                overlap_hidden_s=overlap, **phases,
             )
             fl.current_phase = "idle"
             reg = get_active_registry()
@@ -1267,15 +1353,20 @@ class InferenceEngine:
         return finished
 
     def run_until_idle(self, max_iterations: int | None = None) -> list[Request]:
-        """Drain queue + slots; returns every request finished during the
-        drain (scheduling-bug guard: ``max_iterations`` bounds the loop)."""
+        """Drain queue + slots + the in-flight round; returns every
+        request finished during the drain (scheduling-bug guard:
+        ``max_iterations`` bounds the loop). The final drain flush — the
+        step that only harvests the last in-flight round — counts as an
+        iteration like any other; the cap is checked BEFORE stepping, so
+        a cap that lands exactly on the drain boundary still returns
+        every finished request (and raising never swallows them)."""
         done: list[Request] = []
         it = 0
-        while self.scheduler.has_work():
-            done.extend(self.step())
-            it += 1
+        while self.scheduler.has_work() or self._inflight is not None:
             if max_iterations is not None and it >= max_iterations:
                 raise RuntimeError(f"engine not idle after {it} iterations")
+            done.extend(self.step())
+            it += 1
         return done
 
     def stream(self, prompt, max_new_tokens: int | None = None):
@@ -1514,6 +1605,144 @@ class InferenceEngine:
 
     # -- iteration internals -------------------------------------------------
 
+    def _fl_begin(self) -> None:
+        """Open the iteration's flight accounting in the "schedule" phase
+        (no-op when the recorder is disabled)."""
+        if self._flight is None:
+            self._fl_phases = None
+            return
+        t = time.perf_counter()
+        self._fl_t0 = self._fl_last = t
+        self._fl_phases = dict.fromkeys(ITERATION_PHASES, 0.0)
+        self._fl_overlap = 0.0
+        self._fl_cur = "schedule"
+        # hidden-overlap rule: an interval counts as hidden iff a round
+        # was in flight when it OPENED (and it is not device_wait) — the
+        # schedule work at the top of an async steady-state iteration runs
+        # entirely under the previous round
+        self._fl_hidden = self._inflight is not None
+        self._flight.current_phase = "schedule"
+
+    def _fl_switch(self, phase: str) -> None:
+        """Close the open interval into its phase bucket and open
+        ``phase``. Phases may be re-entered (the async loop visits
+        "harvest" both at the harvest point and for bookkeeping) — the
+        buckets accumulate, and their sum telescopes to the iteration
+        wall exactly, which ``FlightRecorder.record`` asserts."""
+        if self._fl_phases is None:
+            return
+        t = time.perf_counter()
+        dt = t - self._fl_last
+        self._fl_phases[self._fl_cur] += dt
+        if self._fl_hidden:
+            self._fl_overlap += dt
+        self._fl_last = t
+        self._fl_cur = phase
+        # decided at OPEN time: device_wait is by definition the residual
+        # the host could NOT hide, so it never accrues overlap
+        self._fl_hidden = self._inflight is not None and phase != "device_wait"
+        self._flight.current_phase = phase
+
+    def _fl_finish(self):
+        """Close the last interval; returns ``(t0, wall_s, phases,
+        overlap_hidden_s)`` for ``FlightRecorder.record`` (None when the
+        recorder is disabled)."""
+        if self._fl_phases is None:
+            return None
+        t = time.perf_counter()
+        dt = t - self._fl_last
+        self._fl_phases[self._fl_cur] += dt
+        if self._fl_hidden:
+            self._fl_overlap += dt
+        phases, self._fl_phases = self._fl_phases, None
+        self._fl_cur = "idle"
+        return self._fl_t0, t - self._fl_t0, phases, self._fl_overlap
+
+    def _harvest_inflight(self, finished: list[Request]) -> None:
+        """Blocking harvest of the in-flight round: ONE device_get of
+        everything the round surfaces, then token emission through the
+        same ``_emit_token`` path both engine modes share (eos / length /
+        grammar-final / stop-trim are host state, so finish semantics are
+        inherited, not re-implemented). A member that finished while the
+        round was in flight emits nothing — its lane result is discarded
+        exactly like a mid-burst eos tail."""
+        rd = self._inflight
+        if rd is None:
+            return
+        self._fl_switch("device_wait")
+        if rd.kind == "spec":
+            tok_seq, accept = (
+                np.asarray(x) for x in jax.device_get((rd.toks, rd.accept))
+            )
+        elif rd.harvest_lp:
+            # the logprob surfaces ride the SAME device_get — no second
+            # dispatch, no extra sync point
+            next_toks, logps, tvals, tids = (
+                np.asarray(x)
+                for x in jax.device_get((rd.toks, rd.logps, rd.tvals, rd.tids))
+            )
+        else:
+            next_toks = np.asarray(jax.device_get(rd.toks))  # [burst, slots]
+        self._inflight = None
+        self._fl_switch("harvest")
+        if rd.kind == "spec":
+            k = self.config.spec_k
+            if self._tr is not None:
+                self._tr.instant(
+                    "serve/spec_round", slots=len(rd.live), k=k,
+                    trace_ids=[r.trace_id for r in rd.live],
+                    accepted=[int(accept[r.slot]) for r in rd.live],
+                )
+            for req in rd.live:
+                a = int(accept[req.slot])
+                self._spec_drafted += k
+                self._spec_accepted += a
+                if req.sampling is not None and req.sampling.do_sample:
+                    # rejection-sampling health, counted over sampled slots
+                    # only (greedy slots use exact-prefix acceptance)
+                    self._rej_drafted += k
+                    self._rej_accepted += a
+                for t in range(a + 1):
+                    if req.state is RequestState.FINISHED:
+                        break  # mid-round eos/length: the run's tail is waste
+                    self._emit_token(req, int(tok_seq[req.slot, t]), finished)
+            return
+        for req in rd.live:
+            want_lp = (
+                rd.harvest_lp and req.sampling is not None and req.sampling.logprobs
+            )
+            for t in range(self.config.decode_burst):
+                if req.state is RequestState.FINISHED:
+                    break  # mid-burst eos/length: the tail lane-steps are waste
+                entry = None
+                if want_lp:
+                    entry = self._logprob_entry(
+                        req.sampling, float(logps[t, req.slot]),
+                        tvals[t, req.slot], tids[t, req.slot],
+                    )
+                self._emit_token(req, int(next_toks[t, req.slot]), finished, entry)
+
+    def _fence_inflight(self) -> bool:
+        """Synchronize with the in-flight round before host code touches
+        pool rows it may still be writing (swap-out's device_get). The
+        round is harvested into the backlog — its tokens land on their
+        requests NOW (an in-flight member already owns that token in the
+        synchronous engine's timeline), any finishes park until the step's
+        harvest point drains them into the finished list — and the evict
+        sweep runs so the caller's capacity math sees the freed slots.
+        Returns True when a round was actually fenced."""
+        if self._inflight is None:
+            return False
+        prev = self._fl_cur if self._fl_phases is not None else None
+        self._harvest_inflight(self._harvest_backlog)
+        self.scheduler.evict_finished()
+        if prev is not None:
+            # resume the interrupted phase: the fence's device_wait +
+            # harvest intervals were attributed; the remainder of the
+            # interrupted phase keeps telescoping
+            self._fl_switch(prev)
+        return True
+
     def _admit_and_place(self) -> None:
         """Admission plus its device obligations (CoW copies, swap-in
         restores), looped with priority preemption: when the head of the
@@ -1618,6 +1847,18 @@ class InferenceEngine:
         reference and stay resident — their HBM is shared anyway. Returns
         False when the swap pool cannot hold the victim (caller falls back
         to truncation or waiting)."""
+        # fence FIRST: an in-flight round may still be writing the
+        # victim's rows — and holds a token the synchronous engine would
+        # already have emitted, which must land on the victim before it
+        # re-queues (pending_tok on resume is output_tokens[-1]). The
+        # fence may finish the victim (eos/length on the harvested token)
+        # or free its slot entirely; capacity is then already available
+        # and there is nothing left to swap — report success so the
+        # caller retries admission/growth instead of picking a new victim.
+        if self._fence_inflight() and (
+            victim.state is RequestState.FINISHED or victim.slot is None
+        ):
+            return True
         swappable = []
         for i, b in enumerate(victim.blocks):
             rc = self.allocator.refcount(b)
@@ -1631,20 +1872,24 @@ class InferenceEngine:
         plan: list[tuple[int, int]] = []
         released = [victim.blocks[i] for i in swappable]
         if released:
-            # one gathered transfer per pool, not 2 round trips per block;
-            # ids padded to a power of two (null-block reads, rows
+            # ONE device round trip for the whole victim: the 2–4 pool
+            # gathers (k/v rows plus scale mirrors when quantized) ride a
+            # single device_get of a tuple, not one blocking transfer
+            # each; ids padded to a power of two (null-block reads, rows
             # discarded host-side) so the gather compiles O(log blocks)
             # executables, symmetric with _place_admitted's restore
             n = len(released)
             m = 1 << max(0, (n - 1).bit_length())
             idx = np.full((m,), NULL_BLOCK, np.int32)
             idx[:n] = released
-            k_rows = jax.device_get(self._kp[:, idx])  # [layers, m, bs, kv, hd]
-            v_rows = jax.device_get(self._vp[:, idx])
+            gathers = [self._kp[:, idx], self._vp[:, idx]]
+            if self._quantized:
+                gathers += [self._ks[:, idx], self._vs[:, idx]]
+            rows = jax.device_get(tuple(gathers))
+            k_rows, v_rows = rows[0], rows[1]  # [layers, m, bs, kv, hd]
             ks_rows = vs_rows = None
             if self._quantized:
-                ks_rows = jax.device_get(self._ks[:, idx])  # [layers, m, bs, kv]
-                vs_rows = jax.device_get(self._vs[:, idx])
+                ks_rows, vs_rows = rows[2], rows[3]  # [layers, m, bs, kv]
             for j, i in enumerate(swappable):
                 plan.append((
                     i,
@@ -1799,7 +2044,14 @@ class InferenceEngine:
             if victim is req:
                 return  # req is queued for re-admission; lane goes idle
 
-    def _decode_once(self, decoding: list[Request], finished: list[Request]) -> None:
+    def _dispatch_decode(
+        self, decoding: list[Request], finished: list[Request]
+    ) -> None:
+        """Build this round's operands and hand the ONE compiled decode
+        executable to the runtime — non-blocking: the results stay device
+        futures in ``self._inflight`` until ``_harvest_inflight`` lands
+        them (next iteration's harvest point in async mode, immediately
+        after this returns in sync mode)."""
         cfg = self.config
         # pass 1 — capacity: grow every lane (evicting cached blocks,
         # preempting victims, truncating last-resort). A later lane's
@@ -1884,9 +2136,7 @@ class InferenceEngine:
             )
 
         if self._spec is not None:
-            self._spec_decode_dispatch(
-                pos0, toks, active, lanes, live, finished, decode_sig
-            )
+            self._spec_decode_dispatch(pos0, toks, active, lanes, live, decode_sig)
             return
         logps = tvals = tids = None
         if self._psampling:
@@ -1915,26 +2165,6 @@ class InferenceEngine:
                 active, self._key, self._temp,
             )
         self._check_one_executable(decode_sig)
-        if self._flight is not None:
-            # dispatch handed off; the harvest device_get below is the one
-            # interval where the host provably waits on the device
-            self._fl_dispatch_done = time.perf_counter()
-            self._flight.current_phase = "device_wait"
-        harvest_lp = self.config.logprobs_topn > 0 and any(
-            r.sampling is not None and r.sampling.logprobs for r in live
-        )
-        if harvest_lp:
-            # the logprob surfaces ride the SAME device_get — no second
-            # dispatch, no extra sync point
-            next_toks, logps, tvals, tids = (
-                np.asarray(x)
-                for x in jax.device_get((next_toks, logps, tvals, tids))
-            )
-        else:
-            next_toks = np.asarray(jax.device_get(next_toks))  # [burst, slots]
-        if self._flight is not None:
-            self._fl_wait_done = time.perf_counter()
-            self._flight.current_phase = "harvest"
         if self._tr is not None:
             # request identity on the decode timeline WITHOUT per-token
             # spans: one instant per dispatch carries the whole slot batch
@@ -1943,33 +2173,27 @@ class InferenceEngine:
                 burst=cfg.decode_burst,
                 trace_ids=[r.trace_id for r in live],
             )
-        for req in live:
-            want_lp = (
-                harvest_lp and req.sampling is not None and req.sampling.logprobs
-            )
-            for t in range(cfg.decode_burst):
-                if req.state is RequestState.FINISHED:
-                    break  # mid-burst eos/length: the tail lane-steps are waste
-                entry = None
-                if want_lp:
-                    entry = self._logprob_entry(
-                        req.sampling, float(logps[t, req.slot]),
-                        tvals[t, req.slot], tids[t, req.slot],
-                    )
-                self._emit_token(req, int(next_toks[t, req.slot]), finished, entry)
+        harvest_lp = cfg.logprobs_topn > 0 and any(
+            r.sampling is not None and r.sampling.logprobs for r in live
+        )
+        self._inflight = _InFlightRound(
+            kind="burst", live=live, toks=next_toks, logps=logps,
+            tvals=tvals, tids=tids, harvest_lp=harvest_lp,
+        )
 
     def _spec_decode_dispatch(
         self, pos0, toks, active, lanes, live: list[Request],
-        finished: list[Request], decode_sig: tuple | None,
+        decode_sig: tuple | None,
     ) -> None:
         """One speculative round: dispatch the single compiled
-        draft+verify executable, then emit each live slot's accepted
-        prefix + correction through the SAME host-side ``_emit_token``
-        path the plain engine uses (eos and length budgets are host
-        state, so greedy parity with the non-spec engine is inherited,
-        not re-implemented). Rollback is implicit: a slot advances by
-        ``accept+1`` positions; the rejected rows beyond that are
-        re-scattered by the next round before anything can attend them."""
+        draft+verify executable; ``_harvest_inflight`` later emits each
+        live slot's accepted prefix + correction through the SAME
+        host-side ``_emit_token`` path the plain engine uses (eos and
+        length budgets are host state, so greedy parity with the non-spec
+        engine is inherited, not re-implemented). Rollback is implicit: a
+        slot advances by ``accept+1`` positions; the rejected rows beyond
+        that are re-scattered by the next round before anything can
+        attend them."""
         lane_args = (
             (lanes, self._gmask, self._gtrans, self._base_key)
             if self._psampling
@@ -1987,34 +2211,12 @@ class InferenceEngine:
                 pos0, toks, active, *lane_args,
             )
         self._check_one_executable(decode_sig)
-        if self._flight is not None:
-            self._fl_dispatch_done = time.perf_counter()
-            self._flight.current_phase = "device_wait"
-        tok_seq = np.asarray(jax.device_get(tok_seq))  # [num_slots, k+1]
-        accept = np.asarray(jax.device_get(accept))    # [num_slots]
-        if self._flight is not None:
-            self._fl_wait_done = time.perf_counter()
-            self._flight.current_phase = "harvest"
-        k = self.config.spec_k
-        if self._tr is not None:
-            self._tr.instant(
-                "serve/spec_round", slots=len(live), k=k,
-                trace_ids=[r.trace_id for r in live],
-                accepted=[int(accept[r.slot]) for r in live],
-            )
-        for req in live:
-            a = int(accept[req.slot])
-            self._spec_drafted += k
-            self._spec_accepted += a
-            if req.sampling is not None and req.sampling.do_sample:
-                # rejection-sampling health, counted over sampled slots
-                # only (greedy slots use exact-prefix acceptance)
-                self._rej_drafted += k
-                self._rej_accepted += a
-            for t in range(a + 1):
-                if req.state is RequestState.FINISHED:
-                    break  # mid-round eos/length: the tail of the run is waste
-                self._emit_token(req, int(tok_seq[req.slot, t]), finished)
+        # the round's [num_slots, k+1] token matrix and [num_slots]
+        # accepted-prefix vector stay device futures; the serve/spec_round
+        # instant needs the accept values, so it moves to the harvest
+        self._inflight = _InFlightRound(
+            kind="spec", live=live, toks=tok_seq, accept=accept
+        )
 
     def _check_one_executable(self, decode_sig: tuple | None) -> None:
         """ONE compiled decode executable is the engine's core contract.

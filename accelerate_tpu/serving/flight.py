@@ -15,16 +15,24 @@ rather than logging it):
     building the decode operands and handing the (single) compiled decode
     executable to the runtime — host work again.
 ``device_wait``
-    the blocking ``device_get`` harvest sync in ``_decode_once`` /
-    ``_spec_decode_dispatch`` — the only truly on-device interval the
-    host observes, and the denominator of every "is the accelerator
+    the blocking ``device_get`` in ``_harvest_inflight`` — the *residual*
+    sync the host could not hide behind its own work, and (together with
+    ``overlap_hidden_s``) the denominator of every "is the accelerator
     actually busy?" question.
 ``harvest``
     token emission, finish bookkeeping, telemetry — host work.
 
-``host_fraction`` = 1 − device_wait / wall over the recorded window: the
-ROADMAP item-5 measurement ("host-scheduling time leaving the per-token
-critical path") that the async-engine refactor must move.
+``host_fraction`` = 1 − (device_wait + overlap_hidden) / wall over the
+recorded window: the ROADMAP item-5 measurement ("host-scheduling time
+leaving the per-token critical path"). Under the double-buffered engine
+host phases can run *while a decode round is in flight on device*; such
+intervals are still attributed to their phase (the vocabulary stays
+exclusive and telescoping) but are additionally accumulated into the
+per-iteration ``overlap_hidden_s`` stat, because they are off the
+critical path — the device was busy the whole time. ``device_wait`` is
+then only the *residual* sync the host could not hide. With the
+synchronous engine ``overlap_hidden_s`` is identically 0.0 and the
+formula reduces to the old 1 − device_wait / wall.
 
 The recorder is a process-global active object with the same discipline
 as ``get_tracer()``: the engine holds a direct reference (zero reads per
@@ -87,16 +95,22 @@ class FlightRecorder:
         self._ring.clear()
         self.iterations = 0
         self.wall_total_s = 0.0
+        self.overlap_hidden_total_s = 0.0
         self.phase_totals_s = {p: 0.0 for p in ITERATION_PHASES}
         self.current_phase = "idle"
 
     def record(self, iteration: int, t_start: float, wall_s: float,
-               **phases: float) -> dict:
+               overlap_hidden_s: float = 0.0, **phases: float) -> dict:
         """Append one iteration. ``phases`` must cover exactly
         :data:`ITERATION_PHASES` and sum to ``wall_s`` — the stamps
         telescope (each phase is the diff of consecutive perf_counter
         reads), so a mismatch means a stamp was dropped or double-counted
-        and the attribution is garbage. Asserted, not logged."""
+        and the attribution is garbage. Asserted, not logged.
+
+        ``overlap_hidden_s`` is *not* a sixth phase: it re-counts the
+        portion of the host phases that ran under an in-flight dispatch
+        (double-buffered engine), so it is bounded by
+        ``wall_s − device_wait`` — also asserted."""
         if set(phases) != set(ITERATION_PHASES):
             raise AssertionError(
                 f"flight phases {sorted(phases)} != {sorted(ITERATION_PHASES)}"
@@ -109,14 +123,23 @@ class FlightRecorder:
                 f"flight phase sum {total!r} != iteration wall {wall_s!r} "
                 f"({ {p: phases[p] for p in ITERATION_PHASES} })"
             )
+        overlap_hidden_s = float(overlap_hidden_s)
+        host_s = wall_s - phases["device_wait"]
+        if not (-1e-6 <= overlap_hidden_s <= host_s + 1e-6):
+            raise AssertionError(
+                f"overlap_hidden_s {overlap_hidden_s!r} outside "
+                f"[0, wall - device_wait = {host_s!r}]"
+            )
         entry = {"iteration": int(iteration), "t_start": float(t_start),
-                 "wall_s": float(wall_s)}
+                 "wall_s": float(wall_s),
+                 "overlap_hidden_s": overlap_hidden_s}
         for p in ITERATION_PHASES:
             entry[f"{p}_s"] = float(phases[p])
             self.phase_totals_s[p] += float(phases[p])
         self._ring.append(entry)
         self.iterations += 1
         self.wall_total_s += float(wall_s)
+        self.overlap_hidden_total_s += overlap_hidden_s
         return entry
 
     def __len__(self) -> int:
@@ -134,12 +157,17 @@ class FlightRecorder:
         return [e for e in self._ring if e["t_start"] >= since_perf_t]
 
     def host_fraction(self) -> float:
-        """1 − device_wait/wall over everything recorded since reset —
-        cumulative, so it matches ``trace tail --iterations`` computed
+        """1 − (device_wait + overlap_hidden)/wall over everything
+        recorded since reset — host time *on the critical path*. Hidden
+        overlap counts as device time: the accelerator was busy under it.
+        Cumulative, so it matches ``trace tail --iterations`` computed
         over the same iterations."""
         if self.wall_total_s <= 0.0:
             return 0.0
-        return 1.0 - self.phase_totals_s["device_wait"] / self.wall_total_s
+        hidden = (
+            self.phase_totals_s["device_wait"] + self.overlap_hidden_total_s
+        )
+        return max(0.0, 1.0 - hidden / self.wall_total_s)
 
     def _percentiles(self, values: list[float]) -> dict:
         # no numpy on purpose: jax-free consumers import this module
@@ -167,6 +195,7 @@ class FlightRecorder:
             "host_fraction": self.host_fraction(),
             "iteration_p50_s": pw["p50"],
             "iteration_p99_s": pw["p99"],
+            "overlap_hidden_s": self.overlap_hidden_total_s,
             "flight_phase": self.current_phase,
         }
 

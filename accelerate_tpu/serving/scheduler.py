@@ -251,7 +251,9 @@ class SlotScheduler:
                 evicted.append(req)
         return evicted
 
-    def expire_deadlines(self, now: float | None = None) -> list[Request]:
+    def expire_deadlines(
+        self, now: float | None = None, skip_slots: set | None = None
+    ) -> list[Request]:
         """Finish every queued or running request whose deadline has
         passed (``finish_reason="deadline_exceeded"``). Running requests
         keep their partial output; their blocks are freed by the
@@ -262,7 +264,14 @@ class SlotScheduler:
         waiting deques directly (they hold no blocks; a *preempted* queued
         request's swap handles are the engine's to release — see
         ``InferenceEngine.step``). The caller only invokes this while
-        ``deadline_live > 0``."""
+        ``deadline_live > 0``.
+
+        ``skip_slots``: slots the sweep must leave alone this pass. The
+        double-buffered engine passes the in-flight round's slots — those
+        requests still have a token landing at this iteration's harvest
+        (the token the synchronous engine emitted LAST iteration), so the
+        engine defers their expiry to just after that harvest to keep the
+        two loops token-identical."""
         now = time.perf_counter() if now is None else now
         expired: list[Request] = []
         for priority in PRIORITY_CLASSES:
@@ -286,6 +295,8 @@ class SlotScheduler:
                 and req.deadline is not None
                 and now > req.deadline
             ):
+                if skip_slots is not None and req.slot in skip_slots:
+                    continue
                 req.finish_reason = "deadline_exceeded"
                 req.finish_time = now
                 req.state = RequestState.FINISHED

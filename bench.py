@@ -797,6 +797,28 @@ def _mode_spec_serve(platform: str) -> None:
     )
 
 
+def _mode_async(platform: str) -> None:
+    """Double-buffered dispatch row (the bench row for
+    benchmarks/async_smoke.py): async vs sync interleaved legs at
+    ``decode_burst=1`` on the identical Poisson trace/model/geometry,
+    pairwise-median TPOT ratio, per-leg host_fraction (the ROADMAP item-5
+    'host off the per-token critical path' gauge, strictly lower on the
+    async leg), and the per-leg decode-compile counts."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.async_smoke import run as async_run
+
+    r = async_run(platform)
+    print(
+        f"BENCH_ASYNC {r['async_tpot_ratio']:.4f} "
+        f"{r['async_host_fraction']:.4f} {r['sync_host_fraction']:.4f} "
+        f"{r['async_goodput_ratio']:.4f} "
+        f"{r['decode_compiles'][0]} {r['decode_compiles'][1]} "
+        f"{r['async_tpot_p50_s']:.6f} {r['sync_tpot_p50_s']:.6f}"
+    )
+
+
 def _mode_sampling(platform: str) -> None:
     """Per-slot sampling lane overhead row (timeit min-of-5 per the
     timing-noise rule). Figures:
@@ -1504,8 +1526,9 @@ def _seq_row(platform: str, device_kind: str, n_dev: int, seq: int) -> dict | No
 #: headline keys comparable across commits: only ratios travel between
 #: hosts (absolute tokens/s moves with the machine). Suffix-matched.
 _RATIO_SUFFIXES = ("_ratio", "_pct", "_mfu", "_speedup", "_rate")
-#: among those, overhead percentages regress by going UP
-_LOWER_IS_BETTER = ("_overhead_pct",)
+#: among those, overhead percentages and TPOT ratios (async/sync,
+#: spec/off — < 1 is the win) regress by going UP
+_LOWER_IS_BETTER = ("_overhead_pct", "_tpot_ratio")
 
 
 def _persist_run(headline, extra_rows):
@@ -2055,6 +2078,35 @@ def main():
     except Exception:
         pass
     try:
+        asy = _run_subprocess("async", platform, attempts=2)
+        (a_ratio, a_hf, s_hf, a_good, a_compiles, s_compiles,
+         a_tpot, s_tpot) = (float(v) for v in asy["BENCH_ASYNC"])
+        extra_rows.append(
+            {
+                "metric": "async_tpot_ratio",
+                "value": round(a_ratio, 4),
+                "unit": "ratio",
+                "async_host_fraction": round(a_hf, 4),
+                "sync_host_fraction": round(s_hf, 4),
+                "goodput_ratio": round(a_good, 4),
+                "tpot_p50_async_s": a_tpot,
+                "tpot_p50_sync_s": s_tpot,
+                "decode_compiles": [int(a_compiles), int(s_compiles)],
+                "note": "double-buffered engine dispatch (the "
+                "async_dispatch default / serve --sync-engine escape "
+                "hatch): async vs sync interleaved legs at decode_burst=1 "
+                "on the identical Poisson trace, pairwise-median TPOT p50 "
+                "ratio (< 1 = the host left the per-token critical path) "
+                "with per-leg host_fraction (strictly lower on the async "
+                "leg: schedule/prefill host work ran under the in-flight "
+                "device round, counted as overlap_hidden_s). Token parity "
+                "and one decode executable per leg asserted "
+                "(benchmarks/async_smoke.py, make async-smoke)",
+            }
+        )
+    except Exception:
+        pass
+    try:
         tel = _run_subprocess("telemetry", platform, attempts=2)
         t_off, t_on = (float(v) for v in tel["BENCH_TELEMETRY"])
         extra_rows.append(
@@ -2477,6 +2529,7 @@ def main():
         "serve_goodput_tokens_per_sec": ("serve_tok_s", "value"),
         "spec_decode_tokens_per_sec": ("spec_decode_tok_s", "value"),
         "spec_serve_tpot_ratio": ("spec_serve_tpot_ratio", "value"),
+        "async_tpot_ratio": ("async_tpot_ratio", "value"),
         "disk_offload_fp32_disk_effective_stream_gb_per_s": ("offload_fp32_s_per_token", "s_per_token"),
         "disk_offload_int8_disk_effective_stream_gb_per_s": ("offload_int8_s_per_token", "s_per_token"),
         "disk_offload_nf4_disk_effective_stream_gb_per_s": ("offload_nf4_s_per_token", "s_per_token"),
@@ -2524,6 +2577,10 @@ def main():
         if row.get("metric") == "spec_serve_tpot_ratio":
             headline["spec_serve_accept_rate"] = row.get("accept_rate")
             headline["spec_serve_goodput_ratio"] = row.get("goodput_ratio")
+        if row.get("metric") == "async_tpot_ratio":
+            headline["async_host_fraction"] = row.get("async_host_fraction")
+            headline["sync_host_fraction"] = row.get("sync_host_fraction")
+            headline["async_goodput_ratio"] = row.get("goodput_ratio")
         if row.get("metric", "").startswith("disk_offload_"):
             tag = row["metric"].split("disk_offload_")[1].split("_disk_")[0]
             headline[f"offload_{tag}_gb_per_s"] = row.get("value")
@@ -2538,8 +2595,9 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] in (
         "probe", "framework", "raw", "attn", "mrpc", "cv", "offload", "commhook",
         "decode", "telemetry", "watchdog", "metrics", "sanitize", "race",
-        "shard", "goodput", "ckpt", "serve", "spec", "spec-serve", "route",
-        "radix", "kv", "chaos", "reqtrace", "flight", "sampling", "fleet",
+        "shard", "goodput", "ckpt", "serve", "spec", "spec-serve", "async",
+        "route", "radix", "kv", "chaos", "reqtrace", "flight", "sampling",
+        "fleet",
     ):
         mode, platform = sys.argv[1], sys.argv[2]
         dispatch = {
@@ -2563,6 +2621,7 @@ if __name__ == "__main__":
             "serve": _mode_serve,
             "spec": _mode_spec,
             "spec-serve": _mode_spec_serve,
+            "async": _mode_async,
             "route": _mode_route,
             "radix": _mode_radix,
             "kv": _mode_kv,

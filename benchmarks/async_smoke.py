@@ -1,0 +1,181 @@
+"""Async engine smoke: double-buffered vs synchronous dispatch on the
+IDENTICAL trace.
+
+Interleaved legs (ASYNC/SYNC/ASYNC/SYNC/...) of the same Poisson
+mixed-length trace through the same engine geometry and the same model —
+the only difference is ``EngineConfig(async_dispatch=...)`` — with
+pairwise ratios and **ratios only** (the timing-noise rule). Headline
+keys: ``async_tpot_ratio`` (async TPOT p50 / sync TPOT p50, < 1 is the
+ROADMAP item-5 win), ``async_host_fraction`` vs ``sync_host_fraction``
+(the host must leave the per-token critical path: strictly lower on the
+async leg at equal throughput), and ``async_goodput_ratio`` (throughput
+must not regress). ``decode_burst=1`` on BOTH legs — one device round
+trip per token is where the host sync dominates and the overlap has the
+most wall time to hide; larger bursts amortise the sync and shrink the
+effect this smoke exists to measure.
+
+Both legs assert the one-decode-executable contract inside
+``run_engine_leg``; token parity is asserted here request-for-request
+(dispatch-after-harvest ordering makes the async engine token-identical
+by construction — this smoke re-checks it end to end).
+
+NOTE the CPU leg is a *smoke* of the machinery, not a credible ratio: at
+tiny-model shapes the device round is microseconds of XLA CPU work, so
+the hideable window is small and the box's wall clock swings. The TPOT
+gate is parallelism-aware: with >1 CPU (or a real accelerator) it is
+``async_tpot_ratio < 1.0`` (any win); on a 1-CPU container the host and
+the XLA worker timeslice one core, so overlap *cannot* cut wall time —
+measured directly: dispatch-then-host-work-then-block runs ~15% SLOWER
+than serial on this class of box — and the gate degrades to a
+no-regression bound (< 1.10) while the host_fraction / overlap /
+parity / goodput gates stay strict. The credible number is the TPU run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.serve_bench import default_workload, run_engine_leg, warm_engine
+
+
+def workload(platform: str):
+    model, engine_cfg, trace = default_workload(platform)
+    # decode_burst=1: per-token dispatch, the regime the overlap targets
+    async_cfg = replace(engine_cfg, decode_burst=1, async_dispatch=True)
+    sync_cfg = replace(async_cfg, async_dispatch=False)
+    return model, async_cfg, sync_cfg, trace
+
+
+def run(platform: str, legs: int = 3) -> dict:
+    model, async_cfg, sync_cfg, trace = workload(platform)
+    async_engine = warm_engine(model, async_cfg, trace)
+    sync_engine = warm_engine(model, sync_cfg, trace)
+
+    async_legs, sync_legs = [], []
+    for _ in range(legs):
+        async_legs.append(run_engine_leg(model, async_cfg, trace, engine=async_engine))
+        sync_legs.append(run_engine_leg(model, sync_cfg, trace, engine=sync_engine))
+
+    # token parity, request for request, on a fresh replay of the trace
+    def replay_tokens(engine):
+        reqs = [engine.add_request(tr.prompt, tr.max_new_tokens) for tr in trace]
+        engine.run_until_idle(max_iterations=100_000)
+        return [list(r.output_tokens) for r in reqs]
+
+    assert replay_tokens(async_engine) == replay_tokens(sync_engine), (
+        "async engine output diverged from the synchronous engine — "
+        "dispatch-after-harvest must keep decode inputs identical"
+    )
+
+    # ratios are taken PAIRWISE over adjacent interleaved legs (async leg i
+    # vs sync leg i ran back to back, sharing the box's weather), then the
+    # median pair wins — a cross-leg median-vs-median on a ±2x box pairs a
+    # warm leg against a cold one and reports contention, not the overlap
+    pair_ratios = sorted(
+        a["tpot_s"]["p50"] / s["tpot_s"]["p50"]
+        for a, s in zip(async_legs, sync_legs)
+        if a.get("tpot_s", {}).get("p50") and s.get("tpot_s", {}).get("p50")
+    )
+    goodput_ratios = sorted(
+        a["serve_tok_s"] / s["serve_tok_s"]
+        for a, s in zip(async_legs, sync_legs)
+        if s["serve_tok_s"]
+    )
+    med = legs // 2
+    a_med = sorted(async_legs, key=lambda r: r.get("tpot_s", {}).get("p50", 0.0))[med]
+    s_med = sorted(sync_legs, key=lambda r: r.get("tpot_s", {}).get("p50", 0.0))[med]
+    # host_fraction: median over legs per side (each leg's recorder window
+    # is exactly that leg — run_engine_leg resets stats before replay)
+    a_hf = sorted(l["host_fraction"] for l in async_legs if l["host_fraction"] is not None)
+    s_hf = sorted(l["host_fraction"] for l in sync_legs if l["host_fraction"] is not None)
+    result = {
+        "async_tpot_ratio": (
+            pair_ratios[len(pair_ratios) // 2] if pair_ratios else None
+        ),
+        "async_host_fraction": a_hf[len(a_hf) // 2] if a_hf else None,
+        "sync_host_fraction": s_hf[len(s_hf) // 2] if s_hf else None,
+        "async_goodput_ratio": (
+            goodput_ratios[len(goodput_ratios) // 2] if goodput_ratios else None
+        ),
+        "overlap_hidden_s": a_med.get("overlap_hidden_s"),
+        "async_tpot_p50_s": a_med.get("tpot_s", {}).get("p50"),
+        "sync_tpot_p50_s": s_med.get("tpot_s", {}).get("p50"),
+        "async_legs_tok_s": [round(l["serve_tok_s"], 1) for l in async_legs],
+        "sync_legs_tok_s": [round(l["serve_tok_s"], 1) for l in sync_legs],
+        "decode_compiles": [a_med["decode_compiles"], s_med["decode_compiles"]],
+        "decode_burst": 1,
+        "token_parity": True,
+        "n_requests": len(trace),
+    }
+    return result
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    result = run(platform)
+    # overlap needs a core for the XLA worker BESIDE the host thread to
+    # turn hidden host time into wall time; on a 1-CPU box the two
+    # timeslice and the honest expectation is parity, not a win
+    cpus = os.cpu_count() or 1
+    can_parallelize = platform != "cpu" or cpus > 1
+    tpot_bar = 1.0 if can_parallelize else 1.10
+    result["cpu_count"] = cpus
+    result["tpot_bar"] = tpot_bar
+    print(json.dumps(result, indent=2, default=float))
+    failures = []
+    ratio = result["async_tpot_ratio"]
+    if ratio is None or ratio >= tpot_bar:
+        failures.append(
+            f"async_tpot_ratio {ratio} >= {tpot_bar} at decode_burst=1: the "
+            "double-buffered dispatch must cut TPOT when the host is on "
+            "the per-token critical path"
+            if can_parallelize
+            else f"async_tpot_ratio {ratio} >= {tpot_bar} at decode_burst=1: "
+            "on a 1-CPU box the overlap cannot win wall time, but it must "
+            "not cost this much either"
+        )
+    if not can_parallelize:
+        print(
+            "ASYNC_SMOKE NOTE: 1 CPU visible — host and XLA worker share "
+            "the core, so the TPOT gate is the no-regression bound "
+            f"{tpot_bar}; the < 1.0 win gate needs a second core or a "
+            "real accelerator",
+            file=sys.stderr,
+        )
+    a_hf, s_hf = result["async_host_fraction"], result["sync_host_fraction"]
+    if a_hf is None or s_hf is None or a_hf >= s_hf:
+        failures.append(
+            f"async_host_fraction {a_hf} not strictly below sync "
+            f"{s_hf}: the overlap hid no host time"
+        )
+    if not result["overlap_hidden_s"]:
+        failures.append(
+            "overlap_hidden_s == 0 on the async leg: the flight recorder "
+            "never saw host work run under an in-flight dispatch"
+        )
+    good = result["async_goodput_ratio"]
+    if good is None or good < 0.9:
+        failures.append(
+            f"async_goodput_ratio {good} < 0.9: throughput must not "
+            "regress with the overlap on"
+        )
+    for f in failures:
+        print(f"ASYNC_SMOKE FAIL: {f}", file=sys.stderr)
+    print(
+        "ASYNC_SMOKE "
+        f"{(ratio or 0.0):.4f} {(a_hf if a_hf is not None else -1.0):.4f} "
+        f"{(s_hf if s_hf is not None else -1.0):.4f} "
+        f"{result['decode_compiles'][0]} {result['decode_compiles'][1]}"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

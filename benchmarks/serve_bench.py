@@ -164,6 +164,10 @@ def run_engine_leg(model, engine_config, trace, engine=None) -> dict:
         "prefill_compiles": stats["prefill_compiles"],
         "prefix_hit_ratio": stats.get("prefix_hit_ratio", 0.0),
         "preemptions": stats.get("preemptions", 0),
+        # flight-recorder attribution over this leg only (reset_stats()
+        # above zeroed the recorder): the async_smoke host-hiding gauges
+        "host_fraction": stats.get("host_fraction"),
+        "overlap_hidden_s": stats.get("overlap_hidden_s", 0.0),
     }
     for key in ("ttft_s", "tpot_s"):
         if key in stats:
