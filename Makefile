@@ -3,7 +3,7 @@
 # compile-heavy model/pipeline/generation files and the end-to-end
 # example runs (batched so no single pytest process runs >10 min).
 
-.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke flight-smoke openai-smoke slo-smoke async-smoke
+.PHONY: test test_slow test_examples test_all telemetry-smoke ckpt-smoke trace-smoke metrics-smoke lint lint-smoke route-smoke shard-smoke radix-smoke kvq-smoke chaos-smoke race-smoke spec-smoke reqtrace-smoke flight-smoke openai-smoke slo-smoke async-smoke usage-smoke
 
 test:            ## core lane (default pytest addopts = -m "not slow and not examples")
 	python -m pytest tests/ -x -q
@@ -74,3 +74,6 @@ slo-smoke:        ## SLO closed loop: seeded overbudget-storm x2 on a 2-replica 
 
 async-smoke:      ## double-buffered dispatch: async vs sync interleaved legs at decode_burst=1 on the identical trace -> TPOT ratio < 1 (no-regress bound on a 1-CPU box), host_fraction strictly lower with overlap hidden, token parity, one decode executable per leg
 	python benchmarks/async_smoke.py
+
+usage-smoke:      ## usage ledger: seeded 3-tenant trace on a routed 2-replica fleet -> both ledgers conserve device-time + block-seconds, usage report --json round-trips pass=true, /metrics tenant counters agree, decode_compiles == 1 per replica
+	python benchmarks/usage_smoke.py

@@ -7,7 +7,7 @@ import sys
 
 from . import (
     config, env, estimate, launch, lint, merge, metrics, monitor, profile,
-    racecheck, route, serve, shardcheck, slo, test, tpu,
+    racecheck, route, serve, shardcheck, slo, test, tpu, usage,
 )
 
 
@@ -18,7 +18,7 @@ def main(argv: list[str] | None = None) -> int:
         allow_abbrev=False,
     )
     subparsers = parser.add_subparsers(dest="command")
-    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, profile, racecheck, route, serve, shardcheck, slo, tpu):
+    for module in (config, env, launch, test, estimate, lint, merge, metrics, monitor, profile, racecheck, route, serve, shardcheck, slo, tpu, usage):
         module.add_parser(subparsers)
 
     args = parser.parse_args(argv)

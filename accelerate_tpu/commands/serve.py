@@ -4,9 +4,11 @@ JSONL on stdin or a local HTTP endpoint.
 Request protocol (one JSON object per line / per POST body):
 ``{"id": <any>, "prompt": [token ids], "max_new_tokens": <int?>,
 "priority": "interactive"|"batch"?, "deadline_ms": <number?>,
-"sampling": {...}?, "grammar": {...}?}``;
+"tenant": <str?>, "sampling": {...}?, "grammar": {...}?}``;
 each completion is written back as
-``{"id", "tokens", "ttft_s", "tpot_s", "finish_reason"}``. ``priority``
+``{"id", "tenant", "tokens", "ttft_s", "tpot_s", "finish_reason"}`` plus
+the usage ledger's measured costs (``device_time_s`` /
+``kv_block_seconds`` / ``swap_bytes``) when accounting is on. ``priority``
 defaults to ``interactive``; under pool pressure the scheduler swaps
 ``batch`` victims to host DRAM before ever touching interactive ones.
 ``deadline_ms`` is a relative budget: once it elapses the scheduler
@@ -253,8 +255,10 @@ def _make_engine(args):
             spec_k=args.spec_k,
             draft=args.draft,
             flight_history=args.flight_history,
+            stats_interval=getattr(args, "stats_interval", 32),
             logprobs_topn=args.logprobs_topn,
             async_dispatch=not getattr(args, "sync_engine", False),
+            usage_accounting=getattr(args, "usage_accounting", True),
         ),
         mesh=mesh,
     )
@@ -293,6 +297,7 @@ def _result_dict(req, req_id) -> dict:
     out = {
         "id": req_id,
         "trace_id": req.trace_id,
+        "tenant": req.tenant,
         "tokens": req.output_tokens,
         "prompt_tokens": req.prompt_len,
         "ttft_s": req.ttft_s,
@@ -301,6 +306,10 @@ def _result_dict(req, req_id) -> dict:
     }
     if req.logprobs is not None:
         out["logprobs"] = req.logprobs
+    if req.usage is not None:
+        # the usage ledger's answer-row costs: what THIS request spent
+        # (device_time_s / kv_block_seconds / swap_bytes, measured)
+        out.update(req.usage)
     return out
 
 
@@ -374,6 +383,7 @@ def _engine_loop(engine, inbox, emit, stop, health=None, handler=None,
                         ),
                         sampling=payload.get("sampling"),
                         grammar=payload.get("grammar"),
+                        tenant=payload.get("tenant"),
                     )
                 except Exception as e:  # noqa: BLE001 — reported, not fatal
                     deliver({"id": req_id, "error": str(e)}, cb)
@@ -907,6 +917,18 @@ def add_parser(subparsers):
                    "on; env ACCELERATE_SERVE_PREFIX_CACHE=0 disables)")
     p.add_argument("--no-prefix-cache", dest="prefix_cache", action="store_false",
                    help="disable prefix sharing (every prompt prefills cold)")
+    usage_env = os.environ.get("ACCELERATE_SERVE_USAGE", "1")
+    p.add_argument("--usage-accounting", dest="usage_accounting",
+                   action="store_true",
+                   default=usage_env.strip().lower()
+                   not in ("0", "false", "no", "off", ""),
+                   help="conservation-checked per-request usage ledger: "
+                   "device-seconds, KV block-seconds, swap bytes by tenant/"
+                   "class (default on; env ACCELERATE_SERVE_USAGE=0 disables)")
+    p.add_argument("--no-usage-accounting", dest="usage_accounting",
+                   action="store_false",
+                   help="disable the usage ledger (answer rows carry no "
+                   "cost fields; stats()/telemetry carry no usage snapshot)")
     try:
         swap_default = float(os.environ.get("ACCELERATE_SERVE_SWAP_GB", "0") or 0)
     except ValueError:
@@ -976,6 +998,22 @@ def add_parser(subparsers):
                    "host-vs-device phase attribution behind "
                    "stats()['host_fraction'], `trace tail --iterations`, "
                    "GET /profile, and HANG_REPORT flight tails")
+    try:
+        stats_default = int(
+            os.environ.get("ACCELERATE_SERVE_STATS_INTERVAL", "32") or 32
+        )
+    except ValueError:
+        print(
+            "accelerate-tpu: ignoring malformed ACCELERATE_SERVE_STATS_INTERVAL="
+            f"{os.environ['ACCELERATE_SERVE_STATS_INTERVAL']!r} (want an integer)",
+            file=sys.stderr,
+        )
+        stats_default = 32
+    p.add_argument("--stats-interval", type=int, default=stats_default,
+                   help="emit a telemetry kind=\"step\" row (windowed "
+                   "throughput, cumulative counters, the usage-ledger "
+                   "snapshot) every N engine iterations (default 32; 0 "
+                   "disables; env ACCELERATE_SERVE_STATS_INTERVAL)")
     try:
         logprobs_default = int(
             os.environ.get("ACCELERATE_SERVE_LOGPROBS_TOPN", "0") or 0

@@ -257,6 +257,9 @@ def collect_status(logging_dir: str, now: float | None = None) -> dict[str, Any]
             "hbm_used_bytes": last_step.get("hbm_used_bytes"),
             "hbm_headroom_bytes": last_step.get("hbm_headroom_bytes"),
             "hbm_bytes_source": last_step.get("hbm_bytes_source"),
+            # usage ledger snapshot (conservation-checked per-request
+            # attribution — absent on usage_accounting=False engines)
+            "usage": last_step.get("usage"),
         }
         last_ts = serving[-1].get("ts")
         if last_ts:
@@ -488,6 +491,29 @@ def render_status(status: dict[str, Any]) -> str:
                 f"grammar-masked {_fmt(srv.get('grammar_masked_steps'), '{}')}"
                 + rej
             )
+        usage = srv.get("usage")
+        if isinstance(usage, dict):
+            by_tenant = usage.get("by_tenant")
+            tenants = ""
+            if isinstance(by_tenant, dict) and by_tenant:
+                top = sorted(
+                    (
+                        (t, row.get("device_seconds") or 0.0)
+                        for t, row in by_tenant.items()
+                        if isinstance(row, dict)
+                    ),
+                    key=lambda kv: -kv[1],
+                )[:3]
+                tenants = "   tenants: " + ", ".join(
+                    f"{t} {_fmt(s, '{:.3g}')}s" for t, s in top
+                )
+            lines.append(
+                f"  usage: device {_fmt(usage.get('device_seconds'), '{:.3g}')}s   "
+                f"kv {_fmt(usage.get('block_seconds'), '{:.3g}')} blk·s   "
+                f"swap {_fmt(usage.get('swap_bytes'), '{}')} B   "
+                f"closed {_fmt(usage.get('requests_finished'), '{}')} "
+                f"(live {_fmt(usage.get('requests_live'), '{}')})" + tenants
+            )
         if srv.get("prefix_hit_ratio") is not None or srv.get("preemptions"):
             lines.append(
                 f"  prefix cache: hit {_fmt(srv.get('prefix_hit_ratio'), '{:.0%}')}   "
@@ -564,6 +590,27 @@ def render_status(status: dict[str, Any]) -> str:
                     f"{_fmt(router.get('max_replicas'), '{}')})"
                 )
             lines.append("  router: " + "  ".join(parts))
+            by_tenant = router.get("by_tenant")
+            if isinstance(by_tenant, dict) and by_tenant:
+                tenant_parts = [
+                    f"{t} {_fmt(row.get('delivered'), '{}')}d"
+                    f"/{_fmt(row.get('shed'), '{}')}s"
+                    f"/{_fmt(row.get('requeued'), '{}')}r"
+                    f"/{_fmt(row.get('deadline_expired'), '{}')}x"
+                    for t, row in sorted(
+                        by_tenant.items(),
+                        key=lambda kv: -(
+                            (kv[1].get("delivered") or 0)
+                            if isinstance(kv[1], dict) else 0
+                        ),
+                    )[:5]
+                    if isinstance(row, dict)
+                ]
+                if tenant_parts:
+                    lines.append(
+                        "  tenants (delivered/shed/requeued/expired): "
+                        + "  ".join(tenant_parts)
+                    )
     goodput = status.get("goodput")
     if goodput:
         lost = goodput["lost_s_by_cause"]

@@ -187,6 +187,48 @@ def _observe_sampling(registry, rec: dict) -> None:
     field, name, help = _REJECTION_GAUGE
     if _num(rec.get(field)) is not None:
         registry.gauge(name, help).set(rec[field])
+#: usage-ledger tenant counters — one-table-two-surfaces: telemetry step
+#: rows carry a ``usage`` ledger snapshot and ``observe_engine_stats``
+#: reads ``stats()["usage"]``. Tenant-label cardinality is capped at the
+#: *producer* (the ledger folds beyond-top-K tenants into ``other``), so
+#: the scrape stays bounded whatever tenant ids the traffic carries.
+#: Counter names render with the OpenMetrics ``_total`` suffix, giving
+#: the documented ``serving_usage_{device_seconds,block_seconds,
+#: swap_bytes}_total{tenant=...}``.
+_USAGE_TENANT_COUNTERS = (
+    ("device_seconds", "serving_usage_device_seconds",
+     "Measured device-seconds (decode device_wait shares + prefill "
+     "chunks) attributed per tenant by the usage ledger"),
+    ("block_seconds", "serving_usage_block_seconds",
+     "KV block-seconds (integral of held blocks over wall time) per tenant"),
+    ("swap_bytes", "serving_usage_swap_bytes",
+     "Bytes moved to/from the host-DRAM swap tier per tenant"),
+)
+
+
+def _observe_usage(registry, usage) -> None:
+    """One usage-ledger snapshot (a step row's ``usage`` field or
+    ``stats()["usage"]``) → tenant-labeled counters. Shared by both export
+    surfaces; never raises on malformed snapshots."""
+    if not isinstance(usage, dict):
+        return
+    tenants = usage.get("by_tenant")
+    if isinstance(tenants, dict):
+        for tenant, trow in tenants.items():
+            if not isinstance(trow, dict):
+                continue
+            for field, name, help in _USAGE_TENANT_COUNTERS:
+                if _num(trow.get(field)) is not None:
+                    registry.counter(name, help).set_total(
+                        trow[field], tenant=str(tenant)[:64]
+                    )
+    if _num(usage.get("requests_finished")) is not None:
+        registry.counter(
+            "serving_usage_requests",
+            "Requests whose usage-ledger account has closed",
+        ).set_total(usage["requests_finished"])
+
+
 #: flight-recorder / device-memory gauges — one-table-two-surfaces again:
 #: telemetry step rows and ``observe_engine_stats`` both splice this in.
 #: Mirrors ``accelerate_tpu.serving.flight.ITERATION_PHASES`` semantics
@@ -290,6 +332,7 @@ def _observe_serving(registry, record: dict) -> None:
         ):
             if _num(record.get(field)) is not None:
                 registry.counter(name, help).set_total(record[field])
+        _observe_usage(registry, record.get("usage"))
         _observe_sampling(registry, record)
 
 
@@ -340,6 +383,30 @@ def observe_router_row(registry, row: dict) -> None:
         for field, name, help in _ROUTER_GAUGES:
             if _num(row.get(field)) is not None:
                 registry.gauge(name, help).set(row[field])
+        tenants = row.get("by_tenant")
+        if isinstance(tenants, dict):
+            # Tenant-labeled views of the delivery counters. Cardinality is
+            # capped at the producer (router folds beyond-top-K tenants into
+            # ``other``); the by_tenant field ``requeued`` feeds the same
+            # ``serving_router_requeues`` family as the aggregate row.
+            for tenant, trow in tenants.items():
+                if not isinstance(trow, dict):
+                    continue
+                for field, name, help in (
+                    ("delivered", "serving_router_delivered",
+                     "Requests delivered exactly once by the router"),
+                    ("shed", "serving_router_shed",
+                     "Requests shed by bounded-queue admission control"),
+                    ("deadline_expired", "serving_router_deadline_expired",
+                     "Requests answered with a deadline-exceeded error row "
+                     "by the router"),
+                    ("requeued", "serving_router_requeues",
+                     "Dispatches requeued after a replica failure or timeout"),
+                ):
+                    if _num(trow.get(field)) is not None:
+                        registry.counter(name, help).set_total(
+                            trow[field], tenant=str(tenant)[:64]
+                        )
         return
     rid = row.get("replica_id")
     if rid is not None and _num(row.get("restarts")) is not None:
@@ -398,4 +465,5 @@ def observe_engine_stats(registry, stats: dict) -> None:
     for field, name, help in (*_SHARING_COUNTERS, *_SPEC_COUNTERS):
         if _num(stats.get(field)) is not None:
             registry.counter(name, help).set_total(stats[field])
+    _observe_usage(registry, stats.get("usage"))
     _observe_sampling(registry, stats)

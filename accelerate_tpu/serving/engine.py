@@ -86,6 +86,7 @@ from .sampling import (
     uniform_per_slot,
 )
 from .scheduler import Request, RequestState, SlotScheduler, priority_rank
+from .usage import UsageLedger, normalize_tenant
 
 
 @dataclass
@@ -226,6 +227,14 @@ class EngineConfig:
     #: repetition-penalty window: the last this-many generated tokens ride
     #: the ``[num_slots, rep_window]`` ring lane
     rep_window: int = 32
+    #: per-request resource attribution (:mod:`.usage`): every request
+    #: accrues measured decode/prefill device-seconds, KV block-seconds,
+    #: swap bytes, spec and grammar counts, rolled up by tenant and
+    #: priority class with conservation asserted against the engine's own
+    #: ``device_wait`` and pool-occupancy totals. ``False`` removes the
+    #: ledger entirely — the disabled path is one truthiness check per
+    #: iteration (the telemetry/flight discipline).
+    usage_accounting: bool = True
 
     @property
     def blocks_per_slot(self) -> int:
@@ -423,9 +432,12 @@ class InferenceEngine:
             if cfg.swap_gb and cfg.swap_gb > 0
             else None
         )
+        #: per-request usage ledger (None = disabled: every hot-path hook
+        #: site pays one truthiness check and nothing else)
+        self.usage = UsageLedger() if cfg.usage_accounting else None
         self.scheduler = SlotScheduler(
             cfg.num_slots, self.allocator, cfg.block_size, cfg.max_seq_len,
-            radix=self.radix,
+            radix=self.radix, usage=self.usage,
         )
         self._kp = jnp.zeros(shape, store_dtype)
         self._vp = jnp.zeros(shape, store_dtype)
@@ -1130,6 +1142,7 @@ class InferenceEngine:
         upstream_hop: bool = False,
         sampling=None,
         grammar: dict | None = None,
+        tenant: str | None = None,
     ) -> Request:
         """Enqueue one request. ``deadline_ms`` is a *relative* budget from
         now: once it elapses the scheduler finishes the request with
@@ -1154,7 +1167,13 @@ class InferenceEngine:
         (``{"type": "regex", ...}`` or ``{"type": "json_schema", ...}``)
         compiled here — admission fails loudly on an unsupported grammar
         or when every grammar row is held by a live request, never
-        mid-decode."""
+        mid-decode.
+
+        ``tenant`` is the usage ledger's accounting dimension, riding the
+        same machinery as ``priority``/``trace_id``: any non-empty string
+        is taken verbatim (stripped, bounded), everything else normalizes
+        to ``"default"`` — unknown-safe, never an admission gate. It is
+        echoed on the answer row beside the accrued costs."""
         if not self._psampling and (sampling is not None or grammar is not None):
             raise ValueError(
                 "per-request sampling/grammar need per_slot_sampling=True "
@@ -1168,6 +1187,7 @@ class InferenceEngine:
             ),
             priority=priority,
             trace_id=ensure_trace_id(trace_id),
+            tenant=normalize_tenant(tenant),
         )
         if arrival_time is not None:
             req.arrival_time = arrival_time
@@ -1206,6 +1226,8 @@ class InferenceEngine:
             if req.grammar_row:
                 self._release_grammar_row(req)
             raise
+        if self.usage is not None:
+            self.usage.begin(req)
         tr = get_tracer()
         if tr:
             # the engine-side async span opens at ARRIVAL (stamped with the
@@ -1278,8 +1300,14 @@ class InferenceEngine:
             # never throttled to one admission per decode burst, while any
             # single prompt still advances at most one chunk between decode
             # steps — the TTFT/stall bound chunked prefill exists for
+            u = self.usage
             for req in sched.active(RequestState.PREFILL):
-                self._prefill_one_chunk(req, finished)
+                if u is not None:
+                    t0_pf = time.perf_counter()
+                    self._prefill_one_chunk(req, finished)
+                    u.accrue_prefill(req, time.perf_counter() - t0_pf)
+                else:
+                    self._prefill_one_chunk(req, finished)
 
         # harvest point: the previous iteration's round lands here,
         # exactly one iteration late. Backlog entries were force-harvested
@@ -1328,6 +1356,16 @@ class InferenceEngine:
                     new_tokens=len(req.output_tokens),
                     ttft_s=req.ttft_s, tpot_s=req.tpot_s,
                 )
+        if self.usage is not None:
+            # close each finished request's account NOW (before the answer
+            # rows emit) — held blocks drop to 0 on BOTH sides of the
+            # block-second integral, so the extra iteration the scheduler
+            # holds them before the next evict sweep is excluded
+            # consistently, and the summary rides the telemetry row
+            for req in finished:
+                summary = self.usage.finish(req)
+                if summary is not None:  # exactly-once across re-lists
+                    req.usage = summary
         self._emit_telemetry(finished)
         rec = self._fl_finish()
         if rec is not None:
@@ -1422,6 +1460,10 @@ class InferenceEngine:
         # only, for stats()['host_fraction'] and the ring both
         if self._flight is not None:
             self._flight.reset()
+        # like the flight ring: the ledger is measurement state — rollups
+        # zero, live requests re-base their block integrals at now
+        if self.usage is not None:
+            self.usage.reset()
 
     def _hbm_watermarks(self) -> dict:
         """Live device-memory watermarks where the backend exposes them
@@ -1550,6 +1592,10 @@ class InferenceEngine:
         out.update(self._spec_stats())
         out.update(self._sampling_stats())
         out.update(self._hbm_watermarks())
+        if self.usage is not None:
+            # totals + capped by_tenant + heavy hitters + the conservation
+            # partner totals (device_wait_seconds / pool_block_seconds)
+            out["usage"] = self.usage.snapshot()
         if self._flight is not None:
             # host_fraction + iteration p50/p99 + per-phase breakdowns
             # over the ring window (empty until an iteration records)
@@ -1623,14 +1669,18 @@ class InferenceEngine:
         self._fl_hidden = self._inflight is not None
         self._flight.current_phase = "schedule"
 
-    def _fl_switch(self, phase: str) -> None:
+    def _fl_switch(self, phase: str) -> float | None:
         """Close the open interval into its phase bucket and open
         ``phase``. Phases may be re-entered (the async loop visits
         "harvest" both at the harvest point and for bookkeeping) — the
         buckets accumulate, and their sum telescopes to the iteration
-        wall exactly, which ``FlightRecorder.record`` asserts."""
+        wall exactly, which ``FlightRecorder.record`` asserts. Returns
+        the closed interval's duration (None when the recorder is off) —
+        the usage ledger accrues the EXACT ``device_wait`` float the
+        flight recorder does, which is what makes Σ per-request decode
+        shares == flight ``device_wait`` an identity, not an estimate."""
         if self._fl_phases is None:
-            return
+            return None
         t = time.perf_counter()
         dt = t - self._fl_last
         self._fl_phases[self._fl_cur] += dt
@@ -1642,6 +1692,7 @@ class InferenceEngine:
         # the host could NOT hide, so it never accrues overlap
         self._fl_hidden = self._inflight is not None and phase != "device_wait"
         self._flight.current_phase = phase
+        return dt
 
     def _fl_finish(self):
         """Close the last interval; returns ``(t0, wall_s, phases,
@@ -1669,7 +1720,17 @@ class InferenceEngine:
         rd = self._inflight
         if rd is None:
             return
+        u = self.usage
+        pre = None if u is None else [len(r.output_tokens) for r in rd.live]
         self._fl_switch("device_wait")
+        # flight disabled: the ledger stamps its own device_wait interval
+        # around the blocking device_get (flight enabled: it reuses the
+        # EXACT float _fl_switch closed, so the two totals are identical)
+        t_dw = (
+            time.perf_counter()
+            if u is not None and self._fl_phases is None
+            else 0.0
+        )
         if rd.kind == "spec":
             tok_seq, accept = (
                 np.asarray(x) for x in jax.device_get((rd.toks, rd.accept))
@@ -1684,7 +1745,9 @@ class InferenceEngine:
         else:
             next_toks = np.asarray(jax.device_get(rd.toks))  # [burst, slots]
         self._inflight = None
-        self._fl_switch("harvest")
+        dw = self._fl_switch("harvest")
+        if u is not None and dw is None:
+            dw = time.perf_counter() - t_dw
         if rd.kind == "spec":
             k = self.config.spec_k
             if self._tr is not None:
@@ -1697,6 +1760,8 @@ class InferenceEngine:
                 a = int(accept[req.slot])
                 self._spec_drafted += k
                 self._spec_accepted += a
+                if u is not None:
+                    u.accrue_spec(req, k, a)
                 if req.sampling is not None and req.sampling.do_sample:
                     # rejection-sampling health, counted over sampled slots
                     # only (greedy slots use exact-prefix acceptance)
@@ -1706,21 +1771,39 @@ class InferenceEngine:
                     if req.state is RequestState.FINISHED:
                         break  # mid-round eos/length: the run's tail is waste
                     self._emit_token(req, int(tok_seq[req.slot, t]), finished)
-            return
-        for req in rd.live:
-            want_lp = (
-                rd.harvest_lp and req.sampling is not None and req.sampling.logprobs
-            )
-            for t in range(self.config.decode_burst):
-                if req.state is RequestState.FINISHED:
-                    break  # mid-burst eos/length: the tail lane-steps are waste
-                entry = None
-                if want_lp:
-                    entry = self._logprob_entry(
-                        req.sampling, float(logps[t, req.slot]),
-                        tvals[t, req.slot], tids[t, req.slot],
+        else:
+            for req in rd.live:
+                want_lp = (
+                    rd.harvest_lp
+                    and req.sampling is not None
+                    and req.sampling.logprobs
+                )
+                for t in range(self.config.decode_burst):
+                    if req.state is RequestState.FINISHED:
+                        break  # mid-burst eos/length: tail lane-steps are waste
+                    entry = None
+                    if want_lp:
+                        entry = self._logprob_entry(
+                            req.sampling, float(logps[t, req.slot]),
+                            tvals[t, req.slot], tids[t, req.slot],
+                        )
+                    self._emit_token(
+                        req, int(next_toks[t, req.slot]), finished, entry
                     )
-                self._emit_token(req, int(next_toks[t, req.slot]), finished, entry)
+        if u is not None:
+            # apportion the round's device_wait across its batch, weighted
+            # by the tokens each request actually emitted from this
+            # harvest (stop-trim can shrink output_tokens — clamp at 0);
+            # an all-discarded round splits equally so no interval is lost
+            emitted = [
+                max(0, len(r.output_tokens) - p) for r, p in zip(rd.live, pre)
+            ]
+            shares = (
+                [(r.request_id, e) for r, e in zip(rd.live, emitted) if e]
+                if any(emitted)
+                else [(r.request_id, 1) for r in rd.live]
+            )
+            u.accrue_decode(dw, shares)
 
     def _fence_inflight(self) -> bool:
         """Synchronize with the in-flight round before host code touches
@@ -1813,6 +1896,13 @@ class InferenceEngine:
             self._swapped_in_blocks += n
             req.swap_plan = []
             req.preempted = False
+            if self.usage is not None:
+                # restored blocks re-enter the held count (admit() stamped
+                # the pre-restore count with swap_plan still pending)
+                self.usage.accrue_swap(
+                    req, bytes_in=n * self._swap.bytes_per_block
+                )
+                self.usage.update_blocks(req)
             if self._tr is not None:
                 # seconds ride the event: swap-in stalls are exactly the
                 # tail-latency share `trace tail` attributes to this phase
@@ -1906,6 +1996,13 @@ class InferenceEngine:
         self.scheduler.requeue_preempted(victim)
         self._preemptions += 1
         self._swapped_out_blocks += len(plan)
+        if self.usage is not None:
+            # swapped blocks leave the victim's held count (host DRAM is
+            # not pool occupancy); retained shared blocks keep accruing
+            self.usage.accrue_swap(
+                victim, bytes_out=len(plan) * self._swap.bytes_per_block
+            )
+            self.usage.update_blocks(victim)
         if self._tr is not None:
             self._tr.request_instant(
                 victim.trace_id, "req/preempt", blocks=len(plan),
@@ -1927,6 +2024,8 @@ class InferenceEngine:
                 self.allocator.decref(retained)
             req.swap_plan = []
         req.blocks = []
+        if self.usage is not None:
+            self.usage.update_blocks(req)
 
     def _force_finish_out_of_blocks(
         self, req: Request, finished: list[Request]
@@ -2386,6 +2485,8 @@ class InferenceEngine:
             # in-trace advance only fed mid-burst masking); entering a
             # state with no live continuation means the match is complete
             self._grammar_masked_steps += 1
+            if self.usage is not None:
+                self.usage.accrue_grammar(req)
             g = self._row_grammar[req.grammar_row]
             req.dfa_state = g.advance(req.dfa_state, tok)
             if req.finish_reason is None and g.final[req.dfa_state]:
@@ -2417,11 +2518,15 @@ class InferenceEngine:
                 request_id=req.request_id,
                 trace_id=req.trace_id,
                 priority=req.priority,
+                tenant=req.tenant,
                 prompt_tokens=req.prompt_len,
                 new_tokens=len(req.output_tokens),
                 ttft_s=req.ttft_s,
                 tpot_s=req.tpot_s,
                 finish_reason=req.finish_reason,
+                # device_time_s / kv_block_seconds / swap_bytes — the
+                # closed per-request account (absent on a no-ledger engine)
+                **(req.usage or {}),
             )
         interval = self.config.stats_interval
         if interval and self._iterations % interval == 0:
@@ -2463,6 +2568,11 @@ class InferenceEngine:
                 **(
                     self._flight.telemetry_fields()
                     if self._flight is not None
+                    else {}
+                ),
+                **(
+                    {"usage": self.usage.snapshot()}
+                    if self.usage is not None
                     else {}
                 ),
             )

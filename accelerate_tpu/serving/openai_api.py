@@ -15,11 +15,14 @@ Request mapping:
   ``temperature=1`` samples);
 * ``response_format={"type": "json_schema", ...}`` → a :mod:`.grammar`
   constrained-decoding spec — every completion parses and validates;
-* ``priority`` / ``deadline_ms`` / ``trace_id`` ride the vendor-prefixed
-  extension fields ``x_accelerate_priority`` / ``x_accelerate_deadline_ms``
-  / ``x_accelerate_trace_id``, so PR 11/15 scheduling + tracing machinery
-  works through the standard surface (and the response carries an
-  ``x_accelerate`` block with trace_id/ttft/tpot);
+* ``priority`` / ``deadline_ms`` / ``trace_id`` / ``tenant`` ride the
+  vendor-prefixed extension fields ``x_accelerate_priority`` /
+  ``x_accelerate_deadline_ms`` / ``x_accelerate_trace_id`` /
+  ``x_accelerate_tenant``, so scheduling + tracing + usage-attribution
+  machinery works through the standard surface (and the response carries
+  an ``x_accelerate`` block with trace_id/ttft/tpot plus the request's
+  measured costs — ``device_time_s``/``kv_block_seconds``/``swap_bytes``
+  from the usage ledger);
 * errors are OpenAI-shaped ``{"error": {message, type, param, code}}``
   objects with the right HTTP status.
 
@@ -246,6 +249,8 @@ class OpenAIFrontend:
             payload["deadline_ms"] = body["x_accelerate_deadline_ms"]
         if body.get("x_accelerate_trace_id") is not None:
             payload["trace_id"] = body["x_accelerate_trace_id"]
+        if body.get("x_accelerate_tenant") is not None:
+            payload["tenant"] = body["x_accelerate_tenant"]
         return payload
 
     def _parse(self, path: str, body) -> tuple[dict, dict]:
@@ -304,7 +309,12 @@ class OpenAIFrontend:
     @staticmethod
     def _vendor(result: dict, raw_finish: str | None) -> dict:
         out = {}
-        for key in ("trace_id", "ttft_s", "tpot_s"):
+        for key in (
+            "trace_id", "ttft_s", "tpot_s",
+            # usage-ledger costs: what THIS request spent (measured, not
+            # estimated — absent on usage_accounting=False engines)
+            "tenant", "device_time_s", "kv_block_seconds", "swap_bytes",
+        ):
             if result.get(key) is not None:
                 out[key] = result[key]
         if raw_finish is not None:

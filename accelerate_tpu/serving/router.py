@@ -63,6 +63,7 @@ from ..analysis.lockwatch import get_active_lockwatch, maybe_watch
 from ..diagnostics.tracing import ensure_trace_id, get_tracer
 from ..logging import get_logger
 from .replica import ReplicaError, ReplicaHandle, ReplicaTimeout
+from .usage import DEFAULT_TOP_K, cap_by_key, normalize_tenant
 
 logger = get_logger(__name__)
 
@@ -113,6 +114,14 @@ class Ticket:
     def priority(self) -> str:
         p = self.payload.get("priority") if isinstance(self.payload, dict) else None
         return p if isinstance(p, str) else "interactive"
+
+    @property
+    def tenant(self) -> str:
+        """The request's accounting tenant (the usage-ledger dimension) —
+        same payload-riding contract as ``priority``, same unknown-safe
+        normalization the engine applies."""
+        t = self.payload.get("tenant") if isinstance(self.payload, dict) else None
+        return normalize_tenant(t)
 
     @property
     def req_id(self):
@@ -200,6 +209,12 @@ class Router:
         self._shed = 0
         self._deadline_expired = 0
         self._tokens = 0
+        # per-tenant outcome counts (usage-ledger attribution at the fleet
+        # seam: which tenant's traffic was delivered / shed / requeued /
+        # expired). Written under _lock at the same sites as the scalar
+        # counters; exported capped to top-K + "other" like every tenant
+        # label surface
+        self._by_tenant: dict[str, dict] = {}
         # earliest deadline among queued tickets (None = no deadlines):
         # the dispatch loop runs the expiry sweep only once this instant
         # passes, so deadline-free traffic pays one None-check per
@@ -220,6 +235,16 @@ class Router:
             t.start()
         if supervisor is not None:
             supervisor.bind(self)
+
+    def _bump_tenant(self, tenant: str, outcome: str) -> None:
+        """One per-tenant outcome count (caller holds ``_lock``, like the
+        scalar counter the call sits beside)."""
+        row = self._by_tenant.get(tenant)
+        if row is None:
+            row = self._by_tenant[tenant] = {
+                "delivered": 0, "shed": 0, "requeued": 0, "deadline_expired": 0,
+            }
+        row[outcome] += 1
 
     # -- admission -----------------------------------------------------------
 
@@ -284,6 +309,9 @@ class Router:
                             shed_victim = t
                             break
                 self._shed += 1
+                self._bump_tenant(
+                    (shed_victim or ticket).tenant, "shed"
+                )
                 if shed_victim is not None:
                     self._queue.remove(shed_victim)
                     self._outstanding += 1
@@ -395,6 +423,8 @@ class Router:
             gone = set(map(id, expired))
             self._queue = deque(t for t in self._queue if id(t) not in gone)
             self._deadline_expired += len(expired)
+            for t in expired:
+                self._bump_tenant(t.tenant, "deadline_expired")
         self._next_deadline = min(
             (t.deadline for t in self._queue if t.deadline is not None),
             default=None,
@@ -509,6 +539,7 @@ class Router:
                 self._inflight.get(replica.replica_id, set()).discard(ticket)
                 if not rescued:
                     self._requeues += 1
+                    self._bump_tenant(ticket.tenant, "requeued")
                 stopped = self._stopped.is_set()
             if not timed_out:
                 self._note_failure(replica)
@@ -521,6 +552,7 @@ class Router:
                 # an answer nobody reads
                 with self._lock:
                     self._deadline_expired += 1
+                    self._bump_tenant(ticket.tenant, "deadline_expired")
                 self._finish(ticket, self._deadline_error(
                     ticket, f"expired after {ticket.attempts} dispatch attempt(s)"
                 ))
@@ -591,6 +623,7 @@ class Router:
             if count_delivered:
                 self._delivered += 1
                 self._outstanding -= 1
+                self._bump_tenant(ticket.tenant, "delivered")
             # token accounting lives under the delivered guard: a late
             # answer from a wedged replica must not double-count
             if isinstance(result, dict) and isinstance(result.get("tokens"), list):
@@ -652,6 +685,7 @@ class Router:
             for t in stranded:
                 self._queue.appendleft(t)
                 self._requeues += 1
+                self._bump_tenant(t.tenant, "requeued")
                 # re-arm the expiry watermark: a rescued deadline ticket
                 # must be answered, never re-dispatched past its budget
                 self._arm_deadline(t.deadline)
@@ -816,6 +850,12 @@ class Router:
                 # summed engine admission backlog: the "queued" pressure
                 # signal when the router queue itself is empty
                 "replica_queue_depth": sum(r.queue_depth for r in self.replicas),
+                # per-tenant outcome attribution (usage ledger at the fleet
+                # seam) — capped to top-K + "other" so a hostile tenant-id
+                # stream cannot grow the trail rows or the scrape unbounded
+                "by_tenant": cap_by_key(
+                    self._by_tenant, DEFAULT_TOP_K, weight_field="delivered"
+                ),
             }
         if self.supervisor is not None:
             sup = self.supervisor
@@ -984,6 +1024,9 @@ class Router:
                 "deadline_expired": self._deadline_expired,
                 "tokens": self._tokens,
                 "sessions": len(self._sessions),
+                "by_tenant": cap_by_key(
+                    self._by_tenant, DEFAULT_TOP_K, weight_field="delivered"
+                ),
                 "per_replica": {
                     r.replica_id: {
                         "state": r.state,

@@ -77,6 +77,11 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     priority: str = "interactive"  # see PRIORITY_CLASSES
+    #: accounting dimension, not an admission gate: any string is legal
+    #: (the engine normalizes), unknown tenants never raise, and the key
+    #: rides payload → Ticket → add_request → here exactly like
+    #: ``priority``/``trace_id``, echoed on answer rows and usage rollups
+    tenant: str = "default"
     request_id: int = field(default_factory=lambda: next(_request_ids))
     #: distributed-trace identity: born at the submit boundary (client-
     #: supplied or generated), echoed on every answer row, and stamped on
@@ -117,6 +122,10 @@ class Request:
     swap_plan: list[tuple[int, int]] = field(default_factory=list)
     preempted: bool = False
     preemptions: int = 0
+    #: final cost summary (device_time_s / kv_block_seconds / swap_bytes)
+    #: stamped by the usage ledger when the engine processes completion;
+    #: None on a usage_accounting=False engine
+    usage: dict | None = None
 
     @property
     def prompt_len(self) -> int:
@@ -146,12 +155,16 @@ class SlotScheduler:
     the block allocator, and (optionally) the radix prefix cache."""
 
     def __init__(self, num_slots: int, allocator: BlockAllocator, block_size: int,
-                 max_seq_len: int, radix=None):
+                 max_seq_len: int, radix=None, usage=None):
         self.num_slots = int(num_slots)
         self.allocator = allocator
         self.block_size = int(block_size)
         self.max_seq_len = int(max_seq_len)
         self.radix = radix
+        #: the engine's :class:`~.usage.UsageLedger` (None = accounting
+        #: off): block-ownership edges here are where per-request KV
+        #: block-seconds accrue
+        self.usage = usage
         self.waiting: dict[str, deque[Request]] = {p: deque() for p in PRIORITY_CLASSES}
         self.slots: list[Request | None] = [None] * self.num_slots
         #: cumulative prompt tokens of admitted (fresh) requests — the
@@ -244,6 +257,8 @@ class SlotScheduler:
             if req is not None and req.state is RequestState.FINISHED:
                 self.allocator.decref(req.blocks)
                 req.blocks = []
+                if self.usage is not None:
+                    self.usage.update_blocks(req)
                 req.slot = None
                 self.slots[i] = None
                 if req.deadline is not None:
@@ -365,6 +380,11 @@ class SlotScheduler:
             self.waiting[req.priority].popleft()
             req.slot = free_slots.pop(0)
             self.slots[req.slot] = req
+            if self.usage is not None:
+                # block-ownership edge: fresh admits start their integral
+                # here; preempted re-admits resume at full holdings once
+                # the engine clears swap_plan in _place_admitted
+                self.usage.update_blocks(req)
             admitted.append(req)
         return admitted
 
@@ -400,8 +420,14 @@ class SlotScheduler:
             ),
             self.block_size,
         )
+        if len(req.blocks) >= need:
+            return True
         while len(req.blocks) < need:
             if not self._ensure_free(1):
+                if self.usage is not None:
+                    self.usage.update_blocks(req)  # partial growth still held
                 return False
             req.blocks.extend(self.allocator.allocate(1))
+        if self.usage is not None:
+            self.usage.update_blocks(req)
         return True

@@ -13,8 +13,10 @@ entries sorted by ``t``; payloads are exactly the request dicts the
 ``serve``/``route`` JSONL protocol accepts (``prompt`` token ids,
 ``max_new_tokens``, optional ``session_id``/``priority``/``deadline_ms``).
 Scenario catalogue (``serve --trace SPEC`` / ``route --trace SPEC``,
-``SPEC = name:seed:duration:rps``; a malformed spec is a bring-up refusal
-— exit 2 — exactly like ``--chaos-spec``):
+``SPEC = name:seed:duration:rps[:tenants=N]`` — the optional ``tenants=N``
+stamps each payload with a seeded tenant id for usage attribution; a
+malformed spec is a bring-up refusal — exit 2 — exactly like
+``--chaos-spec``):
 
 ``bursty-diurnal``    sinusoid-modulated Poisson arrivals (a compressed
                       diurnal cycle: troughs and rush hours in one run)
@@ -82,18 +84,24 @@ class TraceSpecError(ValueError):
 
 @dataclass(frozen=True)
 class TraceSpec:
-    """One parsed ``--trace`` spec. ``path`` is set only for ``replay``."""
+    """One parsed ``--trace`` spec. ``path`` is set only for ``replay``.
+    ``tenants`` > 0 stamps each payload with a deterministic tenant id
+    (``t0``..``t{N-1}``) for usage-attribution scenarios."""
 
     name: str
     seed: int = 0
     duration_s: float = 10.0
     rps: float = 4.0
     path: str | None = None
+    tenants: int = 0
 
     def as_text(self) -> str:
         if self.name == "replay":
             return f"replay:{self.path}"
-        return f"{self.name}:{self.seed}:{self.duration_s:g}:{self.rps:g}"
+        text = f"{self.name}:{self.seed}:{self.duration_s:g}:{self.rps:g}"
+        if self.tenants:
+            text += f":tenants={self.tenants}"
+        return text
 
 
 def parse_trace_spec(spec: str) -> TraceSpec:
@@ -114,9 +122,22 @@ def parse_trace_spec(spec: str) -> TraceSpec:
             f"{SCENARIOS} or replay:<path>"
         )
     parts = rest.split(":") if rest else []
+    tenants = 0
+    if len(parts) == 4 and parts[3].startswith("tenants="):
+        try:
+            tenants = int(parts[3][len("tenants="):])
+            if tenants < 0:
+                raise ValueError
+        except ValueError:
+            raise TraceSpecError(
+                f"--trace spec {spec!r}: tenants= must be a non-negative "
+                f"integer"
+            ) from None
+        parts = parts[:3]
     if len(parts) != 3:
         raise TraceSpecError(
             f"--trace spec {spec!r} must be name:seed:duration:rps"
+            f"[:tenants=N]"
         )
     try:
         seed = int(parts[0])
@@ -135,7 +156,9 @@ def parse_trace_spec(spec: str) -> TraceSpec:
         raise TraceSpecError(
             f"--trace spec {spec!r}: duration and rps must be positive numbers"
         ) from None
-    return TraceSpec(name=name, seed=seed, duration_s=duration_s, rps=rps)
+    return TraceSpec(
+        name=name, seed=seed, duration_s=duration_s, rps=rps, tenants=tenants
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +317,13 @@ def generate_schedule(spec: TraceSpec) -> list[dict]:
         f"workload generator {spec.name!r} is non-deterministic for "
         f"seed {spec.seed}"
     )
+    if spec.tenants:
+        # tenant assignment is a post-process on the arrival schedule —
+        # its own seeded stream, so `tenants=N` changes WHO each request
+        # bills, never when it arrives or what it asks for
+        rng = random.Random(spec.seed * 1_000_003 + spec.tenants)
+        for entry in schedule:
+            entry["payload"]["tenant"] = f"t{rng.randrange(spec.tenants)}"
     return schedule
 
 

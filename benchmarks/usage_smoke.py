@@ -1,0 +1,240 @@
+"""Usage-ledger smoke: a seeded 3-tenant trace through a real routed
+2-replica fleet, with the conservation invariant checked everywhere the
+numbers surface.
+
+What it pins, end to end:
+
+1. **Conservation on both replicas** — each replica's ledger satisfies
+   Σ per-request decode device-seconds == cumulative ``device_wait`` and
+   Σ per-request block-seconds == the pool-occupancy integral;
+2. **The tenant dimension round-trips** — ``--trace ...:tenants=3``
+   assigns ``t0/t1/t2`` from a seeded stream, every answer row carries
+   its tenant and its measured costs (``device_time_s`` /
+   ``kv_block_seconds`` / ``swap_bytes``) exactly once, and the fleet's
+   per-tenant device-seconds sum to the fleet total;
+3. **Scorecard and scrape agree** — ``usage report --json`` on the
+   fleet's logging dir (router trail at the root, one telemetry trail
+   per replica) round-trips with ``"conserved": true``, and each
+   replica's ``GET /metrics`` tenant-labeled counters equal its own
+   ledger rollup;
+4. **Serving invariants survive** — ``decode_compiles == [1, 1]``: the
+   ledger rides existing edges, it never perturbs the one compiled
+   decode executable.
+
+Run directly (``make usage-smoke``).
+"""
+
+import json
+import math
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# replicas are separate single-device processes — the parent never imports
+# jax, exactly like the production router host
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: the seeded 3-tenant trace: ~30 bursty arrivals, tenant assignment is a
+#: post-process on the schedule (same arrivals as the tenant-less spec)
+SPEC_TEXT = "bursty-diurnal:7:3:10:tenants=3"
+
+ENGINE_ARGS = [
+    "--preset", "tiny", "--num-slots", "4", "--block-size", "8",
+    "--max-seq-len", "96", "--prefill-chunk", "8", "--decode-burst", "2",
+]
+
+_REL_TOL = 1e-6
+_ABS_TOL = 1e-9
+
+
+def _replica_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # single-device replicas: fast start, no oversubscription
+    # a step row (with the ledger snapshot) every iteration, so the
+    # telemetry trail's last snapshot is the replica's final state
+    env["ACCELERATE_SERVE_STATS_INTERVAL"] = "1"
+    env.pop("ACCELERATE_SERVE_USAGE", None)  # the default-on path is the product
+    return env
+
+
+def _close(a, b):
+    return math.isclose(a, b, rel_tol=_REL_TOL, abs_tol=_ABS_TOL)
+
+
+def _assert_conserved(snap, who):
+    assert _close(snap["decode_device_seconds"], snap["device_wait_seconds"]), (
+        f"{who}: decode attribution leaks: Σ shares "
+        f"{snap['decode_device_seconds']} vs device_wait "
+        f"{snap['device_wait_seconds']}"
+    )
+    assert _close(snap["block_seconds"], snap["pool_block_seconds"]), (
+        f"{who}: block-second attribution leaks: Σ integrals "
+        f"{snap['block_seconds']} vs pool integral {snap['pool_block_seconds']}"
+    )
+
+
+def _scrape_tenant_counters(base_url, name):
+    """Parse one tenant-labeled counter family off a replica's /metrics."""
+    with urllib.request.urlopen(base_url + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    out = {}
+    for m in re.finditer(
+        rf'^accelerate_{name}_total{{tenant="([^"]+)"}} (\S+)$', text, re.M
+    ):
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def run(platform: str = "cpu") -> dict:
+    from accelerate_tpu.serving.replica import spawn_replica, wait_until_ready
+    from accelerate_tpu.serving.router import Router
+    from accelerate_tpu.serving.workload import (
+        generate_schedule,
+        parse_trace_spec,
+        run_schedule,
+        write_workload_manifest,
+    )
+
+    spec = parse_trace_spec(SPEC_TEXT)
+    schedule = generate_schedule(spec)
+    traced_tenants = {e["payload"]["tenant"] for e in schedule}
+    assert traced_tenants <= {"t0", "t1", "t2"} and len(traced_tenants) >= 2
+
+    with tempfile.TemporaryDirectory() as logdir:
+        write_workload_manifest(logdir, spec, schedule)
+        replicas = [
+            spawn_replica(
+                i,
+                ENGINE_ARGS
+                + ["--logging-dir", os.path.join(logdir, f"replica_{i}")],
+                env=_replica_env(),
+            )
+            for i in range(2)
+        ]
+        router = Router(replicas, logging_dir=logdir, health_interval=0.2)
+        try:
+            wait_until_ready(replicas, timeout=300)
+            deliveries = []
+            submitted = run_schedule(
+                schedule, lambda p: router.submit(p, callback=deliveries.append)
+            )
+            assert submitted == len(schedule), (submitted, len(schedule))
+            if not router.wait_idle(timeout=600):
+                raise RuntimeError("router never went idle")
+
+            # -- every answer carries its tenant + costs, exactly once -----
+            assert len(deliveries) == len(schedule), (
+                f"{len(deliveries)} deliveries for {len(schedule)} requests"
+            )
+            ids = [d.get("id") for d in deliveries]
+            assert len(ids) == len(set(ids)), "duplicated delivery"
+            by_tenant_rows = {}
+            for d in deliveries:
+                assert d.get("tenant") in traced_tenants, d
+                assert d.get("device_time_s", -1.0) >= 0.0, d
+                assert d.get("kv_block_seconds", -1.0) >= 0.0, d
+                assert "swap_bytes" in d, d
+                by_tenant_rows.setdefault(d["tenant"], []).append(d)
+
+            # -- conservation + scrape agreement per replica ---------------
+            compiles, fleet_total, fleet_by_tenant = [], 0.0, {}
+            for r in replicas:
+                with urllib.request.urlopen(
+                    r.base_url + "/stats", timeout=10
+                ) as resp:
+                    stats = json.loads(resp.read())
+                compiles.append(stats["decode_compiles"])
+                snap = stats["usage"]
+                _assert_conserved(snap, f"replica {r.replica_id}")
+                assert snap["requests_live"] == 0
+                fleet_total += snap["device_seconds"]
+                for tenant, trow in snap["by_tenant"].items():
+                    fleet_by_tenant[tenant] = (
+                        fleet_by_tenant.get(tenant, 0.0) + trow["device_seconds"]
+                    )
+                scraped = _scrape_tenant_counters(
+                    r.base_url, "serving_usage_device_seconds"
+                )
+                for tenant, trow in snap["by_tenant"].items():
+                    assert tenant in scraped and _close(
+                        scraped[tenant], trow["device_seconds"]
+                    ), (
+                        f"replica {r.replica_id}: /metrics disagrees with the "
+                        f"ledger for {tenant}: {scraped.get(tenant)} vs "
+                        f"{trow['device_seconds']}"
+                    )
+            assert compiles == [1, 1], (
+                f"usage accounting recompiled a replica: {compiles}"
+            )
+            # tenants partition the fleet total — nothing double-billed
+            assert _close(sum(fleet_by_tenant.values()), fleet_total)
+
+            clean = router.drain(timeout=120)
+            assert clean, "drain did not exit cleanly"
+        finally:
+            router.close()
+
+        # -- the offline scorecard sees the same story -----------------------
+        from accelerate_tpu.commands.usage import build_report
+
+        report = build_report(logdir)
+        roundtrip = json.loads(json.dumps(report, default=str))
+        assert roundtrip["conserved"] is True and roundtrip["pass"] is True, (
+            roundtrip
+        )
+        ledger_runs = [
+            row for row in roundtrip["runs"] if row["usage"] is not None
+        ]
+        assert len(ledger_runs) == 2, (
+            f"expected both replicas' trails in the report: {roundtrip['runs']}"
+        )
+        report_finished = sum(
+            row["usage"]["requests_finished"] for row in ledger_runs
+        )
+        assert report_finished == len(schedule), (
+            f"trail snapshots closed {report_finished} accounts for "
+            f"{len(schedule)} requests"
+        )
+
+    return {
+        "spec": SPEC_TEXT,
+        "n_requests": len(schedule),
+        "tenants": sorted(traced_tenants),
+        "decode_compiles": compiles,
+        "conserved": True,
+        "report_pass": True,
+        "fleet_device_seconds": fleet_total,
+        "by_tenant_device_seconds": {
+            t: fleet_by_tenant[t] for t in sorted(fleet_by_tenant)
+        },
+        "requests_by_tenant": {
+            t: len(by_tenant_rows[t]) for t in sorted(by_tenant_rows)
+        },
+    }
+
+
+def main() -> int:
+    r = run()
+    shares = "  ".join(
+        f"{t} {s:.4g}s" for t, s in r["by_tenant_device_seconds"].items()
+    )
+    print(
+        f"usage-smoke OK: {r['spec']} — {r['n_requests']} requests over "
+        f"{len(r['tenants'])} tenants through a routed 2-replica fleet\n"
+        f"  both ledgers conserved (device-time and block-seconds), "
+        f"usage report --json round-trips pass=true, "
+        f"/metrics tenant counters agree, "
+        f"decode_compiles={r['decode_compiles']}\n"
+        f"  fleet device-seconds {r['fleet_device_seconds']:.4g}s "
+        f"partitioned: {shares}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
